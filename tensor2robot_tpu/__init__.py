@@ -1,0 +1,14 @@
+"""tensor2robot_tpu: TPU-native (JAX/XLA/pjit/Pallas) framework with the
+capabilities of google-research/tensor2robot.
+
+Spec-driven training / evaluation / export / serving for large-scale robotic
+perception & control models. A model declares its inputs and labels as
+`TensorSpec` structures; the framework auto-generates the data pipeline,
+SPMD train step, checkpointing, export signatures and robot-side inference
+feeds from them.
+"""
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+__version__ = "0.1.0"

@@ -1,0 +1,28 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX import.
+
+Mirrors the reference's "TPUEstimator-on-CPU" test strategy
+(/root/reference/utils/train_eval.py:136,149-151): all sharding / pjit tests
+run against a virtual 8-device CPU topology so they validate multi-chip
+sharding without hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402  (import after env setup)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected >=8 virtual devices, got {len(devs)}"
+    return devs[:8]

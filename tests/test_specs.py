@@ -1,0 +1,384 @@
+"""Tests for the L0 spec system.
+
+Ports the semantics guarded by the reference's tensorspec_utils_test.py
+(SURVEY.md §7 "hard parts": TensorSpecStruct live-view semantics), adapted
+to the JAX-native design.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+
+class TestTensorSpec:
+
+  def test_basic_construction(self):
+    s = TensorSpec(shape=(64, 64, 3), dtype=np.uint8, name="image",
+                   data_format="jpeg")
+    assert s.shape == (64, 64, 3)
+    assert s.dtype == np.dtype(np.uint8)
+    assert s.is_image
+    assert s.rank == 3
+
+  def test_bfloat16_dtype(self):
+    import ml_dtypes
+    s = TensorSpec(shape=(4,), dtype="bfloat16")
+    assert s.dtype == np.dtype(ml_dtypes.bfloat16)
+
+  def test_invalid_data_format(self):
+    with pytest.raises(ValueError):
+      TensorSpec(shape=(2,), data_format="webp")
+
+  def test_from_array(self):
+    s = TensorSpec.from_array(np.zeros((3, 4), np.float32), name="x")
+    assert s.shape == (3, 4) and s.dtype == np.float32 and s.name == "x"
+
+  def test_replace_and_from_spec(self):
+    s = TensorSpec(shape=(2,), dtype=np.float32, is_optional=True)
+    s2 = TensorSpec.from_spec(s, dtype=np.int32)
+    assert s2.is_optional and s2.dtype == np.int32
+
+  def test_batch_manipulation(self):
+    s = TensorSpec(shape=(5,))
+    assert s.with_batch(8).shape == (8, 5)
+    assert s.with_batch().shape == (None, 5)
+    assert s.with_batch(8).without_batch().shape == (5,)
+
+  def test_compatibility(self):
+    s = TensorSpec(shape=(None, 3), dtype=np.float32)
+    assert s.is_compatible_with(np.zeros((7, 3), np.float32))
+    assert not s.is_compatible_with(np.zeros((7, 4), np.float32))
+    assert not s.is_compatible_with(np.zeros((7, 3), np.int32))
+    assert s.is_compatible_with(np.zeros((2, 7, 3), np.float32),
+                                ignore_batch=True)
+
+  def test_compatible_with_jax_array(self):
+    s = TensorSpec(shape=(4,), dtype=np.float32)
+    assert s.is_compatible_with(jnp.zeros((4,), jnp.float32))
+
+  def test_serialization_roundtrip(self):
+    s = TensorSpec(shape=(None, 64, 64, 3), dtype=np.uint8, name="img",
+                   is_optional=True, is_sequence=True, data_format="png",
+                   dataset_key="d2", varlen_default_value=0.0,
+                   sharding=("data", None, None, None))
+    s2 = TensorSpec.from_dict(json.loads(json.dumps(s.to_dict())))
+    assert s == s2
+
+  def test_partition_spec(self):
+    s = TensorSpec(shape=(8, 4), sharding=("data", "model"))
+    assert s.partition_spec() == jax.sharding.PartitionSpec("data", "model")
+    assert TensorSpec(shape=(2,)).partition_spec() == (
+        jax.sharding.PartitionSpec())
+
+
+class TestSpecStruct:
+
+  def _make(self):
+    s = SpecStruct()
+    s["train/images"] = TensorSpec(shape=(64, 64, 3), dtype=np.uint8)
+    s["train/actions"] = TensorSpec(shape=(7,))
+    s["val/images"] = TensorSpec(shape=(64, 64, 3), dtype=np.uint8)
+    return s
+
+  def test_flat_and_hierarchical_access(self):
+    s = self._make()
+    assert s["train/images"] is s.train.images
+    assert s["train"]["images"] is s["train/images"]
+    assert set(s.train.keys()) == {"images", "actions"}
+
+  def test_dot_normalization(self):
+    s = self._make()
+    assert s["train.images"] is s["train/images"]
+
+  def test_views_are_live(self):
+    s = self._make()
+    view = s.train
+    view["rewards"] = TensorSpec(shape=())
+    assert "train/rewards" in s
+    s["train/done"] = TensorSpec(shape=(), dtype=np.bool_)
+    assert "done" in view
+
+  def test_attribute_set(self):
+    s = SpecStruct()
+    s.a = TensorSpec(shape=(1,))
+    s.b = {"c": TensorSpec(shape=(2,))}
+    assert s["a"].shape == (1,)
+    assert s["b/c"].shape == (2,)
+
+  def test_nested_dict_construction(self):
+    s = SpecStruct({"a": {"b": 1, "c": {"d": 2}}, "e": 3})
+    assert list(s.keys()) == ["a/b", "a/c/d", "e"]
+    assert s.a.c.d == 2
+
+  def test_leaf_vs_node_conflict(self):
+    s = self._make()
+    with pytest.raises(KeyError):
+      s["train"] = TensorSpec(shape=())  # train is an intermediate node
+
+  def test_subtree_replacement(self):
+    s = self._make()
+    s["train"] = {"only": TensorSpec(shape=())}
+    assert list(s.train.keys()) == ["only"]
+
+  def test_delete_leaf_and_subtree(self):
+    s = self._make()
+    del s["train/images"]
+    assert "train/images" not in s
+    del s["train"]
+    assert "train" not in s
+    assert "val/images" in s
+
+  def test_to_dict(self):
+    s = self._make()
+    d = s.to_dict()
+    assert set(d.keys()) == {"train", "val"}
+    assert set(d["train"].keys()) == {"images", "actions"}
+
+  def test_equality(self):
+    a = SpecStruct({"x": 1, "y": {"z": 2}})
+    b = SpecStruct({"x": 1, "y/z": 2})
+    assert a == b
+    assert a == {"x": 1, "y": {"z": 2}}
+
+  def test_copy_shares_leaves_not_structure(self):
+    s = self._make()
+    c = s.copy()
+    c["extra"] = TensorSpec(shape=())
+    assert "extra" not in s
+
+  def test_pytree_registration(self):
+    s = SpecStruct({"a": jnp.ones((2,)), "b": {"c": jnp.zeros((3,))}})
+    leaves = jax.tree_util.tree_leaves(s)
+    assert len(leaves) == 2
+    doubled = jax.tree_util.tree_map(lambda x: x * 2, s)
+    assert isinstance(doubled, SpecStruct)
+    np.testing.assert_allclose(doubled["a"], 2.0)
+
+  def test_equality_with_arrays(self):
+    a = SpecStruct({"x": np.ones((3,)), "y": 1})
+    b = SpecStruct({"x": np.ones((3,)), "y": 1})
+    c = SpecStruct({"x": np.zeros((3,)), "y": 1})
+    assert a == b
+    assert a != c
+
+  def test_pytree_preserves_insertion_order(self):
+    s = SpecStruct({"z": jnp.ones(()), "a": jnp.zeros(())})
+    mapped = jax.tree_util.tree_map(lambda x: x, s)
+    assert list(mapped.keys()) == ["z", "a"]
+
+  def test_leaf_ancestor_guard(self):
+    s = SpecStruct({"a": 1})
+    with pytest.raises(KeyError, match="ancestor"):
+      s["a/b"] = 2
+
+  def test_empty_mapping_assignment_raises(self):
+    s = SpecStruct({"a": {"b": 1}})
+    with pytest.raises(ValueError, match="empty mapping"):
+      s["a"] = {}
+
+  def test_pytree_through_jit(self):
+    s = SpecStruct({"x": jnp.ones((4,)), "nested": {"y": jnp.ones((2,))}})
+
+    @jax.jit
+    def f(batch):
+      return batch["x"].sum() + batch.nested.y.sum()
+
+    assert float(f(s)) == 6.0
+
+
+class TestSpecAlgebra:
+
+  def _spec(self):
+    return SpecStruct({
+        "images": TensorSpec(shape=(4, 4, 3), dtype=np.float32),
+        "aux/pose": TensorSpec(shape=(7,), dtype=np.float32),
+        "aux/opt": TensorSpec(shape=(2,), dtype=np.float32,
+                              is_optional=True),
+    })
+
+  def test_flatten(self):
+    flat = specs.flatten_spec_structure(
+        {"a": {"b": TensorSpec(shape=())}, "c": TensorSpec(shape=(1,))})
+    assert set(flat.keys()) == {"a/b", "c"}
+
+  def test_pack_drops_extra_and_optionals(self):
+    spec = self._spec()
+    values = {
+        "images": np.zeros((4, 4, 3), np.float32),
+        "aux/pose": np.zeros((7,), np.float32),
+        "unrelated": np.zeros((1,)),
+    }
+    packed = specs.pack_flat_sequence_to_spec_structure(spec, values)
+    assert set(packed.keys()) == {"images", "aux/pose"}
+
+  def test_pack_missing_required_raises(self):
+    with pytest.raises(ValueError, match="Required spec"):
+      specs.pack_flat_sequence_to_spec_structure(
+          self._spec(), {"images": np.zeros((4, 4, 3), np.float32)})
+
+  def test_validate_ok_and_failures(self):
+    spec = self._spec()
+    good = specs.make_random_numpy(spec)
+    specs.validate(spec, good)
+    bad = dict(good.items())
+    bad["images"] = np.zeros((4, 4, 4), np.float32)
+    with pytest.raises(ValueError, match="incompatible"):
+      specs.validate(spec, bad)
+
+  def test_validate_ignore_batch(self):
+    spec = self._spec()
+    batched = specs.make_random_numpy(spec, batch_size=5)
+    specs.validate(spec, batched, ignore_batch=True)
+    with pytest.raises(ValueError):
+      specs.validate(spec, batched, ignore_batch=False)
+
+  def test_validate_and_pack(self):
+    spec = self._spec()
+    values = specs.make_random_numpy(spec)
+    packed = specs.validate_and_pack(spec, values)
+    assert set(packed.keys()) == {"images", "aux/pose"}
+
+  def test_assert_equal(self):
+    specs.assert_equal(self._spec(), self._spec())
+    other = self._spec()
+    other["images"] = TensorSpec(shape=(4, 4, 1), dtype=np.float32)
+    with pytest.raises(ValueError):
+      specs.assert_equal(self._spec(), other)
+
+  def test_assert_required(self):
+    full = self._spec()
+    required_only = specs.filter_required(full)
+    specs.assert_required(full, required_only)
+    with pytest.raises(ValueError):
+      specs.assert_required(full, SpecStruct(
+          {"images": full["images"]}))
+
+  def test_copy_specs_prefix_and_batch(self):
+    out = specs.copy_specs(self._spec(), prefix="cond", batch_size=8)
+    assert "cond/images" in out
+    assert out["cond/images"].shape == (8, 4, 4, 3)
+    unbatched = specs.copy_specs(self._spec(), batch_size=-1)
+    assert unbatched["images"].shape == (None, 4, 4, 3)
+
+  def test_filter_required(self):
+    filtered = specs.filter_required(self._spec())
+    assert "aux/opt" not in filtered
+    assert "images" in filtered
+
+  def test_filter_by_dataset(self):
+    spec = SpecStruct({
+        "a": TensorSpec(shape=(1,), dataset_key="d1"),
+        "b": TensorSpec(shape=(1,), dataset_key="d2"),
+    })
+    assert set(specs.filter_by_dataset(spec, "d1").keys()) == {"a"}
+    assert specs.dataset_keys(spec) == ("d1", "d2")
+
+  def test_add_sequence_length_specs(self):
+    spec = SpecStruct({
+        "seq": TensorSpec(shape=(None, 3), is_sequence=True),
+        "static": TensorSpec(shape=(2,)),
+    })
+    out = specs.add_sequence_length_specs(spec)
+    assert "seq_length" in out
+    assert out["seq_length"].dtype == np.int64
+    assert "static_length" not in out
+
+  def test_replace_dtype(self):
+    out = specs.replace_dtype(self._spec(), np.float32, "bfloat16")
+    import ml_dtypes
+    assert out["images"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+  def test_bfloat16_casts_roundtrip(self):
+    data = SpecStruct({"x": np.ones((3,), np.float32),
+                       "i": np.ones((3,), np.int32)})
+    bf = specs.cast_float32_to_bfloat16(data)
+    import ml_dtypes
+    assert bf["x"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert bf["i"].dtype == np.int32
+    back = specs.cast_bfloat16_to_float32(bf)
+    assert back["x"].dtype == np.float32
+
+
+class TestGenerators:
+
+  def _spec(self):
+    return SpecStruct({
+        "image": TensorSpec(shape=(8, 8, 3), dtype=np.uint8,
+                            data_format="jpeg"),
+        "action": TensorSpec(shape=(2,), dtype=np.float32),
+        "step": TensorSpec(shape=(), dtype=np.int64),
+        "flag": TensorSpec(shape=(), dtype=np.bool_),
+        "opt": TensorSpec(shape=(3,), is_optional=True),
+    })
+
+  def test_make_random_numpy(self):
+    data = specs.make_random_numpy(self._spec(), batch_size=4, seed=0)
+    assert data["image"].shape == (4, 8, 8, 3)
+    assert data["image"].dtype == np.uint8
+    assert data["action"].shape == (4, 2)
+    assert data["step"].dtype == np.int64
+    assert data["flag"].dtype == np.bool_
+    assert "opt" not in data  # optional specs skipped
+
+  def test_make_random_numpy_deterministic(self):
+    a = specs.make_random_numpy(self._spec(), batch_size=2, seed=7)
+    b = specs.make_random_numpy(self._spec(), batch_size=2, seed=7)
+    np.testing.assert_array_equal(a["action"], b["action"])
+
+  def test_make_constant_numpy(self):
+    data = specs.make_constant_numpy(self._spec(), 3, batch_size=2)
+    np.testing.assert_array_equal(data["action"], 3.0)
+
+  def test_unknown_dims_use_sequence_length(self):
+    spec = SpecStruct({"s": TensorSpec(shape=(None, 2), is_sequence=True)})
+    data = specs.make_random_numpy(spec, batch_size=2, sequence_length=5)
+    assert data["s"].shape == (2, 5, 2)
+
+  def test_shape_dtype_struct(self):
+    tree = specs.shape_dtype_struct(self._spec(), batch_size=16)
+    assert tree["image"].shape == (16, 8, 8, 3)
+    assert tree["action"].dtype == np.float32
+    assert "opt" not in tree
+
+
+class TestSharding:
+
+  def test_partition_specs_default_dp(self):
+    spec = SpecStruct({"x": TensorSpec(shape=(4,)),
+                       "y": TensorSpec(shape=(2, 2), sharding=(None, "model"))})
+    ps = specs.partition_specs(spec)
+    assert ps["x"] == jax.sharding.PartitionSpec("data")
+    # Annotations are over the unbatched shape; batch axis is prepended.
+    assert ps["y"] == jax.sharding.PartitionSpec("data", None, "model")
+
+  def test_with_batch_shifts_sharding(self):
+    s = TensorSpec(shape=(4,), sharding=("model",))
+    batched = s.with_batch(8)
+    assert batched.sharding == (None, "model")
+    assert batched.without_batch().sharding == ("model",)
+
+
+class TestAssets:
+
+  def test_roundtrip(self, tmp_path):
+    feature_spec = SpecStruct({
+        "img": TensorSpec(shape=(32, 32, 3), dtype=np.uint8,
+                          data_format="jpeg", name="image/encoded"),
+    })
+    label_spec = SpecStruct({"y": TensorSpec(shape=(1,))})
+    assets = specs.Assets(feature_spec=feature_spec, label_spec=label_spec,
+                          global_step=1234, extra={"model": "mock"})
+    path = str(tmp_path / "export" / specs.ASSET_FILENAME)
+    specs.write_assets(assets, path)
+    loaded = specs.load_assets(path)
+    specs.assert_equal(loaded.feature_spec, feature_spec)
+    specs.assert_equal(loaded.label_spec, label_spec)
+    assert loaded.global_step == 1234
+    assert loaded.feature_spec["img"].name == "image/encoded"
+    assert loaded.extra == {"model": "mock"}
