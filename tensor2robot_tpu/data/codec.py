@@ -1,0 +1,131 @@
+"""Encoding numpy data to tf.Example-format records, driven by specs.
+
+Writer-side counterpart of the parser: used by replay writers, test-fixture
+generation, and export receivers. Mirrors the serialization conventions the
+reference relies on from tf.train.Example (float_list/int64_list/bytes_list,
+JPEG/PNG-encoded image bytes, SequenceExample feature_lists).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.data import example_pb2
+from tensor2robot_tpu import specs as specs_lib
+
+__all__ = ["encode_image", "decode_image", "set_feature", "encode_example",
+           "encode_sequence_example"]
+
+
+def encode_image(array: np.ndarray, data_format: str = "jpeg") -> bytes:
+  """Encodes an HWC uint8 array to compressed image bytes via PIL."""
+  from PIL import Image
+
+  array = np.asarray(array)
+  if array.ndim == 3 and array.shape[-1] == 1:
+    array = array[..., 0]
+  img = Image.fromarray(array)
+  buf = io.BytesIO()
+  fmt = {"jpg": "JPEG", "jpeg": "JPEG", "png": "PNG", "bmp": "BMP",
+         "gif": "GIF"}[data_format.lower()]
+  img.save(buf, format=fmt)
+  return buf.getvalue()
+
+
+def decode_image(data: bytes, channels: Optional[int] = None) -> np.ndarray:
+  """Decodes image bytes to an HWC uint8 array (reference
+  /root/reference/utils/tfdata.py:426-484 uses tf.image.decode_image)."""
+  from PIL import Image
+
+  img = Image.open(io.BytesIO(data))
+  if channels == 3 and img.mode != "RGB":
+    img = img.convert("RGB")
+  elif channels == 1 and img.mode != "L":
+    img = img.convert("L")
+  array = np.asarray(img)
+  if array.ndim == 2:
+    array = array[..., None]
+  return array
+
+
+def set_feature(feature: "example_pb2.Feature", value: Any,
+                spec: Optional[specs_lib.TensorSpec] = None) -> None:
+  """Fills one Feature message from a numpy value according to its spec."""
+  if spec is not None and spec.is_image:
+    if isinstance(value, bytes):
+      feature.bytes_list.value.append(value)
+    else:
+      feature.bytes_list.value.append(
+          encode_image(np.asarray(value), spec.data_format))
+    return
+  if isinstance(value, bytes):
+    feature.bytes_list.value.append(value)
+    return
+  if isinstance(value, str):
+    feature.bytes_list.value.append(value.encode("utf-8"))
+    return
+  array = np.asarray(value)
+  if array.dtype.kind in "SU":
+    for item in array.ravel():
+      data = item if isinstance(item, bytes) else str(item).encode("utf-8")
+      feature.bytes_list.value.append(data)
+  elif array.dtype.kind in "iub":
+    feature.int64_list.value.extend(int(v) for v in array.ravel())
+  else:
+    feature.float_list.value.extend(float(v) for v in array.ravel())
+
+
+def encode_example(values: Mapping[str, Any],
+                   spec_structure: Optional[specs_lib.SpecStructLike] = None
+                   ) -> bytes:
+  """Serializes a flat dict of values to tf.Example wire bytes.
+
+  Feature keys use `spec.name` when set, else the flat path key — the same
+  name-vs-key duality the reference parser honors
+  (/root/reference/utils/tfdata.py:515-541).
+  """
+  flat_specs = None
+  if spec_structure is not None:
+    flat_specs = specs_lib.flatten_spec_structure(spec_structure)
+  example = example_pb2.Example()
+  flat_values = specs_lib.flatten_spec_structure(dict(values))
+  for key, value in flat_values.items():
+    spec = flat_specs[key] if flat_specs is not None and key in flat_specs \
+        else None
+    name = (spec.name if spec is not None and spec.name else key)
+    set_feature(example.features.feature[name], value, spec)
+  return example.SerializeToString()
+
+
+def encode_sequence_example(
+    context: Mapping[str, Any],
+    sequences: Mapping[str, Any],
+    spec_structure: Optional[specs_lib.SpecStructLike] = None) -> bytes:
+  """Serializes context + per-step sequence values to SequenceExample bytes.
+
+  `sequences` values must have a leading time dimension.
+  """
+  flat_specs = None
+  if spec_structure is not None:
+    flat_specs = specs_lib.flatten_spec_structure(spec_structure)
+
+  def _spec_for(key):
+    if flat_specs is not None and key in flat_specs:
+      return flat_specs[key]
+    return None
+
+  example = example_pb2.SequenceExample()
+  for key, value in specs_lib.flatten_spec_structure(dict(context)).items():
+    spec = _spec_for(key)
+    name = spec.name if spec is not None and spec.name else key
+    set_feature(example.context.feature[name], value, spec)
+  for key, value in specs_lib.flatten_spec_structure(dict(sequences)).items():
+    spec = _spec_for(key)
+    name = spec.name if spec is not None and spec.name else key
+    feature_list = example.feature_lists.feature_list[name]
+    for step_value in value:
+      set_feature(feature_list.feature.add(), step_value, spec)
+  return example.SerializeToString()

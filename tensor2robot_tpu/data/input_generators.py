@@ -1,0 +1,343 @@
+"""Input generators: spec-filled batch sources for the train/eval loop.
+
+Re-designs the reference's `input_generators/` package
+(/root/reference/input_generators/abstract_input_generator.py:34-204,
+default_input_generator.py:47-314). An input generator holds feature/label
+specs plus a preprocess function — both injected from the model via
+`set_specification_from_model` — and produces an iterator of batches
+(SpecStructs of numpy arrays) for a mode. The trainer shards those batches
+onto the device mesh.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import os
+from typing import Any, Callable, Iterator, Mapping, Optional, Sequence, Union
+
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data import parsing, pipeline
+from tensor2robot_tpu.utils import config
+
+__all__ = [
+    "AbstractInputGenerator",
+    "DefaultRecordInputGenerator",
+    "FractionalRecordInputGenerator",
+    "MultiEvalRecordInputGenerator",
+    "GeneratorInputGenerator",
+    "DefaultRandomInputGenerator",
+    "DefaultConstantInputGenerator",
+    "WeightedRecordInputGenerator",
+]
+
+
+class AbstractInputGenerator(abc.ABC):
+  """Holds specs + preprocess_fn; produces batch iterators per mode.
+
+  Reference contract (/root/reference/input_generators/
+  abstract_input_generator.py:76-160): specs are *not* constructor inputs —
+  they are injected from the model (via its preprocessor) so the input
+  pipeline always matches what the model consumes.
+  """
+
+  def __init__(self, batch_size: int = 32):
+    self._batch_size = batch_size
+    self._feature_spec: Optional[specs_lib.SpecStruct] = None
+    self._label_spec: Optional[specs_lib.SpecStruct] = None
+    self._preprocess_fn = None
+
+  @property
+  def batch_size(self) -> int:
+    return self._batch_size
+
+  @batch_size.setter
+  def batch_size(self, value: int) -> None:
+    self._batch_size = value
+
+  @property
+  def feature_spec(self) -> Optional[specs_lib.SpecStruct]:
+    return self._feature_spec
+
+  @property
+  def label_spec(self) -> Optional[specs_lib.SpecStruct]:
+    return self._label_spec
+
+  def set_specification(self,
+                        feature_spec: specs_lib.SpecStructLike,
+                        label_spec: Optional[specs_lib.SpecStructLike] = None
+                        ) -> None:
+    self._feature_spec = specs_lib.flatten_spec_structure(feature_spec)
+    self._label_spec = (specs_lib.flatten_spec_structure(label_spec)
+                        if label_spec is not None else None)
+
+  def set_specification_from_model(self, model, mode: str) -> None:
+    """Pulls the preprocessor's *in* specs and preprocess fn from a model
+    (reference :76-98: spec flow model -> preprocessor -> input)."""
+    preprocessor = model.preprocessor
+    self.set_specification(
+        preprocessor.get_in_feature_specification(mode),
+        preprocessor.get_in_label_specification(mode))
+    self._preprocess_fn = preprocessor.preprocess
+
+  def set_preprocess_fn(self, preprocess_fn) -> None:
+    self._preprocess_fn = preprocess_fn
+
+  def _assert_specs_initialized(self) -> None:
+    if self._feature_spec is None:
+      raise ValueError(
+          "Input generator specs not set. Call set_specification_from_model "
+          "or set_specification first.")
+
+  @abc.abstractmethod
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    """Returns an iterator over `{features: ..., labels: ...}` batches."""
+
+  def __call__(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    return self.create_dataset(modes_lib.validate(mode))
+
+
+@config.configurable
+class DefaultRecordInputGenerator(AbstractInputGenerator):
+  """TFRecord-file-backed generator (reference :47-101)."""
+
+  def __init__(self,
+               file_patterns: Union[str, Sequence[str], Mapping[str, Any],
+                                    None] = None,
+               batch_size: int = 32,
+               shuffle_buffer_size: int = 512,
+               prefetch_size: int = 2,
+               seed: Optional[int] = None,
+               process_index: Optional[int] = None,
+               process_count: Optional[int] = None):
+    super().__init__(batch_size=batch_size)
+    if not file_patterns:
+      raise ValueError("file_patterns must be provided.")
+    self._file_patterns = file_patterns
+    self._shuffle_buffer_size = shuffle_buffer_size
+    self._prefetch_size = prefetch_size
+    self._seed = seed
+    # Host-sharding info is injected by the trainer (which owns the JAX
+    # runtime); defaults are single-host. Querying jax.process_index() here
+    # would force backend initialization from the data layer.
+    self._process_index = process_index
+    self._process_count = process_count
+
+  def set_process_info(self, process_index: int, process_count: int) -> None:
+    self._process_index = process_index
+    self._process_count = process_count
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    self._assert_specs_initialized()
+    parse_fn = parsing.create_parse_fn(self._feature_spec, self._label_spec)
+    return iter(pipeline.RecordBatchPipeline(
+        self._file_patterns,
+        parse_fn,
+        batch_size=self._batch_size,
+        mode=mode,
+        shuffle_buffer_size=self._shuffle_buffer_size,
+        prefetch_size=self._prefetch_size,
+        seed=self._seed,
+        preprocess_fn=self._preprocess_fn,
+        process_index=self._process_index or 0,
+        process_count=self._process_count or 1))
+
+
+@config.configurable
+class FractionalRecordInputGenerator(DefaultRecordInputGenerator):
+  """Uses only a fraction of the matched files — data-ablation experiments
+  (reference :104-124)."""
+
+  def __init__(self, file_fraction: float = 1.0, **kwargs):
+    super().__init__(**kwargs)
+    if not 0.0 < file_fraction <= 1.0:
+      raise ValueError(f"file_fraction must be in (0, 1], got {file_fraction}")
+    self._file_fraction = file_fraction
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    if self._file_fraction < 1.0:
+      files = pipeline.resolve_file_patterns(self._file_patterns)
+      n = max(1, int(self._file_fraction * len(files)))
+      self._file_patterns = files[:n]
+    return super().create_dataset(mode)
+
+
+@config.configurable
+class MultiEvalRecordInputGenerator(DefaultRecordInputGenerator):
+  """Selects its dataset by eval-job name from the cluster env
+  (reference :127-140 reads TF_CONFIG['multi_eval_name'])."""
+
+  def __init__(self,
+               eval_dataset_map: Optional[Mapping[str, Any]] = None,
+               **kwargs):
+    if not eval_dataset_map:
+      raise ValueError("eval_dataset_map must be provided.")
+    eval_name = multi_eval_name()
+    if eval_name not in eval_dataset_map:
+      raise ValueError(
+          f"Eval job {eval_name!r} not in eval_dataset_map "
+          f"{sorted(eval_dataset_map)}.")
+    super().__init__(file_patterns=eval_dataset_map[eval_name], **kwargs)
+
+
+def multi_eval_name(default: str = "eval") -> str:
+  """Reads the eval-job name from T2R_CLUSTER (JSON) or TF_CONFIG-style env
+  (reference /root/reference/input_generators/default_input_generator.py:
+  36-44)."""
+  for var in ("T2R_CLUSTER", "TF_CONFIG"):
+    raw = os.environ.get(var)
+    if raw:
+      try:
+        return json.loads(raw).get("multi_eval_name", default)
+      except (ValueError, AttributeError):
+        continue
+  return default
+
+
+@config.configurable
+class GeneratorInputGenerator(AbstractInputGenerator):
+  """Backed by a python generator yielding (features, labels) numpy dicts
+  (reference :143-193)."""
+
+  def __init__(self, generator_fn: Optional[Callable] = None,
+               batch_size: int = 32):
+    super().__init__(batch_size=batch_size)
+    if generator_fn is None:
+      raise ValueError("generator_fn must be provided.")
+    self._generator_fn = generator_fn
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    self._assert_specs_initialized()
+
+    def _iterate():
+      gen = self._generator_fn(mode)
+      while True:
+        columns_f, columns_l = [], []
+        for _ in range(self._batch_size):
+          try:
+            features, labels = next(gen)
+          except StopIteration:
+            return
+          columns_f.append(specs_lib.flatten_spec_structure(features))
+          columns_l.append(specs_lib.flatten_spec_structure(labels))
+        out = specs_lib.SpecStruct()
+        for key in columns_f[0]:
+          out["features/" + key] = np.stack([c[key] for c in columns_f])
+        for key in columns_l[0]:
+          out["labels/" + key] = np.stack([c[key] for c in columns_l])
+        yield self._apply_preprocess(out, mode)
+
+    return _iterate()
+
+  def _apply_preprocess(self, batch, mode):
+    if self._preprocess_fn is None:
+      return batch
+    features, labels = self._preprocess_fn(
+        batch["features"], batch["labels"] if "labels" in batch else
+        specs_lib.SpecStruct(), mode)
+    out = specs_lib.SpecStruct()
+    out["features"] = specs_lib.flatten_spec_structure(features)
+    if labels is not None and len(labels):
+      out["labels"] = specs_lib.flatten_spec_structure(labels)
+    return out
+
+
+@config.configurable
+class DefaultRandomInputGenerator(AbstractInputGenerator):
+  """Random data matching the specs — smoke tests & benchmarks
+  (reference :196-206)."""
+
+  def __init__(self, batch_size: int = 32, sequence_length: int = 3,
+               seed: int = 0):
+    super().__init__(batch_size=batch_size)
+    self._sequence_length = sequence_length
+    self._seed = seed
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    self._assert_specs_initialized()
+
+    def _iterate():
+      step = 0
+      while True:
+        out = specs_lib.SpecStruct()
+        out["features"] = specs_lib.make_random_numpy(
+            self._feature_spec, batch_size=self._batch_size,
+            sequence_length=self._sequence_length, seed=self._seed + step)
+        if self._label_spec is not None and len(self._label_spec):
+          out["labels"] = specs_lib.make_random_numpy(
+              self._label_spec, batch_size=self._batch_size,
+              sequence_length=self._sequence_length,
+              seed=self._seed + step + 10_000_019)
+        step += 1
+        if self._preprocess_fn is not None:
+          features, labels = self._preprocess_fn(
+              out["features"],
+              out["labels"] if "labels" in out else specs_lib.SpecStruct(),
+              mode)
+          out = specs_lib.SpecStruct()
+          out["features"] = features
+          if labels is not None and len(labels):
+            out["labels"] = labels
+        yield out
+
+    return _iterate()
+
+
+@config.configurable
+class DefaultConstantInputGenerator(AbstractInputGenerator):
+  """Constant data matching the specs (reference :209-225)."""
+
+  def __init__(self, constant_value: float = 1.0, batch_size: int = 32,
+               sequence_length: int = 3):
+    super().__init__(batch_size=batch_size)
+    self._constant_value = constant_value
+    self._sequence_length = sequence_length
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    self._assert_specs_initialized()
+
+    def _iterate():
+      while True:
+        out = specs_lib.SpecStruct()
+        out["features"] = specs_lib.make_constant_numpy(
+            self._feature_spec, self._constant_value, self._batch_size,
+            self._sequence_length)
+        if self._label_spec is not None and len(self._label_spec):
+          out["labels"] = specs_lib.make_constant_numpy(
+              self._label_spec, self._constant_value, self._batch_size,
+              self._sequence_length)
+        yield out
+
+    return _iterate()
+
+
+@config.configurable
+class WeightedRecordInputGenerator(AbstractInputGenerator):
+  """Weighted mixture over file-pattern groups (reference :228-314)."""
+
+  def __init__(self,
+               file_pattern_groups: Optional[Sequence[Any]] = None,
+               weights: Optional[Sequence[float]] = None,
+               batch_size: int = 32,
+               seed: Optional[int] = None,
+               shuffle_buffer_size: int = 512):
+    super().__init__(batch_size=batch_size)
+    if not file_pattern_groups:
+      raise ValueError("file_pattern_groups must be provided.")
+    self._groups = file_pattern_groups
+    self._weights = weights or [1.0 / len(file_pattern_groups)] * len(
+        file_pattern_groups)
+    self._seed = seed
+    self._shuffle_buffer_size = shuffle_buffer_size
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    self._assert_specs_initialized()
+    parse_fn = parsing.create_parse_fn(self._feature_spec, self._label_spec)
+    return iter(pipeline.WeightedRecordPipeline(
+        self._groups, self._weights, parse_fn,
+        batch_size=self._batch_size, mode=mode, seed=self._seed,
+        shuffle_buffer_size=self._shuffle_buffer_size,
+        preprocess_fn=self._preprocess_fn))

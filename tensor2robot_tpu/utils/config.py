@@ -1,0 +1,481 @@
+"""Gin-style dependency-injection configuration.
+
+The reference configures everything through gin: every class/factory is
+`@gin.configurable` and experiments are `.gin` files driven by thin CLIs
+(/root/reference/bin/run_t2r_trainer.py:28-31,
+/root/reference/utils/train_eval.py:48-58). gin-config is not available in
+this environment, so this module provides a compatible engine with the
+subset the framework needs:
+
+* `@configurable` decorator and `external_configurable` for third-party
+  callables;
+* config files / binding strings with `Name.param = value`,
+  `scope/Name.param = value`, `@Name` / `@Name()` configurable references,
+  `%MACRO` macros, `include 'other.gin'`, and `import a.b.c`;
+* scoping via `with config_scope('train'): ...`;
+* an operative-config dump recording every parameter actually used, saved
+  alongside checkpoints for reproducibility (reference
+  `GinConfigSaverHook`, /root/reference/models/abstract_model.py:772-775).
+
+One deliberate divergence from gin (SURVEY.md §7 "gin over JAX"): bindings
+are resolved *eagerly at call time, outside traced functions* — a
+configurable is an ordinary Python callable once invoked, so configs can
+never leak into `jit` tracing or cause retraces.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import functools
+import importlib
+import inspect
+import os
+import re
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "configurable",
+    "external_configurable",
+    "bind",
+    "parse_config",
+    "parse_config_files_and_bindings",
+    "config_scope",
+    "clear_config",
+    "operative_config_str",
+    "query_parameter",
+    "get_configurable",
+    "REQUIRED",
+    "ConfigError",
+]
+
+
+class ConfigError(Exception):
+  pass
+
+
+class _Required:
+  """Sentinel for parameters that must be provided via config (gin.REQUIRED)."""
+
+  def __repr__(self):
+    return "REQUIRED"
+
+
+REQUIRED = _Required()
+
+
+class _ConfigurableReference:
+  """`@Name` (pass the callable) or `@Name()` (call it at injection time)."""
+
+  def __init__(self, name: str, evaluate: bool):
+    self.name = name
+    self.evaluate = evaluate
+
+  def resolve(self) -> Any:
+    fn = get_configurable(self.name)
+    return fn() if self.evaluate else fn
+
+  def __repr__(self):
+    return f"@{self.name}" + ("()" if self.evaluate else "")
+
+  def __eq__(self, other):
+    return (isinstance(other, _ConfigurableReference)
+            and (self.name, self.evaluate) == (other.name, other.evaluate))
+
+
+class _MacroReference:
+  def __init__(self, name: str):
+    self.name = name
+
+  def __repr__(self):
+    return f"%{self.name}"
+
+  def __eq__(self, other):
+    return isinstance(other, _MacroReference) and self.name == other.name
+
+
+class _Registry:
+  def __init__(self):
+    self.configurables: Dict[str, Callable] = {}
+    # (scope, configurable_name, param) -> raw value
+    self.bindings: Dict[Tuple[str, str, str], Any] = {}
+    self.macros: Dict[str, Any] = {}
+    self.operative: Dict[Tuple[str, str], Any] = {}
+    self.imports: List[str] = []
+
+
+_REGISTRY = _Registry()
+_SCOPE = threading.local()
+
+
+def _scope_stack() -> List[str]:
+  if not hasattr(_SCOPE, "stack"):
+    _SCOPE.stack = []
+  return _SCOPE.stack
+
+
+@contextlib.contextmanager
+def config_scope(name: str):
+  """Activates a gin-style scope: bindings `name/Conf.param` take priority."""
+  if not name:
+    yield
+    return
+  _scope_stack().append(name)
+  try:
+    yield
+  finally:
+    _scope_stack().pop()
+
+
+def clear_config() -> None:
+  _REGISTRY.bindings.clear()
+  _REGISTRY.macros.clear()
+  _REGISTRY.operative.clear()
+  _SCOPE.stack = []
+
+
+def _register(name: str, wrapped: Callable, allow_override: bool = False):
+  if name in _REGISTRY.configurables and not allow_override:
+    existing = _REGISTRY.configurables[name]
+    if getattr(existing, "__wrapped__", existing) is not getattr(
+        wrapped, "__wrapped__", wrapped):
+      raise ConfigError(f"Configurable {name!r} already registered.")
+  _REGISTRY.configurables[name] = wrapped
+
+
+def get_configurable(name: str) -> Callable:
+  """Looks up a registered configurable, also matching by trailing path."""
+  if name in _REGISTRY.configurables:
+    return _REGISTRY.configurables[name]
+  # Allow module-qualified lookups: 'pkg.mod.Name' matches registered 'Name'
+  # and vice versa.
+  short = name.rsplit(".", 1)[-1]
+  if short in _REGISTRY.configurables:
+    return _REGISTRY.configurables[short]
+  matches = [k for k in _REGISTRY.configurables if k.rsplit(".", 1)[-1] == name]
+  if len(matches) == 1:
+    return _REGISTRY.configurables[matches[0]]
+  raise ConfigError(
+      f"No configurable named {name!r}. Registered: "
+      f"{sorted(_REGISTRY.configurables)}")
+
+
+def _resolve_value(value: Any) -> Any:
+  if isinstance(value, _ConfigurableReference):
+    return value.resolve()
+  if isinstance(value, _MacroReference):
+    if value.name not in _REGISTRY.macros:
+      raise ConfigError(f"Undefined macro %{value.name}")
+    return _resolve_value(_REGISTRY.macros[value.name])
+  if isinstance(value, list):
+    return [_resolve_value(v) for v in value]
+  if isinstance(value, tuple):
+    return tuple(_resolve_value(v) for v in value)
+  if isinstance(value, dict):
+    return {k: _resolve_value(v) for k, v in value.items()}
+  return value
+
+
+def _lookup_bindings(name: str) -> Dict[str, Any]:
+  """Collects bindings for `name` honoring the active scope stack.
+
+  Unscoped bindings apply everywhere; scoped bindings apply when their scope
+  is in the active stack, innermost scope winning.
+  """
+  out: Dict[str, Any] = {}
+  for (scope, conf, param), value in _REGISTRY.bindings.items():
+    if conf != name:
+      continue
+    if scope == "":
+      out.setdefault(param, value)
+  stack = _scope_stack()
+  for active in stack:  # outermost → innermost so innermost wins
+    for (scope, conf, param), value in _REGISTRY.bindings.items():
+      if conf == name and scope == active:
+        out[param] = value
+  return out
+
+
+def configurable(fn_or_name=None, *, name: Optional[str] = None,
+                 denylist: Sequence[str] = ()):
+  """Registers a function/class; config bindings are injected at call time."""
+
+  def decorate(fn: Callable) -> Callable:
+    if inspect.isclass(fn):
+      return _decorate_class(fn, name or fn.__name__, denylist)
+    reg_name = name or fn.__name__
+    try:
+      sig = inspect.signature(fn)
+      has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                       for p in sig.parameters.values())
+      param_names = set(sig.parameters)
+    except (TypeError, ValueError):
+      sig, has_var_kw, param_names = None, True, set()
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+      bindings = _lookup_bindings(reg_name)
+      bound_positional = set()
+      if sig is not None and args:
+        for arg_name, _ in zip(sig.parameters, args):
+          bound_positional.add(arg_name)
+      injected = {}
+      for param, raw in bindings.items():
+        if param in denylist:
+          raise ConfigError(
+              f"Parameter {param!r} of {reg_name!r} may not be configured.")
+        if not has_var_kw and param not in param_names:
+          raise ConfigError(
+              f"Configurable {reg_name!r} has no parameter {param!r}.")
+        if param in kwargs or param in bound_positional:
+          continue  # explicit call-site args win over config
+        injected[param] = _resolve_value(raw)
+      merged = {**injected, **kwargs}
+      for param, value in merged.items():
+        if isinstance(value, _Required):
+          raise ConfigError(
+              f"Required parameter {reg_name}.{param} was not configured.")
+      if sig is not None:
+        try:
+          bound = sig.bind(*args, **merged)
+        except TypeError:
+          bound = None
+        if bound is not None:
+          bound.apply_defaults()
+          for param, value in bound.arguments.items():
+            if isinstance(value, _Required):
+              raise ConfigError(
+                  f"Required parameter {reg_name}.{param} was not configured.")
+      for param, value in merged.items():
+        _REGISTRY.operative[(reg_name, param)] = value
+      return fn(*args, **merged)
+
+    wrapper.__wrapped__ = fn
+    wrapper._configurable_name = reg_name
+    _register(reg_name, wrapper)
+    return wrapper
+
+  if fn_or_name is None:
+    return decorate
+  if isinstance(fn_or_name, str):
+    name = fn_or_name
+    return decorate
+  return decorate(fn_or_name)
+
+
+def _decorate_class(cls: type, reg_name: str,
+                    denylist: Sequence[str]) -> type:
+  """Registers a class by wrapping its __init__ (classes stay classes so
+  inheritance and isinstance keep working, as with gin)."""
+  original_init = cls.__init__
+  sig = inspect.signature(original_init)
+  param_names = set(sig.parameters) - {"self"}
+  has_var_kw = any(p.kind == inspect.Parameter.VAR_KEYWORD
+                   for p in sig.parameters.values())
+
+  @functools.wraps(original_init)
+  def init_wrapper(self, *args, **kwargs):
+    # Only inject when constructing exactly this class: a configurable
+    # subclass handles its own injection and forwards via super().
+    if type(self) is cls or not getattr(
+        type(self), "_configurable_name", None):
+      bindings = _lookup_bindings(reg_name)
+      bound_positional = set()
+      if args:
+        non_self = [p for p in sig.parameters if p != "self"]
+        for arg_name, _ in zip(non_self, args):
+          bound_positional.add(arg_name)
+      for param, raw in bindings.items():
+        if param in denylist:
+          raise ConfigError(
+              f"Parameter {param!r} of {reg_name!r} may not be configured.")
+        if not has_var_kw and param not in param_names:
+          raise ConfigError(
+              f"Configurable {reg_name!r} has no parameter {param!r}.")
+        if param in kwargs or param in bound_positional:
+          continue
+        kwargs[param] = _resolve_value(raw)
+      for param, value in kwargs.items():
+        if isinstance(value, _Required):
+          raise ConfigError(
+              f"Required parameter {reg_name}.{param} was not configured.")
+        _REGISTRY.operative[(reg_name, param)] = value
+    return original_init(self, *args, **kwargs)
+
+  cls.__init__ = init_wrapper
+  cls._configurable_name = reg_name
+  _register(reg_name, cls)
+  return cls
+
+
+def external_configurable(fn: Callable, name: Optional[str] = None) -> Callable:
+  """Registers a third-party callable (reference: gin.external_configurable
+  of RunConfig/Saver etc., /root/reference/models/abstract_model.py:66-83)."""
+  return configurable(name=name or fn.__name__)(fn)
+
+
+def bind(configurable_name: str, param: str, value: Any,
+         scope: str = "") -> None:
+  _REGISTRY.bindings[(scope, configurable_name, param)] = value
+
+
+def macro(name: str, value: Any) -> None:
+  _REGISTRY.macros[name] = value
+
+
+def query_parameter(dotted: str) -> Any:
+  """`query_parameter('Conf.param')` → currently bound (resolved) value."""
+  scope, name, param = _parse_lhs(dotted)
+  key = (scope, name, param)
+  if key in _REGISTRY.bindings:
+    return _resolve_value(_REGISTRY.bindings[key])
+  raise ConfigError(f"No binding for {dotted!r}")
+
+
+# ---------------------------------------------------------------------------
+# Parser
+# ---------------------------------------------------------------------------
+
+_LHS_RE = re.compile(
+    r"^(?:(?P<scope>[\w./]+)/)?(?P<name>[\w.]+)\.(?P<param>\w+)$")
+
+
+def _parse_lhs(lhs: str) -> Tuple[str, str, str]:
+  m = _LHS_RE.match(lhs.strip())
+  if not m:
+    raise ConfigError(f"Cannot parse binding target {lhs!r}")
+  return m.group("scope") or "", m.group("name"), m.group("param")
+
+
+class _ValueTransformer(ast.NodeTransformer):
+  """Rewrites @ref / %macro placeholders back out of a parsed literal."""
+
+
+def _parse_value(text: str) -> Any:
+  """Parses a gin RHS: python literal with @references and %macros."""
+  text = text.strip()
+  # Tokenize @references and %macros into placeholder strings, parse the
+  # literal, then substitute back.
+  placeholders: Dict[str, Any] = {}
+
+  def _sub_ref(m: re.Match) -> str:
+    key = f"__t2r_ref_{len(placeholders)}__"
+    name = m.group("name")
+    evaluate = m.group("call") is not None
+    placeholders[key] = _ConfigurableReference(name, evaluate)
+    return repr(key)
+
+  def _sub_macro(m: re.Match) -> str:
+    key = f"__t2r_macro_{len(placeholders)}__"
+    placeholders[key] = _MacroReference(m.group("name"))
+    return repr(key)
+
+  substituted = re.sub(
+      r"@(?P<name>[\w./]+)(?P<call>\(\))?", _sub_ref, text)
+  substituted = re.sub(r"%(?P<name>[\w.]+)", _sub_macro, substituted)
+  try:
+    value = ast.literal_eval(substituted)
+  except (ValueError, SyntaxError) as e:
+    raise ConfigError(f"Cannot parse config value {text!r}: {e}") from e
+
+  def _restore(obj: Any) -> Any:
+    if isinstance(obj, str) and obj in placeholders:
+      return placeholders[obj]
+    if isinstance(obj, list):
+      return [_restore(v) for v in obj]
+    if isinstance(obj, tuple):
+      return tuple(_restore(v) for v in obj)
+    if isinstance(obj, dict):
+      return {_restore(k): _restore(v) for k, v in obj.items()}
+    return obj
+
+  return _restore(value)
+
+
+def _logical_lines(text: str):
+  """Yields logical config lines, joining bracket/paren continuations."""
+  buffer = ""
+  depth = 0
+  for raw_line in text.splitlines():
+    line = raw_line.split("#", 1)[0].rstrip()
+    if not line.strip() and depth == 0:
+      continue
+    buffer = (buffer + " " + line.strip()) if buffer else line.strip()
+    depth = (buffer.count("(") - buffer.count(")")
+             + buffer.count("[") - buffer.count("]")
+             + buffer.count("{") - buffer.count("}"))
+    if depth <= 0 and buffer and not buffer.endswith(("=", ",")):
+      yield buffer
+      buffer = ""
+      depth = 0
+  if buffer.strip():
+    yield buffer
+
+
+def parse_config(text: str, base_dir: Optional[str] = None) -> None:
+  """Parses config text: bindings, macros, imports, includes."""
+  for line in _logical_lines(text):
+    if line.startswith("import "):
+      module = line[len("import "):].strip()
+      _REGISTRY.imports.append(module)
+      importlib.import_module(module)
+      continue
+    if line.startswith("include "):
+      target = line[len("include "):].strip().strip("'\"")
+      path = target
+      if base_dir and not os.path.isabs(target):
+        path = os.path.join(base_dir, target)
+      parse_config_file(path)
+      continue
+    if "=" not in line:
+      raise ConfigError(f"Cannot parse config line: {line!r}")
+    lhs, rhs = line.split("=", 1)
+    lhs = lhs.strip()
+    value = _parse_value(rhs)
+    if re.match(r"^[A-Z_][A-Z0-9_]*$", lhs):  # MACRO = value
+      macro(lhs, value)
+      continue
+    if "." not in lhs:
+      # bare-name macro (gin allows lowercase macros too)
+      macro(lhs, value)
+      continue
+    scope, name, param = _parse_lhs(lhs)
+    bind(name, param, value, scope=scope)
+
+
+def parse_config_file(path: str) -> None:
+  with open(path) as f:
+    parse_config(f.read(), base_dir=os.path.dirname(path))
+
+
+def parse_config_files_and_bindings(
+    config_files: Optional[Sequence[str]] = None,
+    bindings: Optional[Sequence[str]] = None) -> None:
+  """The CLI entry used by trainer binaries (reference
+  bin/run_t2r_trainer.py:29)."""
+  for path in config_files or []:
+    parse_config_file(path)
+  for binding in bindings or []:
+    parse_config(binding)
+
+
+def operative_config_str() -> str:
+  """Every parameter value actually used by invoked configurables, as
+  re-parseable config text (reference operative-config persistence)."""
+  lines = []
+  for (name, param), value in sorted(_REGISTRY.operative.items()):
+    lines.append(f"{name}.{param} = {_format_value(value)}")
+  return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _format_value(value: Any) -> str:
+  if isinstance(value, (_ConfigurableReference, _MacroReference)):
+    return repr(value)
+  if callable(value) and hasattr(value, "_configurable_name"):
+    return f"@{value._configurable_name}"
+  if isinstance(value, str):
+    return repr(value)
+  if isinstance(value, (list, tuple, dict, int, float, bool, type(None))):
+    return repr(value)
+  return repr(str(value))

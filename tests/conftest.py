@@ -8,7 +8,9 @@ sharding without hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-override: the environment may pin JAX_PLATFORMS to a hardware
+# backend (axon TPU tunnel); tests must never touch it.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +19,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax  # noqa: E402  (import after env setup)
+
+# Belt and braces: the env var alone can be overridden by site hooks that
+# registered a hardware platform before conftest runs.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,170 @@
+"""Tests for the gin-style config engine."""
+
+import pytest
+
+from tensor2robot_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+@config.configurable
+def lr_schedule(base_lr=0.1, decay=0.99):
+  return base_lr, decay
+
+
+@config.configurable
+def make_optimizer(lr_fn=None, momentum=0.9):
+  return {"lr_fn": lr_fn, "momentum": momentum}
+
+
+@config.configurable("NamedThing")
+def _thing(value=1):
+  return value
+
+
+@config.configurable
+def needs_value(value=config.REQUIRED):
+  return value
+
+
+class TestBindings:
+
+  def test_basic_binding(self):
+    config.parse_config("lr_schedule.base_lr = 0.5")
+    assert lr_schedule() == (0.5, 0.99)
+
+  def test_call_site_wins(self):
+    config.parse_config("lr_schedule.base_lr = 0.5")
+    assert lr_schedule(base_lr=1.0) == (1.0, 0.99)
+
+  def test_positional_call_site_wins(self):
+    config.parse_config("lr_schedule.base_lr = 0.5")
+    assert lr_schedule(2.0) == (2.0, 0.99)
+
+  def test_unknown_param_raises(self):
+    config.parse_config("lr_schedule.nope = 1")
+    with pytest.raises(config.ConfigError, match="no parameter"):
+      lr_schedule()
+
+  def test_custom_name(self):
+    config.parse_config("NamedThing.value = 42")
+    assert _thing() == 42
+
+  def test_required_sentinel(self):
+    with pytest.raises(config.ConfigError, match="Required parameter"):
+      needs_value()
+    config.parse_config("needs_value.value = 3")
+    assert needs_value() == 3
+
+  def test_literal_types(self):
+    config.parse_config("""
+lr_schedule.base_lr = 1e-3
+lr_schedule.decay = None
+""")
+    assert lr_schedule() == (1e-3, None)
+
+  def test_multiline_list(self):
+    config.parse_config("""
+make_optimizer.momentum = [
+    1,
+    2,
+    3,
+]
+""")
+    assert make_optimizer()["momentum"] == [1, 2, 3]
+
+  def test_comments_ignored(self):
+    config.parse_config("# a comment\nlr_schedule.base_lr = 0.25  # inline\n")
+    assert lr_schedule()[0] == 0.25
+
+
+class TestReferencesAndMacros:
+
+  def test_configurable_reference(self):
+    config.parse_config("make_optimizer.lr_fn = @lr_schedule")
+    out = make_optimizer()
+    assert out["lr_fn"]() == (0.1, 0.99)
+
+  def test_evaluated_reference(self):
+    config.parse_config("""
+lr_schedule.base_lr = 0.7
+make_optimizer.lr_fn = @lr_schedule()
+""")
+    assert make_optimizer()["lr_fn"] == (0.7, 0.99)
+
+  def test_macro(self):
+    config.parse_config("""
+LR = 0.125
+lr_schedule.base_lr = %LR
+""")
+    assert lr_schedule()[0] == 0.125
+
+  def test_undefined_macro_raises(self):
+    config.parse_config("lr_schedule.base_lr = %MISSING")
+    with pytest.raises(config.ConfigError, match="Undefined macro"):
+      lr_schedule()
+
+  def test_reference_in_list(self):
+    config.parse_config("make_optimizer.lr_fn = [@lr_schedule, %M]\nM = 5")
+    out = make_optimizer()
+    assert out["lr_fn"][1] == 5
+    assert out["lr_fn"][0]() == (0.1, 0.99)
+
+
+class TestScopes:
+
+  def test_scoped_binding(self):
+    config.parse_config("""
+lr_schedule.base_lr = 0.1
+train/lr_schedule.base_lr = 0.9
+""")
+    assert lr_schedule()[0] == 0.1
+    with config.config_scope("train"):
+      assert lr_schedule()[0] == 0.9
+
+  def test_inner_scope_wins(self):
+    config.parse_config("""
+a/lr_schedule.base_lr = 0.2
+b/lr_schedule.base_lr = 0.3
+""")
+    with config.config_scope("a"):
+      with config.config_scope("b"):
+        assert lr_schedule()[0] == 0.3
+
+
+class TestFilesAndOperative:
+
+  def test_include_and_file(self, tmp_path):
+    base = tmp_path / "base.gin"
+    base.write_text("lr_schedule.base_lr = 0.01\n")
+    top = tmp_path / "top.gin"
+    top.write_text("include 'base.gin'\nlr_schedule.decay = 0.5\n")
+    config.parse_config_files_and_bindings([str(top)], ["lr_schedule.decay = 0.75"])
+    assert lr_schedule() == (0.01, 0.75)
+
+  def test_operative_config(self):
+    config.parse_config("lr_schedule.base_lr = 0.5")
+    lr_schedule()
+    text = config.operative_config_str()
+    assert "lr_schedule.base_lr = 0.5" in text
+    # operative config must be re-parseable
+    config.clear_config()
+    config.parse_config(text)
+    assert lr_schedule()[0] == 0.5
+
+  def test_external_configurable(self):
+    import fnmatch
+    translate = config.external_configurable(
+        fnmatch.translate, name="translate")
+    config.parse_config("translate.pat = '*.py'")
+    import re
+    assert re.match(translate(), "foo.py")
+
+  def test_query_parameter(self):
+    config.parse_config("lr_schedule.base_lr = 0.5")
+    assert config.query_parameter("lr_schedule.base_lr") == 0.5

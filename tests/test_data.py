@@ -1,0 +1,336 @@
+"""Tests for the data layer: TFRecord IO, codec, parsing, pipeline,
+input generators."""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.data import codec, input_generators, parsing, pipeline, tfrecord
+
+
+def _write_records(path, records):
+  with tfrecord.RecordWriter(str(path)) as w:
+    for r in records:
+      w.write(r)
+
+
+class TestTFRecord:
+
+  def test_roundtrip(self, tmp_path):
+    path = tmp_path / "data.tfrecord"
+    records = [b"hello", b"", b"x" * 1000]
+    _write_records(path, records)
+    assert tfrecord.read_records(str(path), verify_crc=True) == records
+    assert tfrecord.count_records(str(path)) == 3
+
+  def test_tf_compatibility(self, tmp_path):
+    """Files we write must be readable by TFRecordDataset and vice versa."""
+    tf = pytest.importorskip("tensorflow")
+    ours = tmp_path / "ours.tfrecord"
+    _write_records(ours, [b"abc", b"defg"])
+    got = [r.numpy() for r in tf.data.TFRecordDataset(str(ours))]
+    assert got == [b"abc", b"defg"]
+    theirs = tmp_path / "theirs.tfrecord"
+    with tf.io.TFRecordWriter(str(theirs)) as w:
+      w.write(b"zzz")
+    assert tfrecord.read_records(str(theirs), verify_crc=True) == [b"zzz"]
+
+  def test_truncated_raises(self, tmp_path):
+    path = tmp_path / "bad.tfrecord"
+    _write_records(path, [b"hello"])
+    data = path.read_bytes()
+    path.write_bytes(data[:-2])
+    with pytest.raises(IOError):
+      tfrecord.read_records(str(path))
+
+
+def _example_spec():
+  return SpecStruct({
+      "pose": TensorSpec(shape=(3,), dtype=np.float32, name="pose"),
+      "count": TensorSpec(shape=(), dtype=np.int64, name="count"),
+      "image": TensorSpec(shape=(6, 8, 3), dtype=np.uint8, name="img/encoded",
+                          data_format="png"),
+  })
+
+
+class TestCodecAndParsing:
+
+  def test_example_roundtrip(self):
+    spec = _example_spec()
+    label_spec = SpecStruct({"target": TensorSpec(shape=(2,))})
+    rng = np.random.RandomState(0)
+    image = rng.randint(0, 255, (6, 8, 3), np.uint8)
+    record = codec.encode_example(
+        {"pose": np.array([1., 2., 3.], np.float32),
+         "count": np.array(5, np.int64),
+         "image": image,
+         "target": np.array([0.5, -0.5], np.float32)},
+        SpecStruct(dict(spec.items(), **{"target": label_spec["target"]})))
+    parse_fn = parsing.create_parse_fn(spec, label_spec)
+    out = parse_fn.parse_batch([record, record])
+    np.testing.assert_allclose(out["features/pose"],
+                               [[1, 2, 3], [1, 2, 3]])
+    assert out["features/count"].tolist() == [5, 5]
+    assert out["features/image"].shape == (2, 6, 8, 3)
+    np.testing.assert_array_equal(out["features/image"][0], image)  # png lossless
+    np.testing.assert_allclose(out["labels/target"], [[0.5, -0.5]] * 2)
+
+  def test_jpeg_decode(self):
+    spec = SpecStruct({"image": TensorSpec(shape=(16, 16, 3), dtype=np.uint8,
+                                           data_format="jpeg")})
+    image = np.full((16, 16, 3), 128, np.uint8)
+    record = codec.encode_example({"image": image}, spec)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    # jpeg is lossy; mid-gray roundtrips within a small tolerance
+    assert np.abs(out["features/image"][0].astype(int) - 128).max() < 4
+
+  def test_empty_image_falls_back_to_zeros(self):
+    spec = SpecStruct({"image": TensorSpec(shape=(4, 4, 3), dtype=np.uint8,
+                                           data_format="jpeg")})
+    record = codec.encode_example({"image": b""}, spec)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    np.testing.assert_array_equal(out["features/image"][0], 0)
+
+  def test_varlen_pad_and_clip(self):
+    spec = SpecStruct({"v": TensorSpec(shape=(4,), dtype=np.float32,
+                                       varlen_default_value=-1.0)})
+    short = codec.encode_example({"v": np.array([1., 2.], np.float32)}, spec)
+    long = codec.encode_example(
+        {"v": np.arange(6, dtype=np.float32)}, spec)
+    out = parsing.create_parse_fn(spec).parse_batch([short, long])
+    np.testing.assert_allclose(out["features/v"][0], [1, 2, -1, -1])
+    np.testing.assert_allclose(out["features/v"][1], [0, 1, 2, 3])
+
+  def test_missing_required_raises(self):
+    spec = SpecStruct({"a": TensorSpec(shape=(1,), name="a"),
+                       "b": TensorSpec(shape=(1,), name="b")})
+    record = codec.encode_example(
+        {"a": np.zeros(1, np.float32)},
+        SpecStruct({"a": spec["a"]}))
+    with pytest.raises(ValueError, match="missing required feature 'b'"):
+      parsing.create_parse_fn(spec).parse_batch([record])
+
+  def test_optional_missing_ok(self):
+    spec = SpecStruct({"a": TensorSpec(shape=(1,), name="a"),
+                       "opt": TensorSpec(shape=(1,), name="opt",
+                                         is_optional=True)})
+    record = codec.encode_example({"a": np.zeros(1, np.float32)},
+                                  SpecStruct({"a": spec["a"]}))
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    assert "features/opt" not in out
+
+  def test_bfloat16_spec_parses_and_casts(self):
+    import ml_dtypes
+    spec = SpecStruct({"x": TensorSpec(shape=(2,), dtype="bfloat16")})
+    record = codec.encode_example(
+        {"x": np.array([1.5, 2.5], np.float32)}, None)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    assert out["features/x"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+  def test_sequence_example(self):
+    spec = SpecStruct({
+        "obs": TensorSpec(shape=(None, 2), dtype=np.float32, name="obs",
+                          is_sequence=True),
+        "task": TensorSpec(shape=(), dtype=np.int64, name="task"),
+    })
+    records = []
+    for length in (2, 4):
+      seq = np.arange(length * 2, dtype=np.float32).reshape(length, 2)
+      records.append(codec.encode_sequence_example(
+          {"task": np.array(1, np.int64)}, {"obs": seq}, spec))
+    out = parsing.create_parse_fn(spec).parse_batch(records)
+    assert out["features/obs"].shape == (2, 4, 2)  # padded to max length
+    assert out["features/obs_length"].tolist() == [2, 4]
+    np.testing.assert_allclose(out["features/obs"][0, 2:], 0)
+    assert out["features/task"].tolist() == [1, 1]
+
+  def test_multi_dataset_zip(self):
+    spec = SpecStruct({
+        "a": TensorSpec(shape=(1,), name="a", dataset_key="d1"),
+        "b": TensorSpec(shape=(1,), name="b", dataset_key="d2"),
+    })
+    rec_a = codec.encode_example({"a": np.array([1.0], np.float32)}, None)
+    rec_b = codec.encode_example({"b": np.array([2.0], np.float32)}, None)
+    parse_fn = parsing.create_parse_fn(spec)
+    assert set(parse_fn.dataset_keys) == {"d1", "d2"}
+    out = parse_fn.parse_batch({"d1": [rec_a], "d2": [rec_b]})
+    np.testing.assert_allclose(out["features/a"], [[1.0]])
+    np.testing.assert_allclose(out["features/b"], [[2.0]])
+
+  def test_spec_name_used_as_wire_key(self):
+    spec = SpecStruct({"nested/deep": TensorSpec(shape=(1,),
+                                                 name="custom_name")})
+    record = codec.encode_example({"nested/deep": np.ones(1, np.float32)},
+                                  spec)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    assert "features/nested/deep" in out
+
+
+class TestPipeline:
+
+  def _make_files(self, tmp_path, n_files=3, records_per_file=10):
+    spec = SpecStruct({"x": TensorSpec(shape=(2,), dtype=np.float32,
+                                       name="x"),
+                       "idx": TensorSpec(shape=(), dtype=np.int64,
+                                         name="idx")})
+    label_spec = SpecStruct({"y": TensorSpec(shape=(1,), name="y")})
+    idx = 0
+    paths = []
+    for i in range(n_files):
+      path = tmp_path / f"data-{i}.tfrecord"
+      with tfrecord.RecordWriter(str(path)) as w:
+        for _ in range(records_per_file):
+          merged_spec = SpecStruct(dict(spec.items(), y=label_spec["y"]))
+          w.write(codec.encode_example(
+              {"x": np.full(2, idx, np.float32),
+               "idx": np.array(idx, np.int64),
+               "y": np.array([idx], np.float32)}, merged_spec))
+          idx += 1
+      paths.append(str(path))
+    return spec, label_spec, paths
+
+  def test_eval_deterministic_single_pass(self, tmp_path):
+    spec, label_spec, paths = self._make_files(tmp_path)
+    parse_fn = parsing.create_parse_fn(spec, label_spec)
+    pipe = pipeline.RecordBatchPipeline(
+        paths, parse_fn, batch_size=5, mode="eval", repeat=False,
+        prefetch_size=0, cycle_length=1)
+    batches = list(pipe)
+    assert len(batches) == 6  # 30 records / batch 5
+    seen = sorted(int(i) for b in batches
+                  for i in b["features/idx"].tolist())
+    assert seen == list(range(30))
+    assert batches[0]["labels/y"].shape == (5, 1)
+
+  def test_train_shuffles_and_repeats(self, tmp_path):
+    spec, label_spec, paths = self._make_files(tmp_path)
+    parse_fn = parsing.create_parse_fn(spec, label_spec)
+    pipe = pipeline.RecordBatchPipeline(
+        paths, parse_fn, batch_size=8, mode="train", seed=1,
+        shuffle_buffer_size=16, prefetch_size=0)
+    it = iter(pipe)
+    batches = [next(it) for _ in range(10)]  # > 1 epoch worth
+    first = batches[0]["features/idx"].tolist()
+    assert first != sorted(first)  # shuffled with high probability
+
+  def test_glob_and_missing_pattern(self, tmp_path):
+    spec, label_spec, paths = self._make_files(tmp_path)
+    files = pipeline.resolve_file_patterns(str(tmp_path / "data-*.tfrecord"))
+    assert len(files) == 3
+    with pytest.raises(ValueError, match="matched no files"):
+      pipeline.resolve_file_patterns(str(tmp_path / "nope-*.tfrecord"))
+
+  def test_host_sharding(self, tmp_path):
+    spec, label_spec, paths = self._make_files(tmp_path, n_files=4)
+    shard0 = pipeline.resolve_file_patterns(paths, 0, 2)
+    shard1 = pipeline.resolve_file_patterns(paths, 1, 2)
+    assert len(shard0) == len(shard1) == 2
+    assert not set(shard0) & set(shard1)
+
+  def test_preprocess_fn_applied(self, tmp_path):
+    spec, label_spec, paths = self._make_files(tmp_path)
+    parse_fn = parsing.create_parse_fn(spec, label_spec)
+
+    def preprocess(features, labels, mode):
+      features = specs_lib.flatten_spec_structure(features)
+      features["x"] = features["x"] * 2.0
+      return features, labels
+
+    pipe = pipeline.RecordBatchPipeline(
+        paths, parse_fn, batch_size=5, mode="eval", repeat=False,
+        preprocess_fn=preprocess, prefetch_size=0, cycle_length=1)
+    batch = next(iter(pipe))
+    np.testing.assert_allclose(
+        batch["features/x"][:, 0], batch["features/idx"] * 2.0)
+
+
+class _SpecsProviderMixin:
+
+  def _specs(self):
+    feature_spec = SpecStruct({
+        "x": TensorSpec(shape=(3,), dtype=np.float32, name="x")})
+    label_spec = SpecStruct({
+        "y": TensorSpec(shape=(1,), dtype=np.float32, name="y")})
+    return feature_spec, label_spec
+
+
+class TestInputGenerators(_SpecsProviderMixin):
+
+  def test_random_generator(self):
+    gen = input_generators.DefaultRandomInputGenerator(batch_size=4)
+    feature_spec, label_spec = self._specs()
+    gen.set_specification(feature_spec, label_spec)
+    batch = next(gen("train"))
+    assert batch["features/x"].shape == (4, 3)
+    assert batch["labels/y"].shape == (4, 1)
+
+  def test_constant_generator(self):
+    gen = input_generators.DefaultConstantInputGenerator(
+        constant_value=2.5, batch_size=2)
+    feature_spec, label_spec = self._specs()
+    gen.set_specification(feature_spec, label_spec)
+    batch = next(gen("eval"))
+    np.testing.assert_allclose(batch["features/x"], 2.5)
+
+  def test_generator_input_generator(self):
+    feature_spec, label_spec = self._specs()
+
+    def gen_fn(mode):
+      i = 0
+      while True:
+        yield ({"x": np.full(3, i, np.float32)},
+               {"y": np.array([i], np.float32)})
+        i += 1
+
+    gen = input_generators.GeneratorInputGenerator(
+        generator_fn=gen_fn, batch_size=3)
+    gen.set_specification(feature_spec, label_spec)
+    batch = next(gen("train"))
+    np.testing.assert_allclose(batch["features/x"][:, 0], [0, 1, 2])
+
+  def test_record_generator_end_to_end(self, tmp_path):
+    feature_spec, label_spec = self._specs()
+    merged = SpecStruct(dict(feature_spec.items(), y=label_spec["y"]))
+    path = tmp_path / "d.tfrecord"
+    with tfrecord.RecordWriter(str(path)) as w:
+      for i in range(8):
+        w.write(codec.encode_example(
+            {"x": np.full(3, i, np.float32),
+             "y": np.array([i], np.float32)}, merged))
+    gen = input_generators.DefaultRecordInputGenerator(
+        file_patterns=str(path), batch_size=4, seed=0)
+    gen.set_specification(feature_spec, label_spec)
+    batch = next(gen("train"))
+    assert batch["features/x"].shape == (4, 3)
+
+  def test_uninitialized_specs_raise(self):
+    gen = input_generators.DefaultRandomInputGenerator(batch_size=2)
+    with pytest.raises(ValueError, match="specs not set"):
+      next(gen("train"))
+
+  def test_multi_eval_name_env(self, monkeypatch):
+    monkeypatch.setenv("T2R_CLUSTER", '{"multi_eval_name": "holdout"}')
+    assert input_generators.multi_eval_name() == "holdout"
+    monkeypatch.delenv("T2R_CLUSTER")
+    assert input_generators.multi_eval_name() == "eval"
+
+  def test_weighted_generator(self, tmp_path):
+    feature_spec, label_spec = self._specs()
+    merged = SpecStruct(dict(feature_spec.items(), y=label_spec["y"]))
+    groups = []
+    for g in range(2):
+      path = tmp_path / f"g{g}.tfrecord"
+      with tfrecord.RecordWriter(str(path)) as w:
+        for i in range(20):
+          w.write(codec.encode_example(
+              {"x": np.full(3, g, np.float32),
+               "y": np.array([g], np.float32)}, merged))
+      groups.append(str(path))
+    gen = input_generators.WeightedRecordInputGenerator(
+        file_pattern_groups=groups, weights=[0.9, 0.1], batch_size=10,
+        seed=0)
+    gen.set_specification(feature_spec, label_spec)
+    batch = next(gen("train"))
+    # heavy weight on group 0 -> most records from it
+    assert (batch["features/x"][:, 0] == 0).sum() >= 6
