@@ -1,0 +1,227 @@
+"""Model protocol: the heart of the framework.
+
+Re-design of the reference's `ModelInterface`/`AbstractT2RModel`
+(/root/reference/models/model_interface.py:47-145,
+/root/reference/models/abstract_model.py:161-981). The reference assembles
+a TF1 EstimatorSpec from `inference_network_fn` + `model_train_fn` +
+`model_eval_fn` inside `model_fn`; here the same pieces are pure functions
+over pytrees, and a generic SPMD step factory
+(`tensor2robot_tpu.parallel.train_step`) builds the jitted train/eval steps
+— replacing model_fn, create_train_op, TPUT2RModelWrapper and
+CrossShardOptimizer in one stroke.
+
+A model provides:
+* `get_feature_specification(mode)` / `get_label_specification(mode)` —
+  the spec contract consumed by data/export/serving layers;
+* `create_module()` — a flax.linen Module whose `__call__(features,
+  mode, train)` returns a SpecStruct/dict of inference outputs (the
+  reference's `inference_network_fn`);
+* `model_train_fn(features, labels, inference_outputs, mode)` ->
+  `(loss, scalars)`;
+* `model_eval_fn(features, labels, inference_outputs)` -> metric scalars;
+* `create_optimizer()` -> optax transformation (gin-injected factory);
+* optional `create_export_outputs_fn` for serving signatures.
+
+bfloat16 policy: `use_bfloat16 == True` wraps the preprocessor in
+`Bfloat16DevicePolicy` (infeed cast) and the step factory runs the forward
+pass in bfloat16 with float32 params — the JAX equivalent of the
+reference's bfloat16_scope + TPUPreprocessorWrapper
+(/root/reference/models/tpu_model_wrapper.py:107-191).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.models import optimizers as optimizers_lib
+from tensor2robot_tpu.preprocessors import base as preprocessors_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["ModelInterface", "T2RModel"]
+
+
+class ModelInterface(abc.ABC):
+  """Minimal contract used by all infra: train_eval, input generators,
+  exporters, predictors (reference model_interface.py:47-145)."""
+
+  @abc.abstractmethod
+  def get_feature_specification(self, mode: str) -> specs_lib.SpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_label_specification(self, mode: str) -> specs_lib.SpecStruct:
+    ...
+
+  @property
+  @abc.abstractmethod
+  def preprocessor(self) -> preprocessors_lib.AbstractPreprocessor:
+    ...
+
+
+class T2RModel(ModelInterface):
+  """Base model: specs + flax module + loss/metrics + optimizer factory."""
+
+  def __init__(self,
+               preprocessor_cls: Optional[Callable] = None,
+               optimizer_fn: Optional[Callable] = None,
+               device_type: str = "tpu",
+               use_bfloat16: bool = False,
+               use_ema: bool = False,
+               ema_decay: float = 0.9999,
+               init_checkpoint: Optional[str] = None,
+               init_checkpoint_filter: Optional[Callable[[str], bool]] = None,
+               use_summaries: bool = True):
+    self._preprocessor_cls = preprocessor_cls
+    self._optimizer_fn = optimizer_fn
+    self._device_type = device_type
+    self._use_bfloat16 = use_bfloat16
+    self._use_ema = use_ema
+    self._ema_decay = ema_decay
+    self._init_checkpoint = init_checkpoint
+    self._init_checkpoint_filter = init_checkpoint_filter
+    self._use_summaries = use_summaries and device_type != "tpu"
+    self._preprocessor: Optional[preprocessors_lib.AbstractPreprocessor] = None
+    self._module: Optional[nn.Module] = None
+
+  # -- properties -----------------------------------------------------------
+
+  @property
+  def device_type(self) -> str:
+    return self._device_type
+
+  @property
+  def use_bfloat16(self) -> bool:
+    return self._use_bfloat16
+
+  @property
+  def use_ema(self) -> bool:
+    return self._use_ema
+
+  @property
+  def ema_decay(self) -> float:
+    return self._ema_decay
+
+  @property
+  def init_checkpoint(self) -> Optional[str]:
+    return self._init_checkpoint
+
+  @property
+  def init_checkpoint_filter(self):
+    return self._init_checkpoint_filter
+
+  @property
+  def use_summaries(self) -> bool:
+    return self._use_summaries
+
+  @property
+  def preprocessor(self) -> preprocessors_lib.AbstractPreprocessor:
+    """Preprocessor wired to this model's specs; bfloat16-wrapped on TPU
+    (reference tpu_model_wrapper.py:122-125)."""
+    if self._preprocessor is None:
+      cls = self._preprocessor_cls or preprocessors_lib.NoOpPreprocessor
+      preprocessor = cls(
+          model_feature_specification_fn=self.get_feature_specification,
+          model_label_specification_fn=self.get_label_specification)
+      if self._use_bfloat16:
+        preprocessor = preprocessors_lib.Bfloat16DevicePolicy(preprocessor)
+      self._preprocessor = preprocessor
+    return self._preprocessor
+
+  @property
+  def module(self) -> nn.Module:
+    if self._module is None:
+      self._module = self.create_module()
+    return self._module
+
+  # -- abstract model surface ----------------------------------------------
+
+  @abc.abstractmethod
+  def create_module(self) -> nn.Module:
+    """The network as a flax module; `__call__(features, mode, train)`
+    returns a mapping of inference outputs."""
+
+  @abc.abstractmethod
+  def model_train_fn(self, features, labels, inference_outputs,
+                     mode: str) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Loss + scalar outputs (reference abstract_model.py model_train_fn)."""
+
+  def model_eval_fn(self, features, labels, inference_outputs
+                    ) -> Dict[str, jnp.ndarray]:
+    """Eval metric scalars; defaults to the train loss (reference
+    model_eval_fn)."""
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    return {"loss": loss, **scalars}
+
+  def create_export_outputs_fn(self, features, inference_outputs
+                               ) -> Dict[str, jnp.ndarray]:
+    """Serving outputs; defaults to all inference outputs (reference
+    create_export_outputs_fn / PredictOutput signatures)."""
+    if isinstance(inference_outputs, Mapping):
+      return dict(inference_outputs.items())
+    return {"output": inference_outputs}
+
+  def create_optimizer(self) -> optax.GradientTransformation:
+    """Optax chain; gin-injected factory wins (reference create_optimizer +
+    MovingAverage wrapping, abstract_model.py:836-871)."""
+    fn = self._optimizer_fn or optimizers_lib.create_adam_optimizer
+    return fn()
+
+  # -- functional init / apply ---------------------------------------------
+
+  def init_variables(self, rng: jax.Array, features,
+                     mode: str = modes_lib.TRAIN) -> Any:
+    """Initializes flax variables from a (possibly abstract) batch."""
+    init_rng, dropout_rng = jax.random.split(rng)
+    return self.module.init(
+        {"params": init_rng, "dropout": dropout_rng}, features, mode=mode,
+        train=(mode == modes_lib.TRAIN))
+
+  def inference_network_fn(self,
+                           variables: Any,
+                           features,
+                           mode: str,
+                           rng: Optional[jax.Array] = None,
+                           train: bool = False) -> Tuple[Any, Any]:
+    """Pure forward pass; returns (outputs, updated_mutable_state).
+
+    The reference's inference_network_fn
+    (/root/reference/models/abstract_model.py:703) with flax mutable
+    collections (batch_stats) threaded explicitly.
+    """
+    rngs = {"dropout": rng} if rng is not None else {}
+    mutable = ["batch_stats"] if train else False
+    out = self.module.apply(variables, features, mode=mode, train=train,
+                            rngs=rngs, mutable=mutable)
+    if mutable:
+      outputs, new_state = out
+      return outputs, new_state
+    return out, {}
+
+  # -- dtype policy ---------------------------------------------------------
+
+  @property
+  def compute_dtype(self):
+    return jnp.bfloat16 if self._use_bfloat16 else jnp.float32
+
+  def cast_features_for_compute(self, features):
+    """float32 -> bfloat16 on the way into the network when the bfloat16
+    policy is active (reference tpu_model_wrapper.py:179-191)."""
+    if not self._use_bfloat16:
+      return features
+
+    def _cast(x):
+      if hasattr(x, "dtype") and x.dtype == jnp.float32:
+        return x.astype(jnp.bfloat16)
+      return x
+
+    return jax.tree_util.tree_map(_cast, features)

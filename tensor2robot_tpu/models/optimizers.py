@@ -1,0 +1,160 @@
+"""Optimizer + LR-schedule factories (optax).
+
+Replaces the reference's TF1 optimizer factories
+(/root/reference/models/optimizers.py:26-159) and QT-Opt's `BuildOpt`
+(/root/reference/research/qtopt/optimizer_builder.py:25-96) with
+gin-configurable optax chains. The reference's MovingAverageOptimizer +
+swapping saver (:132-159) maps to an EMA transform whose shadow params are
+part of the train state and swapped in at save/export time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tensor2robot_tpu.utils import config
+
+__all__ = [
+    "create_constant_learning_rate", "create_exponential_decay_learning_rate",
+    "create_piecewise_linear_learning_rate",
+    "create_adam_optimizer", "create_sgd_optimizer",
+    "create_momentum_optimizer", "create_rms_prop_optimizer",
+    "with_ema", "EmaState",
+]
+
+
+# -- learning-rate schedules -------------------------------------------------
+
+
+@config.configurable
+def create_constant_learning_rate(learning_rate: float = 1e-4
+                                  ) -> optax.Schedule:
+  return optax.constant_schedule(learning_rate)
+
+
+@config.configurable
+def create_exponential_decay_learning_rate(
+    initial_learning_rate: float = 1e-4,
+    decay_steps: int = 10000,
+    decay_rate: float = 0.9,
+    staircase: bool = True) -> optax.Schedule:
+  """Reference exponential-decay LR (/root/reference/models/optimizers.py
+  and qtopt optimizer_builder exp-decay defaults)."""
+  return optax.exponential_decay(
+      init_value=initial_learning_rate,
+      transition_steps=decay_steps,
+      decay_rate=decay_rate,
+      staircase=staircase)
+
+
+@config.configurable
+def create_piecewise_linear_learning_rate(
+    boundaries: Any = (0, 10000),
+    values: Any = (1e-3, 1e-4)) -> optax.Schedule:
+  """Piecewise-linear global-step schedule (reference
+  /root/reference/utils/global_step_functions.py:26-123)."""
+  boundaries = [float(b) for b in boundaries]
+  values = [float(v) for v in values]
+  if len(boundaries) != len(values):
+    raise ValueError("boundaries and values must have the same length.")
+
+  def schedule(step):
+    step = jnp.asarray(step, jnp.float32)
+    out = jnp.asarray(values[0])
+    for (b0, v0), (b1, v1) in zip(zip(boundaries[:-1], values[:-1]),
+                                  zip(boundaries[1:], values[1:])):
+      frac = jnp.clip((step - b0) / jnp.maximum(b1 - b0, 1e-8), 0.0, 1.0)
+      out = jnp.where(step >= b0, v0 + frac * (v1 - v0), out)
+    out = jnp.where(step >= boundaries[-1], values[-1], out)
+    return out
+
+  return schedule
+
+
+def _resolve_lr(learning_rate) -> Any:
+  if callable(learning_rate) or isinstance(learning_rate, (int, float)):
+    return learning_rate
+  raise ValueError(f"Bad learning_rate {learning_rate!r}")
+
+
+# -- optimizers --------------------------------------------------------------
+
+
+def _finish(tx: optax.GradientTransformation,
+            gradient_clip_norm: Optional[float]
+            ) -> optax.GradientTransformation:
+  if gradient_clip_norm:
+    return optax.chain(optax.clip_by_global_norm(gradient_clip_norm), tx)
+  return tx
+
+
+@config.configurable
+def create_adam_optimizer(learning_rate: Any = 1e-4,
+                          b1: float = 0.9,
+                          b2: float = 0.999,
+                          eps: float = 1e-8,
+                          gradient_clip_norm: Optional[float] = None
+                          ) -> optax.GradientTransformation:
+  return _finish(optax.adam(_resolve_lr(learning_rate), b1=b1, b2=b2,
+                            eps=eps), gradient_clip_norm)
+
+
+@config.configurable
+def create_sgd_optimizer(learning_rate: Any = 1e-4,
+                         gradient_clip_norm: Optional[float] = None
+                         ) -> optax.GradientTransformation:
+  return _finish(optax.sgd(_resolve_lr(learning_rate)), gradient_clip_norm)
+
+
+@config.configurable
+def create_momentum_optimizer(learning_rate: Any = 1e-4,
+                              momentum: float = 0.9,
+                              use_nesterov: bool = False,
+                              gradient_clip_norm: Optional[float] = None
+                              ) -> optax.GradientTransformation:
+  return _finish(optax.sgd(_resolve_lr(learning_rate), momentum=momentum,
+                           nesterov=use_nesterov), gradient_clip_norm)
+
+
+@config.configurable
+def create_rms_prop_optimizer(learning_rate: Any = 1e-4,
+                              decay: float = 0.9,
+                              momentum: float = 0.9,
+                              eps: float = 1.0,
+                              gradient_clip_norm: Optional[float] = None
+                              ) -> optax.GradientTransformation:
+  return _finish(optax.rmsprop(_resolve_lr(learning_rate), decay=decay,
+                               momentum=momentum, eps=eps),
+                 gradient_clip_norm)
+
+
+# -- EMA (MovingAverageOptimizer + swapping-saver semantics) -----------------
+
+
+class EmaState(NamedTuple):
+  ema_params: Any
+
+
+def with_ema(decay: float = 0.9999):
+  """Returns an `update_ema(ema_state, params)` pair of helpers.
+
+  The reference keeps shadow moving-average variables and swaps them in at
+  checkpoint-save/eval time (swapping saver,
+  /root/reference/models/optimizers.py:132-159). Here the shadow params
+  live in the train state; `train_eval` swaps them in for eval/export when
+  the model requests it.
+  """
+
+  def init(params) -> EmaState:
+    return EmaState(ema_params=jax.tree_util.tree_map(jnp.asarray, params))
+
+  def update(state: EmaState, params) -> EmaState:
+    new_ema = jax.tree_util.tree_map(
+        lambda e, p: e * decay + (1.0 - decay) * p, state.ema_params, params)
+    return EmaState(ema_params=new_ema)
+
+  return init, update

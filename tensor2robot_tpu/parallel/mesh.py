@@ -1,0 +1,139 @@
+"""Device mesh construction and host<->device data placement.
+
+The TPU-native replacement for the reference's distribution machinery
+(SURVEY.md §2.5): where the reference splits batches across TPU shards via
+TPUEstimator + CrossShardOptimizer
+(/root/reference/models/tpu_model_wrapper.py:45-49) and aggregates
+multi-worker gradients with SyncReplicasOptimizer
+(/root/reference/models/abstract_model.py:864-871), this framework lays
+out a `jax.sharding.Mesh` over ICI (+ a DCN axis for multislice) and lets
+XLA insert the collectives from sharding annotations.
+
+Axes (any may be size 1):
+* `data`  — data parallelism (batch dim), the default;
+* `fsdp`  — parameter/optimizer-state sharding (ZeRO-style), a new
+            capability the reference lacks;
+* `model` — tensor parallelism on annotated layers, also new.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["create_mesh", "data_sharding", "replicated",
+           "put_host_batch", "local_batch_size", "initialize_multihost"]
+
+DEFAULT_AXES = ("data", "fsdp", "model")
+
+
+@config.configurable
+def create_mesh(mesh_shape: Optional[Sequence[int]] = None,
+                axis_names: Sequence[str] = DEFAULT_AXES,
+                devices: Optional[Sequence[jax.Device]] = None,
+                dcn_data_parallelism: int = 1) -> Mesh:
+  """Builds a Mesh over the available devices.
+
+  With `mesh_shape=None`, all devices go on the first ('data') axis and the
+  rest are size 1 — pure DP, the reference's only TPU strategy. For
+  multislice pods, `dcn_data_parallelism > 1` builds a hybrid mesh whose
+  outermost data axis rides DCN while the inner axes stay on ICI
+  (mesh_utils.create_hybrid_device_mesh).
+  """
+  devices = list(devices if devices is not None else jax.devices())
+  n = len(devices)
+  if mesh_shape is None:
+    mesh_shape = [n] + [1] * (len(axis_names) - 1)
+  mesh_shape = list(mesh_shape)
+  if math.prod(mesh_shape) != n:
+    raise ValueError(
+        f"mesh_shape {mesh_shape} does not cover {n} devices.")
+  if len(mesh_shape) != len(axis_names):
+    raise ValueError(
+        f"mesh_shape rank {len(mesh_shape)} != axis_names "
+        f"{len(axis_names)}.")
+  if dcn_data_parallelism > 1:
+    ici_shape = list(mesh_shape)
+    ici_shape[0] //= dcn_data_parallelism
+    dcn_shape = [dcn_data_parallelism] + [1] * (len(axis_names) - 1)
+    device_array = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=devices)
+  else:
+    device_array = mesh_utils.create_device_mesh(mesh_shape,
+                                                 devices=devices)
+  return Mesh(device_array, tuple(axis_names))
+
+
+def data_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:
+  """Sharding for batch leaves: leading dim over the data axis."""
+  return NamedSharding(mesh, PartitionSpec(batch_axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+  return NamedSharding(mesh, PartitionSpec())
+
+
+def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
+  """Per-host batch size (reference per-host batch override,
+  /root/reference/utils/tfdata.py:38-61)."""
+  process_count = max(
+      1, len({d.process_index for d in mesh.devices.flat}))
+  if global_batch_size % process_count:
+    raise ValueError(
+        f"Global batch {global_batch_size} not divisible by host count "
+        f"{process_count}.")
+  return global_batch_size // process_count
+
+
+def put_host_batch(mesh: Mesh, batch, batch_axis: str = "data",
+                   spec_structure: Optional[specs_lib.SpecStructLike] = None
+                   ) -> Any:
+  """Forms the global on-device array from each host's local numpy batch.
+
+  Single-host: a plain sharded device_put. Multi-host: every process
+  passes its local shard and `jax.make_array_from_process_local_data`
+  assembles the global array — the infeed path that replaces
+  TPUEstimator's per-host infeed threads.
+  """
+  flat_partition = None
+  if spec_structure is not None:
+    flat_partition = specs_lib.partition_specs(spec_structure, batch_axis)
+
+  def _put(path_key, x):
+    pspec = PartitionSpec(batch_axis)
+    if flat_partition is not None and path_key in flat_partition:
+      pspec = flat_partition[path_key]
+    sharding = NamedSharding(mesh, pspec)
+    if jax.process_count() == 1:
+      return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+  if isinstance(batch, specs_lib.SpecStruct):
+    out = specs_lib.SpecStruct()
+    for key, value in specs_lib.flatten_spec_structure(batch).items():
+      out[key] = _put(key, value)
+    return out
+  return jax.tree_util.tree_map(lambda x: _put(None, x), batch)
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+  """jax.distributed bring-up for multi-host pods (replaces the
+  reference's TF_CONFIG cluster plumbing,
+  /root/reference/models/abstract_model.py:440-443). No-op when
+  single-process or already initialized."""
+  if num_processes in (None, 1):
+    return
+  jax.distributed.initialize(
+      coordinator_address=coordinator_address,
+      num_processes=num_processes,
+      process_id=process_id)

@@ -1,0 +1,7 @@
+from tensor2robot_tpu.preprocessors.base import (
+    AbstractPreprocessor,
+    Bfloat16DevicePolicy,
+    NoOpPreprocessor,
+    SpecTransformationPreprocessor,
+)
+from tensor2robot_tpu.preprocessors import image_ops

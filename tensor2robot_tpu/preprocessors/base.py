@@ -1,0 +1,253 @@
+"""Preprocessor contract: the 4-spec transformation layer between data and
+model.
+
+Re-design of the reference's `AbstractPreprocessor`
+(/root/reference/preprocessors/abstract_preprocessor.py:28-217): a
+preprocessor declares *in* specs (what the raw parsed data looks like) and
+*out* specs (what the model consumes), for features and labels; its
+`preprocess()` validates+packs the input, applies `_preprocess_fn`, and
+validates+flattens the output. The same contract feeds training (mapped
+over the host pipeline), export receivers, and predictors.
+
+TPU-native notes:
+* `_preprocess_fn` is a pure function over SpecStructs of arrays; it can
+  run on host numpy batches (pipeline) or be traced by jit when fused into
+  the device step — RNG is passed explicitly as a jax PRNG key.
+* The bfloat16 device policy (reference TPUPreprocessorWrapper,
+  /root/reference/preprocessors/tpu_preprocessor_wrapper.py:34-157) is a
+  wrapper that rewrites out-specs float32->bfloat16 and strips optional
+  specs to cut infeed bandwidth.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["AbstractPreprocessor", "NoOpPreprocessor",
+           "SpecTransformationPreprocessor", "Bfloat16DevicePolicy"]
+
+SpecGetter = Callable[[str], specs_lib.SpecStruct]
+
+
+class AbstractPreprocessor(abc.ABC):
+  """4-spec preprocessor contract."""
+
+  def __init__(self,
+               model_feature_specification_fn: Optional[SpecGetter] = None,
+               model_label_specification_fn: Optional[SpecGetter] = None):
+    self._model_feature_specification_fn = model_feature_specification_fn
+    self._model_label_specification_fn = model_label_specification_fn
+
+  # -- model spec plumbing --------------------------------------------------
+
+  def model_feature_specification(self, mode: str) -> specs_lib.SpecStruct:
+    if self._model_feature_specification_fn is None:
+      raise ValueError(
+          f"{type(self).__name__} has no model feature specification fn.")
+    return specs_lib.flatten_spec_structure(
+        self._model_feature_specification_fn(mode))
+
+  def model_label_specification(self, mode: str) -> specs_lib.SpecStruct:
+    if self._model_label_specification_fn is None:
+      raise ValueError(
+          f"{type(self).__name__} has no model label specification fn.")
+    return specs_lib.flatten_spec_structure(
+        self._model_label_specification_fn(mode))
+
+  def set_model_specifications(self, feature_fn: SpecGetter,
+                               label_fn: SpecGetter) -> None:
+    self._model_feature_specification_fn = feature_fn
+    self._model_label_specification_fn = label_fn
+
+  # -- the 4 specs ----------------------------------------------------------
+
+  @abc.abstractmethod
+  def get_in_feature_specification(self, mode: str) -> specs_lib.SpecStruct:
+    """Raw-data feature layout this preprocessor consumes."""
+
+  @abc.abstractmethod
+  def get_in_label_specification(self, mode: str) -> specs_lib.SpecStruct:
+    """Raw-data label layout this preprocessor consumes."""
+
+  @abc.abstractmethod
+  def get_out_feature_specification(self, mode: str) -> specs_lib.SpecStruct:
+    """Feature layout delivered to the model."""
+
+  @abc.abstractmethod
+  def get_out_label_specification(self, mode: str) -> specs_lib.SpecStruct:
+    """Label layout delivered to the model."""
+
+  # -- transformation -------------------------------------------------------
+
+  @abc.abstractmethod
+  def _preprocess_fn(self, features: specs_lib.SpecStruct,
+                     labels: specs_lib.SpecStruct,
+                     mode: str) -> Tuple[specs_lib.SpecStruct,
+                                         specs_lib.SpecStruct]:
+    """Pure transformation from in-layout to out-layout."""
+
+  def preprocess(self, features, labels, mode: str
+                 ) -> Tuple[specs_lib.SpecStruct, specs_lib.SpecStruct]:
+    """Validate+pack in, transform, validate+flatten out (reference
+    :171-217). Batched inputs are expected (ignore_batch=True)."""
+    modes_lib.validate(mode)
+    in_f_spec = specs_lib.add_sequence_length_specs(
+        self.get_in_feature_specification(mode))
+    in_l_spec = specs_lib.add_sequence_length_specs(
+        self.get_in_label_specification(mode))
+    features = specs_lib.validate_and_pack(
+        in_f_spec, features, ignore_batch=True)
+    if labels is not None and len(labels):
+      labels = specs_lib.validate_and_pack(
+          in_l_spec, labels, ignore_batch=True)
+    else:
+      labels = specs_lib.SpecStruct()
+    out_features, out_labels = self._preprocess_fn(features, labels, mode)
+    out_features = specs_lib.validate_and_flatten(
+        specs_lib.add_sequence_length_specs(
+            self.get_out_feature_specification(mode)),
+        out_features, ignore_batch=True)
+    if out_labels is not None and len(out_labels):
+      out_labels = specs_lib.validate_and_flatten(
+          specs_lib.add_sequence_length_specs(
+              self.get_out_label_specification(mode)),
+          out_labels, ignore_batch=True)
+    return out_features, out_labels
+
+  def __call__(self, features, labels, mode: str):
+    return self.preprocess(features, labels, mode)
+
+
+@config.configurable
+class NoOpPreprocessor(AbstractPreprocessor):
+  """Identity preprocessor: in == out == model specs (reference
+  /root/reference/preprocessors/noop_preprocessor.py:27-107)."""
+
+  def get_in_feature_specification(self, mode):
+    return self.model_feature_specification(mode)
+
+  def get_in_label_specification(self, mode):
+    return self.model_label_specification(mode)
+
+  def get_out_feature_specification(self, mode):
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode):
+    return self.model_label_specification(mode)
+
+  def _preprocess_fn(self, features, labels, mode):
+    return features, labels
+
+
+class SpecTransformationPreprocessor(AbstractPreprocessor):
+  """Base for preprocessors whose out-specs equal the model specs and whose
+  in-specs are targeted rewrites of them (reference
+  /root/reference/preprocessors/spec_transformation_preprocessor.py:25-174).
+
+  Subclasses override `update_in_spec(spec, key)` to rewrite individual
+  leaves (e.g. a float32 image spec becomes a uint8 jpeg spec on the wire)
+  and `_preprocess_fn` to do the corresponding tensor transformation.
+  """
+
+  def get_out_feature_specification(self, mode):
+    return self.model_feature_specification(mode)
+
+  def get_out_label_specification(self, mode):
+    return self.model_label_specification(mode)
+
+  def get_in_feature_specification(self, mode):
+    out = specs_lib.SpecStruct()
+    for key, spec in self.model_feature_specification(mode).items():
+      out[key] = self.update_in_spec(spec, key)
+    return out
+
+  def get_in_label_specification(self, mode):
+    out = specs_lib.SpecStruct()
+    for key, spec in self.model_label_specification(mode).items():
+      out[key] = self.update_in_spec(spec, key)
+    return out
+
+  def update_in_spec(self, spec: specs_lib.TensorSpec,
+                     key: str) -> specs_lib.TensorSpec:
+    return spec
+
+
+@config.configurable
+class Bfloat16DevicePolicy(AbstractPreprocessor):
+  """Wraps a preprocessor for the TPU infeed dtype policy.
+
+  Reference TPUPreprocessorWrapper
+  (/root/reference/preprocessors/tpu_preprocessor_wrapper.py:34-157): the
+  host side stays float32, the model-facing out-specs become bfloat16, and
+  optional specs are stripped from the out-spec to cut infeed bandwidth.
+  """
+
+  def __init__(self, preprocessor: AbstractPreprocessor):
+    super().__init__()
+    self._preprocessor = preprocessor
+
+  @property
+  def inner(self) -> AbstractPreprocessor:
+    return self._preprocessor
+
+  def set_model_specifications(self, feature_fn, label_fn):
+    self._preprocessor.set_model_specifications(feature_fn, label_fn)
+
+  def get_in_feature_specification(self, mode):
+    return self._preprocessor.get_in_feature_specification(mode)
+
+  def get_in_label_specification(self, mode):
+    return self._preprocessor.get_in_label_specification(mode)
+
+  def get_out_feature_specification(self, mode):
+    out = specs_lib.filter_required(
+        self._preprocessor.get_out_feature_specification(mode))
+    return specs_lib.replace_dtype(out, np.float32, "bfloat16")
+
+  def get_out_label_specification(self, mode):
+    out = specs_lib.filter_required(
+        self._preprocessor.get_out_label_specification(mode))
+    return specs_lib.replace_dtype(out, np.float32, "bfloat16")
+
+  def _preprocess_fn(self, features, labels, mode):
+    features, labels = self._preprocessor._preprocess_fn(
+        features, labels, mode)
+    features = specs_lib.cast_float32_to_bfloat16(
+        _keep_required(features, self.get_out_feature_specification(mode)))
+    labels = specs_lib.cast_float32_to_bfloat16(
+        _keep_required(labels, self.get_out_label_specification(mode)))
+    return features, labels
+
+  def preprocess(self, features, labels, mode):
+    # Delegate validation to the inner preprocessor's in-specs, then apply
+    # the dtype policy on the way out.
+    modes_lib.validate(mode)
+    out_features, out_labels = self._preprocessor.preprocess(
+        features, labels, mode)
+    out_features = specs_lib.cast_float32_to_bfloat16(
+        _keep_required(out_features,
+                       self.get_out_feature_specification(mode)))
+    if out_labels is not None and len(out_labels):
+      out_labels = specs_lib.cast_float32_to_bfloat16(
+          _keep_required(out_labels, self.get_out_label_specification(mode)))
+    return out_features, out_labels
+
+
+def _keep_required(values: specs_lib.SpecStruct,
+                   spec: specs_lib.SpecStruct) -> specs_lib.SpecStruct:
+  """Drops value leaves not present in (required) spec, keeping _length
+  side outputs for sequence specs."""
+  out = specs_lib.SpecStruct()
+  flat = specs_lib.flatten_spec_structure(values)
+  spec = specs_lib.add_sequence_length_specs(spec)
+  for key, value in flat.items():
+    if key in spec:
+      out[key] = value
+  return out

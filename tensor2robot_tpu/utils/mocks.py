@@ -1,0 +1,144 @@
+"""Mock model + input generator test fixtures.
+
+The backbone of train_eval/hook/export/predictor tests, mirroring the
+reference's strategy (/root/reference/utils/mocks.py:43-236): a tiny MLP
+with batch-norm over a deterministic linearly-separable dataset, so
+end-to-end training converges in a few hundred CPU steps
+(/root/reference/utils/train_eval_test.py:37-39).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data import input_generators
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["MockMLP", "MockT2RModel", "MockInputGenerator"]
+
+
+class MockMLP(nn.Module):
+  """3-layer MLP with batch norm producing a single logit."""
+
+  hidden_size: int = 16
+  use_batch_norm: bool = True
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    x = features["x"]
+    for i in range(2):
+      x = nn.Dense(self.hidden_size, name=f"dense_{i}")(x)
+      if self.use_batch_norm:
+        x = nn.BatchNorm(use_running_average=not train,
+                         name=f"bn_{i}")(x)
+      x = nn.relu(x)
+    logit = nn.Dense(1, name="head")(x)
+    return specs_lib.SpecStruct({
+        "logit": logit,
+        "prediction": nn.sigmoid(logit),
+    })
+
+
+@config.configurable
+class MockT2RModel(abstract_model.T2RModel):
+  """Binary classifier over 3-dim features (reference MockT2RModel,
+  /root/reference/utils/mocks.py:99-188); optional multi-dataset specs
+  exercising `dataset_key` joins."""
+
+  def __init__(self, multi_dataset: bool = False, use_batch_norm: bool = True,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._multi_dataset = multi_dataset
+    self._use_batch_norm = use_batch_norm
+
+  def get_feature_specification(self, mode):
+    if self._multi_dataset:
+      return SpecStruct({
+          "x": TensorSpec(shape=(3,), dtype=np.float32, name="measured_position",
+                          dataset_key="dataset1"),
+      })
+    return SpecStruct({
+        "x": TensorSpec(shape=(3,), dtype=np.float32,
+                        name="measured_position"),
+    })
+
+  def get_label_specification(self, mode):
+    dataset_key = "dataset2" if self._multi_dataset else ""
+    return SpecStruct({
+        "y": TensorSpec(shape=(1,), dtype=np.float32, name="valid_position",
+                        dataset_key=dataset_key),
+    })
+
+  def create_module(self):
+    return MockMLP(use_batch_norm=self._use_batch_norm)
+
+  def create_optimizer(self):
+    if self._optimizer_fn is not None:
+      return super().create_optimizer()
+    import optax
+
+    return optax.adam(1e-2)  # CI-budget convergence (reference: 400 steps)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    logit = inference_outputs["logit"]
+    y = labels["y"]
+    loss = jnp.mean(
+        jnp.maximum(logit, 0) - logit * y + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+    return loss, {"sigmoid_xent": loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    prediction = inference_outputs["prediction"]
+    y = labels["y"]
+    accuracy = jnp.mean((prediction > 0.5).astype(jnp.float32) == y)
+    mse = jnp.mean((prediction - y) ** 2)
+    return {"accuracy": accuracy, "mse": mse}
+
+
+def make_separable_data(num_samples: int, seed: int = 0
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+  """Deterministic linearly separable data (reference MockInputGenerator,
+  /root/reference/utils/mocks.py:43-96)."""
+  rng = np.random.RandomState(seed)
+  x = rng.uniform(-1.0, 1.0, size=(num_samples, 3)).astype(np.float32)
+  w = np.array([1.5, -2.0, 0.5], np.float32)
+  y = (x @ w > 0.0).astype(np.float32)[:, None]
+  return x, y
+
+
+@config.configurable
+class MockInputGenerator(input_generators.AbstractInputGenerator):
+  """Cycles deterministically through the separable dataset."""
+
+  def __init__(self, batch_size: int = 32, num_samples: int = 256,
+               seed: int = 0):
+    super().__init__(batch_size=batch_size)
+    self._x, self._y = make_separable_data(num_samples, seed)
+
+  def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
+    def _iterate():
+      pos = 0
+      n = self._x.shape[0]
+      while True:
+        idx = [(pos + i) % n for i in range(self._batch_size)]
+        pos = (pos + self._batch_size) % n
+        out = SpecStruct()
+        out["features/x"] = self._x[idx]
+        out["labels/y"] = self._y[idx]
+        if self._preprocess_fn is not None:
+          features, labels = self._preprocess_fn(
+              out["features"], out["labels"], mode)
+          out = SpecStruct()
+          out["features"] = features
+          out["labels"] = labels
+        yield out
+
+    return _iterate()

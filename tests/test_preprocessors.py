@@ -1,0 +1,176 @@
+"""Tests for preprocessors and image ops."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.preprocessors import (
+    AbstractPreprocessor, Bfloat16DevicePolicy, NoOpPreprocessor,
+    SpecTransformationPreprocessor, image_ops)
+
+
+def _model_specs():
+  feature_spec = SpecStruct({
+      "image": TensorSpec(shape=(8, 8, 3), dtype=np.float32),
+      "pose": TensorSpec(shape=(3,), dtype=np.float32),
+      "opt": TensorSpec(shape=(1,), dtype=np.float32, is_optional=True),
+  })
+  label_spec = SpecStruct({"target": TensorSpec(shape=(2,),
+                                                dtype=np.float32)})
+  return feature_spec, label_spec
+
+
+def _noop():
+  f, l = _model_specs()
+  return NoOpPreprocessor(model_feature_specification_fn=lambda m: f,
+                          model_label_specification_fn=lambda m: l)
+
+
+class TestNoOpPreprocessor:
+
+  def test_identity(self):
+    pre = _noop()
+    features = specs_lib.make_random_numpy(
+        pre.get_in_feature_specification("train"), batch_size=2)
+    labels = specs_lib.make_random_numpy(
+        pre.get_in_label_specification("train"), batch_size=2)
+    out_f, out_l = pre.preprocess(features, labels, "train")
+    np.testing.assert_array_equal(out_f["image"], features["image"])
+    np.testing.assert_array_equal(out_l["target"], labels["target"])
+
+  def test_validation_failure(self):
+    pre = _noop()
+    with pytest.raises(ValueError):
+      pre.preprocess({"image": np.zeros((2, 4, 4, 3), np.float32)},
+                     {}, "train")
+
+  def test_invalid_mode(self):
+    pre = _noop()
+    with pytest.raises(ValueError, match="Unknown mode"):
+      pre.preprocess({}, {}, "banana")
+
+
+class _JpegWirePreprocessor(SpecTransformationPreprocessor):
+  """Float image in model; uint8 on the wire."""
+
+  def update_in_spec(self, spec, key):
+    if key == "image":
+      return spec.replace(dtype=np.uint8)
+    return spec
+
+  def _preprocess_fn(self, features, labels, mode):
+    features = specs_lib.flatten_spec_structure(features)
+    features["image"] = features["image"].astype(np.float32) / 255.0
+    return features, labels
+
+
+class TestSpecTransformation:
+
+  def test_in_spec_rewrite_and_transform(self):
+    f, l = _model_specs()
+    pre = _JpegWirePreprocessor(
+        model_feature_specification_fn=lambda m: f,
+        model_label_specification_fn=lambda m: l)
+    in_spec = pre.get_in_feature_specification("train")
+    assert in_spec["image"].dtype == np.uint8
+    assert pre.get_out_feature_specification("train")["image"].dtype == (
+        np.float32)
+    features = {
+        "image": np.full((2, 8, 8, 3), 255, np.uint8),
+        "pose": np.zeros((2, 3), np.float32),
+    }
+    labels = {"target": np.zeros((2, 2), np.float32)}
+    out_f, _ = pre.preprocess(features, labels, "train")
+    np.testing.assert_allclose(out_f["image"], 1.0)
+
+
+class TestBfloat16Policy:
+
+  def test_spec_rewrite_and_cast(self):
+    import ml_dtypes
+    pre = Bfloat16DevicePolicy(_noop())
+    out_spec = pre.get_out_feature_specification("train")
+    assert out_spec["image"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert "opt" not in out_spec  # optionals stripped
+    features = specs_lib.make_random_numpy(
+        pre.get_in_feature_specification("train"), batch_size=2)
+    labels = specs_lib.make_random_numpy(
+        pre.get_in_label_specification("train"), batch_size=2)
+    out_f, out_l = pre.preprocess(features, labels, "train")
+    assert out_f["image"].dtype == np.dtype(ml_dtypes.bfloat16)
+    assert out_l["target"].dtype == np.dtype(ml_dtypes.bfloat16)
+
+
+class TestImageOps:
+
+  def _img(self, b=2, h=16, w=16, c=3, seed=0):
+    return jax.random.uniform(jax.random.PRNGKey(seed), (b, h, w, c))
+
+  def test_center_and_custom_crop(self):
+    img = self._img()
+    out = image_ops.center_crop(img, 8, 8)
+    assert out.shape == (2, 8, 8, 3)
+    np.testing.assert_allclose(out, img[:, 4:12, 4:12, :])
+    out2 = image_ops.crop_image(img, 0, 0, 4, 6)
+    assert out2.shape == (2, 4, 6, 3)
+
+  def test_crop_too_large_raises(self):
+    with pytest.raises(ValueError, match="larger"):
+      image_ops.center_crop(self._img(), 32, 32)
+
+  def test_random_crop_shapes_and_determinism(self):
+    img = self._img()
+    key = jax.random.PRNGKey(1)
+    a = image_ops.random_crop(key, img, 8, 8)
+    b = image_ops.random_crop(key, img, 8, 8)
+    assert a.shape == (2, 8, 8, 3)
+    np.testing.assert_array_equal(a, b)
+
+  def test_resize(self):
+    out = image_ops.resize(self._img(), 4, 4)
+    assert out.shape == (2, 4, 4, 3)
+
+  def test_flip(self):
+    img = self._img()
+    # with a fixed key over many samples both flipped and unflipped occur
+    out = image_ops.random_flip_left_right(jax.random.PRNGKey(0), img)
+    assert out.shape == img.shape
+
+  def test_photometric_chain_jits_and_stays_in_range(self):
+    img = self._img()
+    fn = jax.jit(lambda k, x: image_ops.apply_photometric_distortions(
+        k, x, random_noise_level=0.01))
+    out = fn(jax.random.PRNGKey(2), img)
+    assert out.shape == img.shape
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+    # distortions must actually change the image
+    assert not np.allclose(out, img)
+
+  def test_hue_small_delta_close_to_identity(self):
+    img = self._img()
+    out = image_ops.random_hue(jax.random.PRNGKey(3), img, max_delta=1e-4)
+    np.testing.assert_allclose(out, np.clip(img, 0, 1), atol=2e-3)
+
+  def test_depth_distortions(self):
+    depth = jnp.ones((2, 8, 8, 1))
+    out = image_ops.apply_depth_distortions(jax.random.PRNGKey(0), depth)
+    assert out.shape == depth.shape
+    assert float(out.min()) >= 0.0
+
+  def test_crop_resize_distort_train_vs_eval(self):
+    img = (self._img() * 255).astype(jnp.uint8)
+    key = jax.random.PRNGKey(0)
+    train = image_ops.crop_resize_distort(key, img, (12, 12), (8, 8),
+                                          is_training=True)
+    ev = image_ops.crop_resize_distort(key, img, (12, 12), (8, 8),
+                                       is_training=False)
+    assert train.shape == ev.shape == (2, 8, 8, 3)
+    assert train.dtype == jnp.float32
+
+  def test_uint8_float_roundtrip(self):
+    img = np.random.RandomState(0).randint(0, 255, (2, 4, 4, 3), np.uint8)
+    rt = image_ops.to_uint8_image(image_ops.to_float_image(jnp.asarray(img)))
+    np.testing.assert_array_equal(np.asarray(rt), img)
