@@ -1,0 +1,34 @@
+"""Trainer CLI: config files in, training out.
+
+Reference twin: /root/reference/bin/run_t2r_trainer.py:28-31 — everything
+is injected through config; the binary only parses flags and calls
+`train_eval_model()`.
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_t2r_trainer \
+      --config_files path/to/experiment.gin \
+      --config "train_eval_model.model_dir = '/tmp/run1'"
+"""
+
+from __future__ import annotations
+
+from absl import app, flags
+
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse.")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  train_eval.train_eval_model()
+
+
+if __name__ == "__main__":
+  app.run(main)

@@ -1,0 +1,156 @@
+"""Checkpoint management: async orbax save/restore, continuous-eval
+iteration, crash-safe backups.
+
+Replaces the reference's TF Saver/scaffold machinery
+(/root/reference/models/abstract_model.py:786-804), the async checkpoint
+hooks (/root/reference/hooks/checkpoint_hooks.py), `checkpoints_iterator`
+continuous eval and the retrying backup-copy logic
+(/root/reference/utils/train_eval.py:585-733) with orbax:
+
+* async checkpointing overlaps HBM->disk with the next train steps;
+* restore is sharding-aware: params are restored directly into their mesh
+  layout (no host-side detour);
+* `checkpoints_iterator` polls a model_dir for new steps (continuous
+  eval); `backup_checkpoint` hardlink-copies a checkpoint so a concurrent
+  GC cannot delete it mid-eval.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Any, Iterator, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu.utils import config
+
+__all__ = ["CheckpointManager", "checkpoints_iterator", "backup_checkpoint",
+           "latest_step"]
+
+
+@config.configurable
+class CheckpointManager:
+  """Thin, spec-aware wrapper over orbax CheckpointManager."""
+
+  def __init__(self,
+               directory: str,
+               max_to_keep: int = 5,
+               save_interval_steps: int = 1,
+               async_checkpointing: bool = True,
+               keep_period: Optional[int] = None):
+    self._directory = os.path.abspath(directory)
+    os.makedirs(self._directory, exist_ok=True)
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep,
+        save_interval_steps=save_interval_steps,
+        keep_period=keep_period,
+        enable_async_checkpointing=async_checkpointing)
+    self._manager = ocp.CheckpointManager(self._directory, options=options)
+
+  @property
+  def directory(self) -> str:
+    return self._directory
+
+  def save(self, step: int, state: Any, force: bool = False) -> bool:
+    return self._manager.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
+  def restore(self, step: Optional[int] = None,
+              abstract_state: Optional[Any] = None) -> Any:
+    """Restores `step` (or latest). With `abstract_state` (a
+    jax.eval_shape tree, optionally with shardings attached) the restore
+    is sharded/in-layout."""
+    if step is None:
+      step = self.latest_step()
+    if step is None:
+      raise FileNotFoundError(f"No checkpoint in {self._directory}")
+    if abstract_state is not None:
+      return self._manager.restore(
+          step, args=ocp.args.StandardRestore(abstract_state))
+    return self._manager.restore(step)
+
+  def latest_step(self) -> Optional[int]:
+    return self._manager.latest_step()
+
+  def all_steps(self):
+    return self._manager.all_steps()
+
+  def wait_until_finished(self) -> None:
+    self._manager.wait_until_finished()
+
+  def close(self) -> None:
+    self._manager.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()
+
+
+def latest_step(directory: str) -> Optional[int]:
+  """Latest checkpoint step in a directory, without holding a manager."""
+  if not os.path.isdir(directory):
+    return None
+  steps = []
+  for name in os.listdir(directory):
+    if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+      steps.append(int(name))
+  return max(steps) if steps else None
+
+
+def checkpoints_iterator(directory: str,
+                         timeout_secs: float = 10.0,
+                         total_timeout_secs: Optional[float] = None,
+                         min_interval_secs: float = 0.0
+                         ) -> Iterator[int]:
+  """Yields new checkpoint steps as they appear (the reference's
+  continuous-eval driver, /root/reference/utils/train_eval.py:585-611)."""
+  seen = set()
+  start = time.time()
+  while True:
+    step = latest_step(directory)
+    if step is not None and step not in seen:
+      seen.add(step)
+      yield step
+      if min_interval_secs:
+        time.sleep(min_interval_secs)
+      continue
+    if (total_timeout_secs is not None
+        and time.time() - start > total_timeout_secs):
+      return
+    time.sleep(timeout_secs)
+
+
+def backup_checkpoint(directory: str, step: int,
+                      backup_root: Optional[str] = None,
+                      max_attempts: int = 3) -> Optional[str]:
+  """Copies a checkpoint out of GC's reach before a long eval (reference
+  create_backup_checkpoint_for_eval + retrying save_copy,
+  /root/reference/utils/train_eval.py:616-733). Retries if the writer
+  races us; returns the backup path or None."""
+  src = os.path.join(directory, str(step))
+  backup_root = backup_root or os.path.join(directory, "eval_backup")
+  dst = os.path.join(backup_root, str(step))
+  for attempt in range(max_attempts):
+    try:
+      if os.path.isdir(dst):
+        shutil.rmtree(dst)
+      os.makedirs(backup_root, exist_ok=True)
+      shutil.copytree(src, dst, copy_function=_link_or_copy)
+      return dst
+    except (FileNotFoundError, shutil.Error, OSError):
+      if attempt == max_attempts - 1:
+        return None
+      time.sleep(0.5 * (attempt + 1))
+  return None
+
+
+def _link_or_copy(src: str, dst: str) -> None:
+  try:
+    os.link(src, dst)
+  except OSError:
+    shutil.copy2(src, dst)

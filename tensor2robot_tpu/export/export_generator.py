@@ -1,0 +1,184 @@
+"""Export generators: hermetic serving bundles.
+
+Reference surface: `AbstractExportGenerator` / `DefaultExportGenerator`
+(/root/reference/export_generators/abstract_export_generator.py:38-142,
+default_export_generator.py:42-133) produce SavedModels with numpy and
+tf_example serving receivers plus a `t2r_assets` sidecar, so robot-side
+predictors can feed the model without knowing anything about it.
+
+TPU-native bundle layout (`<base>/<version>/`):
+* `t2r_assets.json`   — feature/label specs + global_step (hermetic feeds);
+* `signature.json`    — model configurable name, output keys, flags;
+* `operative_config.gin` — config to reconstruct the model object;
+* `params/`           — orbax checkpoint of eval-time variables (EMA
+                        shadow params when enabled — the reference's
+                        swapping-saver export semantics);
+* `saved_model/`      — optional jax2tf TF SavedModel with a numpy
+                        (dense-feed) signature for TF-Serving parity.
+
+The pure-JAX path (assets + params + config) is primary: a predictor
+rebuilds the model, restores params, and jits `predict` — no TF runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["AbstractExportGenerator", "DefaultExportGenerator",
+           "SIGNATURE_FILENAME", "PARAMS_DIRNAME"]
+
+SIGNATURE_FILENAME = "signature.json"
+PARAMS_DIRNAME = "params"
+SAVED_MODEL_DIRNAME = "saved_model"
+
+
+class AbstractExportGenerator:
+  """Holds model specs; produces timestamped export bundles."""
+
+  def __init__(self, export_raw_receivers: bool = False):
+    # Raw mode skips the preprocessor in serving — clients preprocess
+    # (reference abstract_export_generator.py:47-48).
+    self._export_raw_receivers = export_raw_receivers
+    self._model = None
+
+  def set_specification_from_model(self, model) -> None:
+    self._model = model
+
+  def _serving_feature_spec(self) -> specs_lib.SpecStruct:
+    if self._model is None:
+      raise ValueError("Call set_specification_from_model first.")
+    if self._export_raw_receivers:
+      return specs_lib.flatten_spec_structure(
+          self._model.get_feature_specification(modes_lib.PREDICT))
+    return self._model.preprocessor.get_in_feature_specification(
+        modes_lib.PREDICT)
+
+  def export(self, state, export_dir_base: str,
+             global_step: Optional[int] = None) -> str:
+    raise NotImplementedError
+
+
+@config.configurable
+class DefaultExportGenerator(AbstractExportGenerator):
+  """Writes the pure-JAX bundle (+ optional jax2tf SavedModel)."""
+
+  def __init__(self, export_raw_receivers: bool = False,
+               write_saved_model: bool = False):
+    super().__init__(export_raw_receivers=export_raw_receivers)
+    self._write_saved_model = write_saved_model
+
+  def export(self, state, export_dir_base: str,
+             global_step: Optional[int] = None) -> str:
+    model = self._model
+    if model is None:
+      raise ValueError("Call set_specification_from_model first.")
+    version = str(int(time.time() * 1e6))  # strictly increasing versions
+    path = os.path.join(export_dir_base, version)
+    os.makedirs(path, exist_ok=True)
+
+    step = int(global_step if global_step is not None else state.step)
+    feature_spec = self._serving_feature_spec()
+    label_spec = specs_lib.flatten_spec_structure(
+        model.get_label_specification(modes_lib.PREDICT))
+    specs_lib.write_assets(
+        specs_lib.Assets(feature_spec=feature_spec, label_spec=label_spec,
+                         global_step=step),
+        os.path.join(path, specs_lib.ASSET_FILENAME))
+
+    # Eval-time variables: EMA shadow when enabled (swapping saver).
+    variables = {"params": state.eval_params(use_ema=True),
+                 "mutable": state.mutable_state}
+    checkpointer = ocp.StandardCheckpointer()
+    checkpointer.save(os.path.join(path, PARAMS_DIRNAME), variables)
+    checkpointer.wait_until_finished()
+    checkpointer.close()
+
+    outputs = self._infer_output_keys(model, state, feature_spec)
+    signature = {
+        "model_configurable": getattr(type(model), "_configurable_name",
+                                      type(model).__name__),
+        "model_class": f"{type(model).__module__}.{type(model).__qualname__}",
+        "outputs": outputs,
+        "raw_receivers": self._export_raw_receivers,
+        "global_step": step,
+    }
+    with open(os.path.join(path, SIGNATURE_FILENAME), "w") as f:
+      json.dump(signature, f, indent=2)
+    with open(os.path.join(path, "operative_config.gin"), "w") as f:
+      f.write(config.operative_config_str())
+
+    if self._write_saved_model:
+      self._export_saved_model(model, state, feature_spec,
+                               os.path.join(path, SAVED_MODEL_DIRNAME))
+    return path
+
+  def _predict_with_preprocess(self, model):
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    predict = ts.make_predict_fn(model)
+    raw = self._export_raw_receivers
+
+    def fn(state, features):
+      if not raw:
+        features, _ = model.preprocessor.preprocess(
+            features, specs_lib.SpecStruct(), modes_lib.PREDICT)
+      return predict(state, features)
+
+    return fn
+
+  def _infer_output_keys(self, model, state, feature_spec) -> List[str]:
+    sample = specs_lib.make_random_numpy(feature_spec, batch_size=1, seed=0)
+    try:
+      outputs = self._predict_with_preprocess(model)(state, sample)
+      return sorted(outputs.keys())
+    except Exception:  # noqa: BLE001 - export must not die on signature probe
+      from absl import logging
+
+      logging.exception(
+          "Could not infer serving output keys for %s; the exported "
+          "bundle's predict path is likely broken.", type(model).__name__)
+      return []
+
+  def _export_saved_model(self, model, state, feature_spec,
+                          saved_model_dir: str) -> None:
+    """jax2tf SavedModel with a dense numpy-feed signature whose input
+    names are the spec `name`s (robot-side feed compatibility,
+    SURVEY.md §7 hard parts)."""
+    import tensorflow as tf
+    from jax.experimental import jax2tf
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    predict = ts.make_predict_fn(model)
+    host_state = jax.device_get(state)
+    flat_spec = specs_lib.filter_required(feature_spec)
+    keys = list(flat_spec.keys())
+
+    def jax_fn(*arrays):
+      features = specs_lib.SpecStruct()
+      for key, array in zip(keys, arrays):
+        features[key] = array
+      return dict(predict(host_state, features).items())
+
+    tf_fn = jax2tf.convert(jax_fn, with_gradient=False)
+    signature_inputs = [
+        tf.TensorSpec([None] + [d or 1 for d in flat_spec[k].shape],
+                      tf.dtypes.as_dtype(np.dtype(flat_spec[k].dtype).name),
+                      name=(flat_spec[k].name or k).replace("/", "_"))
+        for k in keys]
+    module = tf.Module()
+    module.fn = tf.function(tf_fn, input_signature=signature_inputs,
+                            autograph=False)
+    tf.saved_model.save(module, saved_model_dir)
+
+

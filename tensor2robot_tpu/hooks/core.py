@@ -1,0 +1,225 @@
+"""Training hooks: the callback protocol replacing Estimator SessionRunHooks.
+
+Reference surface: `HookBuilder` ABC
+(/root/reference/hooks/hook_builder.py:27-43), gin operative-config logger
+(gin_config_hook_builder.py:28-55), golden-values recorder
+(golden_values_hook_builder.py:37-79), variable stats logger
+(variable_logger_hook.py:27-62), and the async checkpoint->export
+listeners (checkpoint_hooks.py:51-201, async_export_hook_builder.py:
+87-134) including the one-version-lagged export dir used by TD3 target
+networks.
+
+Here a Hook is a plain object with lifecycle callbacks driven by the
+train loop; builders are gin-configurables producing hook lists.
+"""
+
+from __future__ import annotations
+
+import abc
+import glob
+import os
+import shutil
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+import jax
+import numpy as np
+
+from tensor2robot_tpu.utils import config
+
+__all__ = ["Hook", "HookBuilder", "ConfigSaverHook", "GoldenValuesHook",
+           "VariableLoggerHook", "ExportHook", "DefaultHookBuilder",
+           "AsyncExportHookBuilder", "add_golden_outputs"]
+
+
+class TrainContext:
+  """What hooks see: model, dirs, and accessors into the live loop."""
+
+  def __init__(self, model, model_dir: str,
+               get_state: Callable[[], Any],
+               summary_writer=None, mesh=None):
+    self.model = model
+    self.model_dir = model_dir
+    self.get_state = get_state
+    self.summary_writer = summary_writer
+    self.mesh = mesh
+
+
+class Hook:
+  def begin(self, ctx: TrainContext) -> None:
+    pass
+
+  def after_step(self, ctx: TrainContext, step: int,
+                 metrics: Mapping[str, Any]) -> None:
+    pass
+
+  def after_checkpoint(self, ctx: TrainContext, step: int) -> None:
+    pass
+
+  def after_eval(self, ctx: TrainContext, step: int,
+                 metrics: Mapping[str, Any]) -> None:
+    pass
+
+  def end(self, ctx: TrainContext) -> None:
+    pass
+
+
+class HookBuilder(abc.ABC):
+  """Gin-configurable factory of hooks (reference hook_builder.py:27-43)."""
+
+  @abc.abstractmethod
+  def create_hooks(self, model, model_dir: str) -> List[Hook]:
+    ...
+
+
+@config.configurable
+class ConfigSaverHook(Hook):
+  """Writes the operative config to model_dir at train begin (reference
+  GinConfigSaverHook, /root/reference/models/abstract_model.py:772-775)."""
+
+  def __init__(self, filename: str = "operative_config-0.gin"):
+    self._filename = filename
+
+  def begin(self, ctx: TrainContext) -> None:
+    os.makedirs(ctx.model_dir, exist_ok=True)
+    with open(os.path.join(ctx.model_dir, self._filename), "w") as f:
+      f.write(config.operative_config_str())
+
+
+_GOLDEN_REGISTRY: Dict[str, Callable] = {}
+
+
+def add_golden_outputs(name: str, fn: Callable) -> None:
+  """Registers a golden-value producer: fn(state) -> dict of arrays
+  (reference collection-based add_golden_tensor,
+  /root/reference/hooks/golden_values_hook_builder.py:37-39)."""
+  _GOLDEN_REGISTRY[name] = fn
+
+
+@config.configurable
+class GoldenValuesHook(Hook):
+  """Saves registered golden values + final predict outputs on a fixed
+  batch to `golden_values.npy` at train end; guards the
+  data->train->checkpoint pipeline against silent regressions."""
+
+  def __init__(self, batch_fn: Optional[Callable] = None,
+               filename: str = "golden_values.npy"):
+    self._batch_fn = batch_fn
+    self._filename = filename
+
+  def end(self, ctx: TrainContext) -> None:
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    values: Dict[str, np.ndarray] = {}
+    state = ctx.get_state()
+    for name, fn in _GOLDEN_REGISTRY.items():
+      out = fn(state)
+      for key, value in out.items():
+        values[f"{name}/{key}"] = np.asarray(value)
+    if self._batch_fn is not None:
+      predict = ts.make_predict_fn(ctx.model)
+      outputs = predict(state, self._batch_fn())
+      for key, value in outputs.items():
+        values[f"predict/{key}"] = np.asarray(value)
+    path = os.path.join(ctx.model_dir, self._filename)
+    os.makedirs(ctx.model_dir, exist_ok=True)
+    np.save(path, values, allow_pickle=True)
+
+
+@config.configurable
+class VariableLoggerHook(Hook):
+  """Logs parameter counts and per-leaf norms (reference
+  variable_logger_hook.py:27-62)."""
+
+  def __init__(self, every_n_steps: int = 100, max_num_variables: int = 50):
+    self._every_n_steps = every_n_steps
+    self._max = max_num_variables
+
+  def after_step(self, ctx, step, metrics) -> None:
+    if step % self._every_n_steps:
+      return
+    from absl import logging
+
+    state = ctx.get_state()
+    leaves = jax.tree_util.tree_leaves_with_path(state.params)
+    total = sum(int(np.prod(l.shape)) for _, l in leaves)
+    logging.info("step %d: %d params in %d arrays", step, total, len(leaves))
+    for path, leaf in leaves[:self._max]:
+      logging.info("  %s %s |x|=%.4f", jax.tree_util.keystr(path),
+                   tuple(leaf.shape), float(jax.numpy.linalg.norm(leaf)))
+
+
+@config.configurable
+class ExportHook(Hook):
+  """Exports a serving bundle after each checkpoint, GCs old exports, and
+  optionally maintains a one-version-lagged directory (reference
+  CheckpointExportListener + LaggedCheckpointListener,
+  /root/reference/hooks/checkpoint_hooks.py:51-201; TD3 target networks
+  read the lagged dir)."""
+
+  def __init__(self,
+               export_generator=None,
+               export_dir_name: str = "export",
+               num_versions: int = 3,
+               lagged_export_dir_name: Optional[str] = None):
+    self._export_generator = export_generator
+    self._export_dir_name = export_dir_name
+    self._num_versions = num_versions
+    self._lagged_dir_name = lagged_export_dir_name
+
+  def begin(self, ctx: TrainContext) -> None:
+    if self._export_generator is not None:
+      self._export_generator.set_specification_from_model(ctx.model)
+
+  def after_checkpoint(self, ctx: TrainContext, step: int) -> None:
+    if self._export_generator is None:
+      return
+    base = os.path.join(ctx.model_dir, self._export_dir_name)
+    previous = _numeric_subdirs(base)
+    path = self._export_generator.export(
+        ctx.get_state(), base, global_step=step)
+    if self._lagged_dir_name and previous:
+      lagged_base = os.path.join(ctx.model_dir, self._lagged_dir_name)
+      lagged_target = os.path.join(lagged_base, os.path.basename(previous[-1]))
+      if not os.path.isdir(lagged_target):
+        os.makedirs(lagged_base, exist_ok=True)
+        shutil.copytree(previous[-1], lagged_target)
+        for old in _numeric_subdirs(lagged_base)[:-self._num_versions]:
+          shutil.rmtree(old, ignore_errors=True)
+    for old in _numeric_subdirs(base)[:-self._num_versions]:
+      shutil.rmtree(old, ignore_errors=True)
+    return path
+
+
+def _numeric_subdirs(base: str) -> List[str]:
+  if not os.path.isdir(base):
+    return []
+  dirs = [os.path.join(base, d) for d in os.listdir(base)
+          if d.isdigit() and os.path.isdir(os.path.join(base, d))]
+  return sorted(dirs, key=lambda p: int(os.path.basename(p)))
+
+
+@config.configurable
+class DefaultHookBuilder(HookBuilder):
+  """Config saver + variable logger (the reference's default hook set)."""
+
+  def create_hooks(self, model, model_dir):
+    return [ConfigSaverHook(), VariableLoggerHook()]
+
+
+@config.configurable
+class AsyncExportHookBuilder(HookBuilder):
+  """Checkpoint-triggered export with GC (reference
+  async_export_hook_builder.py:87-134)."""
+
+  def __init__(self, export_generator=None, num_versions: int = 3,
+               lagged: bool = False):
+    self._export_generator = export_generator
+    self._num_versions = num_versions
+    self._lagged = lagged
+
+  def create_hooks(self, model, model_dir):
+    return [ExportHook(
+        export_generator=self._export_generator,
+        num_versions=self._num_versions,
+        lagged_export_dir_name="lagged_export" if self._lagged else None)]

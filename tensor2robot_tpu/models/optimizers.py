@@ -23,7 +23,6 @@ __all__ = [
     "create_piecewise_linear_learning_rate",
     "create_adam_optimizer", "create_sgd_optimizer",
     "create_momentum_optimizer", "create_rms_prop_optimizer",
-    "with_ema", "EmaState",
 ]
 
 
@@ -132,29 +131,7 @@ def create_rms_prop_optimizer(learning_rate: Any = 1e-4,
                  gradient_clip_norm)
 
 
-# -- EMA (MovingAverageOptimizer + swapping-saver semantics) -----------------
-
-
-class EmaState(NamedTuple):
-  ema_params: Any
-
-
-def with_ema(decay: float = 0.9999):
-  """Returns an `update_ema(ema_state, params)` pair of helpers.
-
-  The reference keeps shadow moving-average variables and swaps them in at
-  checkpoint-save/eval time (swapping saver,
-  /root/reference/models/optimizers.py:132-159). Here the shadow params
-  live in the train state; `train_eval` swaps them in for eval/export when
-  the model requests it.
-  """
-
-  def init(params) -> EmaState:
-    return EmaState(ema_params=jax.tree_util.tree_map(jnp.asarray, params))
-
-  def update(state: EmaState, params) -> EmaState:
-    new_ema = jax.tree_util.tree_map(
-        lambda e, p: e * decay + (1.0 - decay) * p, state.ema_params, params)
-    return EmaState(ema_params=new_ema)
-
-  return init, update
+# EMA note: the reference's MovingAverageOptimizer + swapping saver
+# (/root/reference/models/optimizers.py:132-159) maps to the `ema_params`
+# field of parallel.train_step.TrainState — updated inside the jitted step
+# and swapped in by `TrainState.eval_params` at eval/export time.

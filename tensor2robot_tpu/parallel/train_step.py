@@ -244,9 +244,7 @@ def make_eval_step(model,
   return jax.jit(eval_fn, in_shardings=(shardings, batch_ns, batch_ns))
 
 
-def make_predict_fn(model,
-                    mesh: Optional[Mesh] = None,
-                    use_ema: bool = True) -> Callable:
+def make_predict_fn(model, use_ema: bool = True) -> Callable:
   """Jitted predict: (state, features) -> export outputs (the PREDICT
   branch + create_export_outputs_fn,
   /root/reference/models/abstract_model.py:714-736)."""

@@ -104,12 +104,17 @@ def random_flip_left_right(key: jax.Array,
 
 # -- photometric distortions (YIQ linear colour algebra) --------------------
 
-_RGB_TO_YIQ = jnp.array([[0.299, 0.587, 0.114],
+# numpy (not jnp) so importing this module never initializes the JAX
+# backend — multi-host bring-up requires jax.distributed.initialize to
+# run before any backend use.
+import numpy as _np
+
+_RGB_TO_YIQ = _np.array([[0.299, 0.587, 0.114],
                          [0.596, -0.274, -0.322],
-                         [0.211, -0.523, 0.312]], dtype=jnp.float32)
-_YIQ_TO_RGB = jnp.array([[1.0, 0.956, 0.621],
+                         [0.211, -0.523, 0.312]], dtype=_np.float32)
+_YIQ_TO_RGB = _np.array([[1.0, 0.956, 0.621],
                          [1.0, -0.272, -0.647],
-                         [1.0, -1.106, 1.703]], dtype=jnp.float32)
+                         [1.0, -1.106, 1.703]], dtype=_np.float32)
 
 
 def _per_image_uniform(key, batch, low, high):

@@ -1,0 +1,335 @@
+"""Train/eval orchestration: the single entry point for training,
+evaluation, continuous evaluation and batch prediction.
+
+Re-design of the reference's `train_eval_model`
+(/root/reference/utils/train_eval.py:423-613): instead of assembling
+TrainSpec/EvalSpec around a (TPU)Estimator, this drives an explicit SPMD
+step loop over a device mesh with async orbax checkpointing, callback
+hooks, periodic in-loop eval and checkpoint-triggered exports. The
+auto-TPU-wrap (reference :477-480) disappears: the same jitted step runs
+on any backend; bfloat16 is a model policy, not a wrapper class.
+
+Capability map:
+* train / evaluate / train_and_evaluate / continuous_eval modes;
+* input-generator spec filling from the model (reference :97-128);
+* auto-resume from the latest checkpoint in model_dir;
+* crash-safe checkpoint backup before long evals (reference :616-684);
+* exporters attached to eval (reference create_default_exporters
+  :295-386) via ExportHook/export generators;
+* `predict_from_model` batch offline inference (reference :389-420).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+from absl import logging
+
+from tensor2robot_tpu import checkpoints as checkpoints_lib
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils import summaries as summaries_lib
+
+__all__ = ["train_eval_model", "predict_from_model",
+           "provide_input_generator_with_model_information",
+           "print_specification"]
+
+CHECKPOINT_DIRNAME = "checkpoints"
+
+
+def provide_input_generator_with_model_information(
+    input_generator, model, mode: str):
+  """Injects the model's (preprocessor) specs + preprocess fn into an
+  input generator (reference :97-128)."""
+  input_generator.set_specification_from_model(model, mode)
+  return input_generator
+
+
+def print_specification(model) -> None:
+  """Debug dump of all six specs (reference :73-94)."""
+  for mode in (modes_lib.TRAIN, modes_lib.EVAL):
+    for name, getter in (
+        ("in_feature", model.preprocessor.get_in_feature_specification),
+        ("in_label", model.preprocessor.get_in_label_specification),
+        ("out_feature", model.preprocessor.get_out_feature_specification),
+        ("out_label", model.preprocessor.get_out_label_specification)):
+      logging.info("%s %s specification:", mode, name)
+      for key, spec in getter(mode).items():
+        logging.info("  %s: %r", key, spec)
+
+
+def _device_batch(mesh, batch):
+  features = mesh_lib.put_host_batch(mesh, batch["features"])
+  labels = (mesh_lib.put_host_batch(mesh, batch["labels"])
+            if "labels" in batch else specs_lib.SpecStruct())
+  return features, labels
+
+
+def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int):
+  """Runs eval_steps batches, averaging metric scalars."""
+  totals: dict = {}
+  count = 0
+  for _ in range(eval_steps):
+    try:
+      batch = next(dataset)
+    except StopIteration:
+      break
+    features, labels = _device_batch(mesh, batch)
+    metrics = eval_step(state, features, labels)
+    for key, value in metrics.items():
+      totals[key] = totals.get(key, 0.0) + float(np.asarray(value))
+    count += 1
+  return {k: v / max(count, 1) for k, v in totals.items()}
+
+
+@config.configurable
+def train_eval_model(
+    model=config.REQUIRED,
+    model_dir: str = config.REQUIRED,
+    mode: str = "train_and_evaluate",
+    max_train_steps: int = 1000,
+    eval_steps: int = 100,
+    eval_every_n_steps: int = 500,
+    checkpoint_every_n_steps: int = 500,
+    keep_checkpoints: int = 5,
+    input_generator_train=None,
+    input_generator_eval=None,
+    hook_builders: Optional[Sequence[hooks_lib.HookBuilder]] = None,
+    export_generators: Optional[Sequence] = None,
+    export_num_versions: int = 3,
+    mesh=None,
+    mesh_shape: Optional[Sequence[int]] = None,
+    partition_rules=None,
+    seed: int = 0,
+    continuous_eval_timeout_secs: Optional[float] = None,
+    use_ema_for_eval: bool = True,
+    log_every_n_steps: int = 100,
+) -> dict:
+  """Runs the requested mode; returns final metrics."""
+  if mode not in ("train", "evaluate", "train_and_evaluate",
+                  "continuous_eval"):
+    raise ValueError(f"Unknown train_eval mode {mode!r}")
+  os.makedirs(model_dir, exist_ok=True)
+  if mesh is None:
+    mesh = mesh_lib.create_mesh(mesh_shape=mesh_shape)
+  print_specification(model)
+
+  writer = summaries_lib.SummaryWriter(os.path.join(model_dir,
+                                                    "train" if "train" in mode
+                                                    else "eval"))
+  hooks: List[hooks_lib.Hook] = []
+  for builder in hook_builders or []:
+    hooks.extend(builder.create_hooks(model, model_dir))
+  for gen in export_generators or []:
+    hooks.append(hooks_lib.ExportHook(export_generator=gen,
+                                      num_versions=export_num_versions))
+
+  manager = checkpoints_lib.CheckpointManager(
+      os.path.join(model_dir, CHECKPOINT_DIRNAME),
+      max_to_keep=keep_checkpoints,
+      save_interval_steps=1)
+
+  # -- data + state bring-up -----------------------------------------------
+  needs_train = mode in ("train", "train_and_evaluate")
+  needs_eval = mode != "train"
+  train_dataset = eval_dataset = None
+  if needs_train:
+    if input_generator_train is None:
+      raise ValueError("input_generator_train is required for training.")
+    provide_input_generator_with_model_information(
+        input_generator_train, model, modes_lib.TRAIN)
+    train_dataset = input_generator_train.create_dataset(modes_lib.TRAIN)
+  if needs_eval:
+    if input_generator_eval is None:
+      raise ValueError("input_generator_eval is required for evaluation.")
+    provide_input_generator_with_model_information(
+        input_generator_eval, model, modes_lib.EVAL)
+
+  if train_dataset is not None:
+    first_batch = next(train_dataset)
+    sample_features = first_batch["features"]
+  else:
+    # Eval-only modes: synthesize an init batch from the preprocessor's
+    # out-specs instead of spinning up (and leaking) a data pipeline.
+    first_batch = None
+    sample_features = specs_lib.make_random_numpy(
+        model.preprocessor.get_out_feature_specification(modes_lib.EVAL),
+        batch_size=input_generator_eval.batch_size, seed=seed)
+
+  state, shardings = ts.create_train_state(
+      model, jax.random.PRNGKey(seed), sample_features, mesh=mesh,
+      rules=partition_rules)
+  restored_step = manager.latest_step()
+  if restored_step is not None:
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state)
+    state = manager.restore(restored_step, abstract_state=abstract)
+    logging.info("Resumed from checkpoint step %d", restored_step)
+
+  ctx = hooks_lib.TrainContext(model, model_dir,
+                               get_state=lambda: state,
+                               summary_writer=writer, mesh=mesh)
+  for hook in hooks:
+    hook.begin(ctx)
+
+  final_metrics: dict = {}
+  saved_steps = set(manager.all_steps())
+
+  def _checkpoint(step: int, force: bool = False) -> None:
+    if step in saved_steps:
+      return
+    if manager.save(step, state, force=force):
+      saved_steps.add(step)
+      for hook in hooks:
+        hook.after_checkpoint(ctx, step)
+
+  # -- evaluate-only modes --------------------------------------------------
+  if mode == "evaluate":
+    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  use_ema=use_ema_for_eval)
+    eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+    final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                              eval_steps)
+    writer.write_scalars(int(state.step), final_metrics)
+    for hook in hooks:
+      hook.after_eval(ctx, int(state.step), final_metrics)
+      hook.end(ctx)
+    manager.close()
+    writer.close()
+    return final_metrics
+
+  if mode == "continuous_eval":
+    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  use_ema=use_ema_for_eval)
+    ckpt_dir = os.path.join(model_dir, CHECKPOINT_DIRNAME)
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=x.sharding), state)
+    for step in checkpoints_lib.checkpoints_iterator(
+        ckpt_dir, timeout_secs=5.0,
+        total_timeout_secs=continuous_eval_timeout_secs):
+      # Copy the checkpoint out of the writer's GC reach, restore from the
+      # copy, delete it when the eval is done (reference :616-684).
+      backup = checkpoints_lib.backup_checkpoint(ckpt_dir, step)
+      try:
+        if backup is not None:
+          backup_manager = checkpoints_lib.CheckpointManager(
+              os.path.dirname(backup), async_checkpointing=False)
+          state = backup_manager.restore(step, abstract_state=abstract)
+          backup_manager.close()
+        else:
+          state = manager.restore(step, abstract_state=abstract)
+        eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+        final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                                  eval_steps)
+      finally:
+        if backup is not None:
+          import shutil
+
+          shutil.rmtree(backup, ignore_errors=True)
+      writer.write_scalars(step, final_metrics)
+      for hook in hooks:
+        hook.after_eval(ctx, step, final_metrics)
+      logging.info("continuous eval @%d: %s", step, final_metrics)
+      if step >= max_train_steps:
+        break
+    for hook in hooks:
+      hook.end(ctx)
+    manager.close()
+    writer.close()
+    return final_metrics
+
+  # -- training loop --------------------------------------------------------
+  train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings)
+  eval_step = None
+  if mode == "train_and_evaluate":
+    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  use_ema=use_ema_for_eval)
+
+  step = int(state.step)
+  batch = first_batch
+  last_log = time.time()
+  while step < max_train_steps:
+    features, labels = _device_batch(mesh, batch)
+    state, metrics = train_step(state, features, labels)
+    step += 1
+    for hook in hooks:
+      hook.after_step(ctx, step, metrics)
+    if step % log_every_n_steps == 0 or step == max_train_steps:
+      scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+      writer.write_scalars(step, scalars)
+      now = time.time()
+      logging.info("step %d: loss=%.5f (%.1f steps/s)", step,
+                   scalars.get("loss", float("nan")),
+                   log_every_n_steps / max(now - last_log, 1e-6))
+      last_log = now
+      final_metrics = scalars
+    if step % checkpoint_every_n_steps == 0:
+      _checkpoint(step)
+    if eval_step is not None and (step % eval_every_n_steps == 0
+                                  or step == max_train_steps):
+      eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+      eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                               eval_steps)
+      writer.write_scalars(step, {f"eval/{k}": v
+                                  for k, v in eval_metrics.items()})
+      for hook in hooks:
+        hook.after_eval(ctx, step, eval_metrics)
+      logging.info("eval @%d: %s", step, eval_metrics)
+      final_metrics.update({f"eval/{k}": v for k, v in eval_metrics.items()})
+    if step < max_train_steps:
+      batch = next(train_dataset)
+
+  _checkpoint(step, force=True)
+  for hook in hooks:
+    hook.end(ctx)
+  manager.wait_until_finished()
+  manager.close()
+  writer.close()
+  return final_metrics
+
+
+@config.configurable
+def predict_from_model(
+    model=config.REQUIRED,
+    model_dir: str = config.REQUIRED,
+    input_generator=None,
+    num_batches: int = 1,
+    checkpoint_step: Optional[int] = None,
+    use_ema: bool = True) -> List[dict]:
+  """Batch offline inference from the latest (or given) checkpoint
+  (reference predict_from_model, :389-420)."""
+  if input_generator is None:
+    raise ValueError("input_generator is required.")
+  provide_input_generator_with_model_information(
+      input_generator, model, modes_lib.PREDICT)
+  dataset = input_generator.create_dataset(modes_lib.PREDICT)
+  first = next(dataset)
+  state, _ = ts.create_train_state(
+      model, jax.random.PRNGKey(0), first["features"])
+  manager = checkpoints_lib.CheckpointManager(
+      os.path.join(model_dir, CHECKPOINT_DIRNAME))
+  abstract = jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+  state = manager.restore(checkpoint_step, abstract_state=abstract)
+  manager.close()
+  predict = ts.make_predict_fn(model, use_ema=use_ema)
+  outputs = []
+  batch = first
+  for i in range(num_batches):
+    outputs.append(jax.device_get(predict(state, batch["features"])))
+    if i + 1 < num_batches:
+      try:
+        batch = next(dataset)
+      except StopIteration:
+        break
+  return outputs

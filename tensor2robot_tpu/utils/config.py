@@ -73,8 +73,22 @@ class _ConfigurableReference:
     self.evaluate = evaluate
 
   def resolve(self) -> Any:
-    fn = get_configurable(self.name)
-    return fn() if self.evaluate else fn
+    scope = ""
+    name = self.name
+    if "/" in name:
+      scope, name = name.rsplit("/", 1)
+    fn = get_configurable(name)
+    if self.evaluate:
+      with config_scope(scope):
+        return fn()
+    if scope:
+      @functools.wraps(fn)
+      def scoped(*args, **kwargs):
+        with config_scope(scope):
+          return fn(*args, **kwargs)
+
+      return scoped
+    return fn
 
   def __repr__(self):
     return f"@{self.name}" + ("()" if self.evaluate else "")

@@ -1,0 +1,57 @@
+"""Scalar/metric logging.
+
+The reference relies on tf.summary + TPU host_call plumbing
+(/root/reference/models/abstract_model.py:873-936); here metrics are
+written to a JSONL events file (always) and mirrored to TensorBoard event
+files when TensorFlow is importable. JSONL is the source of truth: cheap,
+append-only, greppable, no runtime dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Mapping, Optional
+
+import numpy as np
+
+__all__ = ["SummaryWriter"]
+
+
+class SummaryWriter:
+  def __init__(self, log_dir: str, use_tensorboard: bool = True):
+    os.makedirs(log_dir, exist_ok=True)
+    self._path = os.path.join(log_dir, "metrics.jsonl")
+    self._file = open(self._path, "a")
+    self._tb = None
+    if use_tensorboard:
+      try:
+        import tensorflow as tf  # heavyweight; optional mirror only
+
+        self._tb = tf.summary.create_file_writer(log_dir)
+      except Exception:  # pragma: no cover - TF missing or broken
+        self._tb = None
+
+  @property
+  def path(self) -> str:
+    return self._path
+
+  def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+    record = {"step": int(step), "time": time.time()}
+    for key, value in scalars.items():
+      record[key] = float(np.asarray(value))
+    self._file.write(json.dumps(record) + "\n")
+    self._file.flush()
+    if self._tb is not None:
+      with self._tb.as_default():
+        import tensorflow as tf
+
+        for key, value in scalars.items():
+          tf.summary.scalar(key, float(np.asarray(value)), step=int(step))
+        self._tb.flush()
+
+  def close(self) -> None:
+    self._file.close()
+    if self._tb is not None:
+      self._tb.close()

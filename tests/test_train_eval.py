@@ -1,0 +1,177 @@
+"""End-to-end train/eval loop tests — the JAX twin of the reference's
+integration tests (/root/reference/utils/train_eval_test.py:87-120)."""
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import checkpoints as checkpoints_lib
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.export import export_generator as export_lib
+from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.utils import config, mocks
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+def _assert_output_files(model_dir):
+  """Reference assert_output_files
+  (/root/reference/utils/train_eval_test_utils.py:26-63)."""
+  assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
+  assert checkpoints_lib.latest_step(
+      os.path.join(model_dir, "checkpoints")) is not None
+  assert os.path.isfile(os.path.join(model_dir, "operative_config-0.gin"))
+  assert glob.glob(os.path.join(model_dir, "train", "metrics.jsonl"))
+
+
+class TestTrainEval:
+
+  def _model(self, **kwargs):
+    return mocks.MockT2RModel(device_type="cpu", **kwargs)
+
+  def test_train_and_evaluate_end_to_end(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    metrics = train_eval.train_eval_model(
+        model=self._model(),
+        model_dir=model_dir,
+        mode="train_and_evaluate",
+        max_train_steps=120,
+        eval_steps=4,
+        eval_every_n_steps=60,
+        checkpoint_every_n_steps=60,
+        input_generator_train=mocks.MockInputGenerator(batch_size=16),
+        input_generator_eval=mocks.MockInputGenerator(batch_size=16),
+        hook_builders=[hooks_lib.DefaultHookBuilder()],
+        log_every_n_steps=20)
+    _assert_output_files(model_dir)
+    assert "eval/accuracy" in metrics
+    assert metrics["eval/accuracy"] > 0.8
+    # metrics.jsonl has train + eval rows
+    rows = [json.loads(l) for l in open(
+        os.path.join(model_dir, "train", "metrics.jsonl"))]
+    assert any("loss" in r for r in rows)
+    assert any("eval/accuracy" in r for r in rows)
+
+  def test_resume_from_checkpoint(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    common = dict(
+        model_dir=model_dir,
+        mode="train",
+        checkpoint_every_n_steps=50,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        log_every_n_steps=50)
+    train_eval.train_eval_model(model=self._model(), max_train_steps=50,
+                                **common)
+    assert checkpoints_lib.latest_step(
+        os.path.join(model_dir, "checkpoints")) == 50
+    # second invocation resumes and continues to 100
+    train_eval.train_eval_model(model=self._model(), max_train_steps=100,
+                                **common)
+    assert checkpoints_lib.latest_step(
+        os.path.join(model_dir, "checkpoints")) == 100
+
+  def test_evaluate_mode(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="train",
+        max_train_steps=60, checkpoint_every_n_steps=60,
+        input_generator_train=mocks.MockInputGenerator(batch_size=16),
+        log_every_n_steps=20)
+    metrics = train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="evaluate",
+        eval_steps=4,
+        input_generator_eval=mocks.MockInputGenerator(batch_size=16))
+    assert "accuracy" in metrics
+
+  def test_continuous_eval_with_timeout(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="train",
+        max_train_steps=40, checkpoint_every_n_steps=20,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        log_every_n_steps=20)
+    metrics = train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="continuous_eval",
+        max_train_steps=40, eval_steps=2,
+        continuous_eval_timeout_secs=1.0,
+        input_generator_eval=mocks.MockInputGenerator(batch_size=8))
+    assert "accuracy" in metrics
+
+  def test_export_hook_produces_bundles(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="train",
+        max_train_steps=40, checkpoint_every_n_steps=20,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        export_generators=[export_lib.DefaultExportGenerator()],
+        log_every_n_steps=20)
+    exports = sorted(glob.glob(os.path.join(model_dir, "export", "*")))
+    assert exports, "no export bundles written"
+    newest = exports[-1]
+    assert os.path.isfile(os.path.join(newest, "t2r_assets.json"))
+    assert os.path.isfile(os.path.join(newest, "signature.json"))
+    assert os.path.isdir(os.path.join(newest, "params"))
+    sig = json.load(open(os.path.join(newest, "signature.json")))
+    assert "prediction" in sig["outputs"]
+
+  def test_golden_values_hook(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    gen = mocks.MockInputGenerator(batch_size=8)
+
+    def batch_fn():
+      x, _ = mocks.make_separable_data(8, seed=7)
+      return {"x": x}
+
+    class GoldenBuilder(hooks_lib.HookBuilder):
+      def create_hooks(self, model, model_dir):
+        return [hooks_lib.GoldenValuesHook(batch_fn=batch_fn)]
+
+    train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="train",
+        max_train_steps=20, checkpoint_every_n_steps=20,
+        input_generator_train=gen,
+        hook_builders=[GoldenBuilder()],
+        log_every_n_steps=20)
+    golden = np.load(os.path.join(model_dir, "golden_values.npy"),
+                     allow_pickle=True).item()
+    assert "predict/prediction" in golden
+    assert golden["predict/prediction"].shape == (8, 1)
+
+  def test_predict_from_model(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=self._model(), model_dir=model_dir, mode="train",
+        max_train_steps=20, checkpoint_every_n_steps=20,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        log_every_n_steps=20)
+    outputs = train_eval.predict_from_model(
+        model=self._model(), model_dir=model_dir,
+        input_generator=mocks.MockInputGenerator(batch_size=8),
+        num_batches=2)
+    assert len(outputs) == 2
+    assert outputs[0]["prediction"].shape == (8, 1)
+
+  def test_ema_swap_for_eval(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    metrics = train_eval.train_eval_model(
+        model=self._model(use_ema=True, ema_decay=0.5),
+        model_dir=model_dir, mode="train_and_evaluate",
+        max_train_steps=60, eval_steps=2, eval_every_n_steps=60,
+        checkpoint_every_n_steps=60,
+        input_generator_train=mocks.MockInputGenerator(batch_size=16),
+        input_generator_eval=mocks.MockInputGenerator(batch_size=16),
+        log_every_n_steps=20)
+    assert "eval/accuracy" in metrics
+
+  def test_unknown_mode_raises(self, tmp_path):
+    with pytest.raises(ValueError, match="Unknown train_eval mode"):
+      train_eval.train_eval_model(
+          model=self._model(), model_dir=str(tmp_path), mode="banana")
