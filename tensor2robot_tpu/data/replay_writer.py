@@ -1,0 +1,44 @@
+"""Replay writers: stream episode transitions to TFRecord files.
+
+Reference: `TFRecordReplayWriter` (/root/reference/utils/writer.py:27-61)
+— actors write collected episodes as tf.Example records that the learner's
+input generators read back (the actor/learner decoupling of §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence
+
+from tensor2robot_tpu.data import codec, tfrecord
+
+__all__ = ["TFRecordReplayWriter"]
+
+
+class TFRecordReplayWriter:
+  """Writes transitions (flat dicts of numpy values) as Example records."""
+
+  def __init__(self, path: str, spec_structure=None):
+    self._writer = tfrecord.RecordWriter(path)
+    self._spec_structure = spec_structure
+
+  def write(self, transitions: Sequence[Any]) -> None:
+    """Writes a list of transitions; each is either a flat mapping of
+    values or pre-serialized bytes."""
+    for transition in transitions:
+      if isinstance(transition, bytes):
+        self._writer.write(transition)
+      else:
+        self._writer.write(
+            codec.encode_example(transition, self._spec_structure))
+
+  def flush(self) -> None:
+    self._writer.flush()
+
+  def close(self) -> None:
+    self._writer.close()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, *exc):
+    self.close()

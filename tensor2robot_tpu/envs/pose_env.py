@@ -1,0 +1,108 @@
+"""Toy visual pose/reach environment for end-to-end tests.
+
+Role of the reference's pybullet `PoseEnv`
+(/root/reference/research/pose_env/pose_env.py:51+): a cheap task whose
+episodes exercise the full robot loop (observe image + state, act with a
+continuous action, reward, replay writing) without simulator dependencies.
+pybullet is not available in this environment, so the task is a pure-numpy
+2D reach: a target dot is rendered into a grayscale image; the action is a
+2D position guess; reward is negative distance. Follows the gymnasium API.
+
+Also provides `RandomPolicy` (reference random_policy :35-48) and
+`episode_to_transitions` (reference episode_to_transitions.py:32-60).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PoseToyEnv", "RandomPolicy", "episode_to_transitions"]
+
+IMAGE_SIZE = 32
+
+
+class PoseToyEnv:
+  """2D reach: observe a rendered target, output its position."""
+
+  action_size = 2
+
+  def __init__(self, image_size: int = IMAGE_SIZE, episode_length: int = 1,
+               seed: Optional[int] = None):
+    self._image_size = image_size
+    self._episode_length = episode_length
+    self._rng = np.random.RandomState(seed)
+    self._target = np.zeros(2, np.float32)
+    self._t = 0
+
+  def _render(self) -> np.ndarray:
+    image = np.zeros((self._image_size, self._image_size, 1), np.uint8)
+    xy = ((self._target + 1.0) / 2.0 * (self._image_size - 1)).astype(int)
+    x, y = int(xy[0]), int(xy[1])
+    image[max(y - 1, 0):y + 2, max(x - 1, 0):x + 2, 0] = 255
+    return image
+
+  def _obs(self) -> Dict[str, np.ndarray]:
+    return {"image": self._render(),
+            "timestep": np.asarray(self._t, np.int64)}
+
+  def reset(self, seed: Optional[int] = None
+            ) -> Tuple[Dict[str, np.ndarray], Dict]:
+    if seed is not None:
+      self._rng = np.random.RandomState(seed)
+    self._target = self._rng.uniform(-0.9, 0.9, 2).astype(np.float32)
+    self._t = 0
+    return self._obs(), {"target": self._target.copy()}
+
+  def step(self, action: np.ndarray
+           ) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict]:
+    action = np.asarray(action, np.float32)
+    distance = float(np.linalg.norm(action - self._target))
+    reward = -distance
+    self._t += 1
+    terminated = self._t >= self._episode_length
+    return self._obs(), reward, terminated, False, {
+        "distance": distance, "target": self._target.copy()}
+
+
+class RandomPolicy:
+  """Uniform random actions (reference random_policy)."""
+
+  def __init__(self, action_size: int = 2, seed: Optional[int] = None):
+    self._action_size = action_size
+    self._rng = np.random.RandomState(seed)
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    return self._rng.uniform(-1, 1, self._action_size).astype(np.float32)
+
+  def sample_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    return self.select_action(obs)
+
+  def reset(self) -> None:
+    pass
+
+  def restore(self) -> bool:
+    return True
+
+  @property
+  def global_step(self) -> int:
+    return 0
+
+
+def episode_to_transitions(episode: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+  """Flattens one episode into per-step training examples: image bytes +
+  action + Monte-Carlo return (reference episode_to_transitions.py)."""
+  from tensor2robot_tpu.data import codec
+
+  transitions = []
+  rewards = [step["reward"] for step in episode]
+  for i, step in enumerate(episode):
+    mc_return = float(sum(rewards[i:]))
+    transitions.append({
+        "state/image": codec.encode_image(step["obs"]["image"], "png"),
+        "action/action": np.asarray(step["action"], np.float32),
+        "reward": np.asarray([mc_return], np.float32),
+    })
+  return transitions

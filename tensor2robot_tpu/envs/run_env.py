@@ -1,0 +1,141 @@
+"""Generic environment episode loop + continuous collect/eval driver.
+
+Reference surface:
+* `run_env` (/root/reference/research/dql_grasping_lib/run_env.py:76-235)
+  — episode loop with explore schedule, reward/Q summaries and replay
+  writing (the 1-10 Hz actor hot loop);
+* `collect_eval_loop`
+  (/root/reference/utils/continuous_collect_eval.py:28-108) — poll the
+  learner's exports for a new policy, run collect episodes, run eval
+  episodes, repeat until max steps.
+
+Envs follow the gymnasium 5-tuple step API; policies are
+`tensor2robot_tpu.policies` objects (select_action/reset/restore).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+from absl import logging
+
+from tensor2robot_tpu.data import replay_writer as writer_lib
+from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils import summaries as summaries_lib
+
+__all__ = ["run_env", "collect_eval_loop"]
+
+EpisodeToTransitionsFn = Callable[[List[Dict[str, Any]]], List[Any]]
+
+
+@config.configurable
+def run_env(env=config.REQUIRED,
+            policy=config.REQUIRED,
+            num_episodes: int = 10,
+            explore_schedule: Optional[Callable[[int], float]] = None,
+            global_step: int = 0,
+            root_dir: Optional[str] = None,
+            tag: str = "collect",
+            episode_to_transitions_fn: Optional[EpisodeToTransitionsFn] = None,
+            replay_writer: Optional[writer_lib.TFRecordReplayWriter] = None,
+            max_episode_steps: Optional[int] = None) -> Dict[str, float]:
+  """Runs episodes; returns aggregate reward stats."""
+  explore_prob = (explore_schedule(global_step) if explore_schedule
+                  else 0.0)
+  episode_rewards: List[float] = []
+  episode_lengths: List[int] = []
+  for episode_idx in range(num_episodes):
+    policy.reset()
+    obs, _ = env.reset()
+    episode: List[Dict[str, Any]] = []
+    total_reward, steps, done = 0.0, 0, False
+    while not done:
+      action = policy.sample_action(obs, explore_prob=explore_prob)
+      next_obs, reward, terminated, truncated, info = env.step(action)
+      episode.append({"obs": obs, "action": action, "reward": reward,
+                      "done": terminated or truncated, "info": info})
+      total_reward += float(reward)
+      obs = next_obs
+      steps += 1
+      done = terminated or truncated or (
+          max_episode_steps is not None and steps >= max_episode_steps)
+    episode_rewards.append(total_reward)
+    episode_lengths.append(steps)
+    if replay_writer is not None and episode_to_transitions_fn is not None:
+      replay_writer.write(episode_to_transitions_fn(episode))
+  stats = {
+      f"{tag}/episode_reward_mean": float(np.mean(episode_rewards)),
+      f"{tag}/episode_reward_std": float(np.std(episode_rewards)),
+      f"{tag}/episode_length_mean": float(np.mean(episode_lengths)),
+      f"{tag}/explore_prob": float(explore_prob),
+  }
+  if root_dir is not None:
+    writer = summaries_lib.SummaryWriter(os.path.join(root_dir, tag),
+                                         use_tensorboard=False)
+    writer.write_scalars(global_step, stats)
+    writer.close()
+  logging.info("run_env[%s] @%d: %s", tag, global_step, stats)
+  return stats
+
+
+@config.configurable
+def collect_eval_loop(collect_env=config.REQUIRED,
+                      eval_env=None,
+                      policy=config.REQUIRED,
+                      root_dir: str = config.REQUIRED,
+                      num_collect_episodes: int = 10,
+                      num_eval_episodes: int = 5,
+                      max_steps: int = 1,
+                      explore_schedule: Optional[Callable] = None,
+                      episode_to_transitions_fn=None,
+                      poll_interval_secs: float = 1.0,
+                      total_timeout_secs: Optional[float] = None
+                      ) -> Dict[str, float]:
+  """Poll policy artifacts -> collect -> eval -> repeat (reference
+  continuous_collect_eval.py:28-108). One iteration per new policy
+  version; stops when the policy's global step reaches max_steps or on
+  timeout."""
+  os.makedirs(root_dir, exist_ok=True)
+  stats: Dict[str, float] = {}
+  last_step = -1
+  start = time.time()
+  while True:
+    if not policy.restore():
+      if (total_timeout_secs is not None
+          and time.time() - start > total_timeout_secs):
+        logging.warning("collect_eval_loop: timed out waiting for policy.")
+        return stats
+      time.sleep(poll_interval_secs)
+      continue
+    step = max(policy.global_step, 0)
+    if step == last_step:
+      if (total_timeout_secs is not None
+          and time.time() - start > total_timeout_secs):
+        return stats
+      if step >= max_steps:
+        return stats
+      time.sleep(poll_interval_secs)
+      continue
+    last_step = step
+    replay_writer = None
+    if episode_to_transitions_fn is not None:
+      replay_path = os.path.join(root_dir, "policy_collect",
+                                 f"episodes_{step}.tfrecord")
+      replay_writer = writer_lib.TFRecordReplayWriter(replay_path)
+    stats.update(run_env(
+        env=collect_env, policy=policy, num_episodes=num_collect_episodes,
+        explore_schedule=explore_schedule, global_step=step,
+        root_dir=root_dir, tag="collect",
+        episode_to_transitions_fn=episode_to_transitions_fn,
+        replay_writer=replay_writer))
+    if replay_writer is not None:
+      replay_writer.close()
+    if eval_env is not None:
+      stats.update(run_env(
+          env=eval_env, policy=policy, num_episodes=num_eval_episodes,
+          global_step=step, root_dir=root_dir, tag="eval"))
+    if step >= max_steps:
+      return stats

@@ -1,0 +1,178 @@
+"""Task-head model bases: classification, regression, critic.
+
+Re-designs of the reference's task heads:
+* `ClassificationModel` (/root/reference/models/classification_model.py:
+  43-237) — network -> logits, sigmoid/softmax cross-entropy, accuracy /
+  precision / recall / mse eval metrics;
+* `RegressionModel` (/root/reference/models/regression_model.py:45-167)
+  — network -> continuous outputs, MSE loss;
+* `CriticModel` (/root/reference/models/critic_model.py:43-238) — state /
+  action spec split, q_func -> q_predicted, Monte-Carlo return regression,
+  and action tiling for CEM batch inference (:123-136).
+
+Concrete models subclass one of these and provide specs + a flax module.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.models import abstract as abstract_model
+
+__all__ = ["ClassificationModel", "RegressionModel", "CriticModel",
+           "sigmoid_cross_entropy", "softmax_cross_entropy"]
+
+
+def sigmoid_cross_entropy(logits: jnp.ndarray,
+                          labels: jnp.ndarray) -> jnp.ndarray:
+  """Numerically-stable elementwise sigmoid xent."""
+  return (jnp.maximum(logits, 0) - logits * labels
+          + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def softmax_cross_entropy(logits: jnp.ndarray,
+                          labels_onehot: jnp.ndarray) -> jnp.ndarray:
+  log_probs = jax.nn.log_softmax(logits, axis=-1)
+  return -(labels_onehot * log_probs).sum(-1)
+
+
+class ClassificationModel(abstract_model.T2RModel):
+  """Logit head + cross-entropy; binary (num_classes=1, sigmoid) or
+  multiclass (softmax over one-hot labels)."""
+
+  def __init__(self, num_classes: int = 1, logits_key: str = "logits",
+               class_label_key: str = "class", **kwargs):
+    super().__init__(**kwargs)
+    self._num_classes = num_classes
+    self._logits_key = logits_key
+    self._class_label_key = class_label_key
+
+  @property
+  def num_classes(self) -> int:
+    return self._num_classes
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    logits = inference_outputs[self._logits_key]
+    y = labels[self._class_label_key]
+    if self._num_classes == 1:
+      loss = jnp.mean(sigmoid_cross_entropy(logits, y))
+    else:
+      if y.ndim == logits.ndim - 1:  # sparse labels -> one-hot
+        y = jax.nn.one_hot(y.astype(jnp.int32), self._num_classes)
+      loss = jnp.mean(softmax_cross_entropy(logits, y))
+    return loss, {"cross_entropy": loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    logits = inference_outputs[self._logits_key]
+    y = labels[self._class_label_key]
+    loss, _ = self.model_train_fn(features, labels, inference_outputs,
+                                  modes_lib.EVAL)
+    if self._num_classes == 1:
+      probs = jax.nn.sigmoid(logits)
+      predicted = (probs > 0.5).astype(jnp.float32)
+      accuracy = jnp.mean(predicted == y)
+      true_pos = jnp.sum(predicted * y)
+      precision = true_pos / jnp.maximum(jnp.sum(predicted), 1.0)
+      recall = true_pos / jnp.maximum(jnp.sum(y), 1.0)
+      mse = jnp.mean((probs - y) ** 2)
+      return {"loss": loss, "accuracy": accuracy, "precision": precision,
+              "recall": recall, "mse": mse}
+    predicted = jnp.argmax(logits, -1)
+    sparse = y if y.ndim == logits.ndim - 1 else jnp.argmax(y, -1)
+    accuracy = jnp.mean(predicted == sparse)
+    return {"loss": loss, "accuracy": accuracy}
+
+  def create_export_outputs_fn(self, features, inference_outputs):
+    logits = inference_outputs[self._logits_key]
+    if self._num_classes == 1:
+      scores = jax.nn.sigmoid(logits)
+    else:
+      scores = jax.nn.softmax(logits, -1)
+    return {self._logits_key: logits, "scores": scores}
+
+
+class RegressionModel(abstract_model.T2RModel):
+  """Continuous output head + MSE (the reference deprecates this in favor
+  of the abstract base, regression_model.py:49-51 — kept for parity)."""
+
+  def __init__(self, output_key: str = "inference_output",
+               target_label_key: str = "target", **kwargs):
+    super().__init__(**kwargs)
+    self._output_key = output_key
+    self._target_label_key = target_label_key
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    predicted = inference_outputs[self._output_key]
+    target = labels[self._target_label_key]
+    loss = jnp.mean((predicted - target) ** 2)
+    return loss, {"mse": loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    predicted = inference_outputs[self._output_key]
+    target = labels[self._target_label_key]
+    mae = jnp.mean(jnp.abs(predicted - target))
+    return {"loss": loss, "mean_absolute_error": mae, **scalars}
+
+
+class CriticModel(abstract_model.T2RModel):
+  """Q(state, action) regression onto Monte-Carlo returns.
+
+  Feature specs split into state and action halves; serving tiles the
+  state over an action batch so CEM can score many candidate actions per
+  observation in one forward pass
+  (/root/reference/models/critic_model.py:123-136)."""
+
+  q_output_key = "q_predicted"
+  reward_label_key = "reward"
+
+  @abc.abstractmethod
+  def get_state_specification(self, mode) -> specs_lib.SpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def get_action_specification(self, mode) -> specs_lib.SpecStruct:
+    ...
+
+  def get_feature_specification(self, mode) -> specs_lib.SpecStruct:
+    out = specs_lib.SpecStruct()
+    for key, spec in specs_lib.flatten_spec_structure(
+        self.get_state_specification(mode)).items():
+      out["state/" + key] = spec
+    for key, spec in specs_lib.flatten_spec_structure(
+        self.get_action_specification(mode)).items():
+      out["action/" + key] = spec
+    return out
+
+  def get_label_specification(self, mode) -> specs_lib.SpecStruct:
+    import numpy as np
+
+    return specs_lib.SpecStruct({
+        self.reward_label_key: specs_lib.TensorSpec(
+            shape=(1,), dtype=np.float32, name="reward")})
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    q = inference_outputs[self.q_output_key]
+    target = labels[self.reward_label_key]
+    loss = jnp.mean((q - target) ** 2)
+    return loss, {"td_mse": loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    q = inference_outputs[self.q_output_key]
+    return {"loss": loss, "q_mean": jnp.mean(q), **scalars}
+
+  @staticmethod
+  def tile_state_for_actions(state_tree, num_action_samples: int):
+    """Repeats each state row `num_action_samples` times so a [B] state
+    batch scores a [B * num_action_samples] action batch (CEM serving)."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.repeat(x, num_action_samples, axis=0), state_tree)

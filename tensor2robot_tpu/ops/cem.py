@@ -1,0 +1,120 @@
+"""Cross-entropy method optimizer.
+
+Reference: a generic numpy CEM used by serving policies to maximize the
+critic over actions (/root/reference/utils/cross_entropy.py:30-154;
+defaults 64 samples x 3 iterations, 10 elites,
+/root/reference/policies/policies.py:110-116).
+
+Two implementations:
+* `cross_entropy_method` — jittable (`lax.fori_loop`), batched over
+  observations, runs entirely on device so CEM serving rides the MXU
+  (score all candidates in one batched forward pass);
+* `CrossEntropyMethod` — the numpy-callable adapter for host-side
+  objective functions (e.g. a remote predictor).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["cross_entropy_method", "CrossEntropyMethod"]
+
+
+def cross_entropy_method(
+    key: jax.Array,
+    objective_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    mean: jnp.ndarray,
+    stddev: jnp.ndarray,
+    num_samples: int = 64,
+    num_iterations: int = 3,
+    num_elites: int = 10,
+    low: Optional[jnp.ndarray] = None,
+    high: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+  """Maximizes objective_fn over action vectors.
+
+  Args:
+    key: PRNG key.
+    objective_fn: [num_samples, action_dim] -> [num_samples] scores.
+      (Batch over observations by vmapping this whole function.)
+    mean / stddev: [action_dim] initial sampling distribution.
+    low / high: optional clipping bounds.
+
+  Returns:
+    (best_action [action_dim], best_score [], final_mean [action_dim]).
+  """
+  action_dim = mean.shape[-1]
+
+  def body(i, carry):
+    key, mean, stddev, best_action, best_score = carry
+    key, sample_key = jax.random.split(key)
+    samples = mean + stddev * jax.random.normal(
+        sample_key, (num_samples, action_dim))
+    if low is not None:
+      samples = jnp.clip(samples, low, high)
+    scores = objective_fn(samples)
+    elite_idx = jax.lax.top_k(scores, num_elites)[1]
+    elites = samples[elite_idx]
+    new_mean = elites.mean(0)
+    new_stddev = elites.std(0) + 1e-6
+    top_idx = elite_idx[0]
+    better = scores[top_idx] > best_score
+    best_action = jnp.where(better, samples[top_idx], best_action)
+    best_score = jnp.where(better, scores[top_idx], best_score)
+    return key, new_mean, new_stddev, best_action, best_score
+
+  init = (key, mean, stddev, jnp.zeros_like(mean),
+          jnp.asarray(-jnp.inf, jnp.float32))
+  _, final_mean, _, best_action, best_score = jax.lax.fori_loop(
+      0, num_iterations, body, init)
+  return best_action, best_score, final_mean
+
+
+class CrossEntropyMethod:
+  """Host-side numpy CEM with a pluggable objective (reference API)."""
+
+  def __init__(self,
+               num_samples: int = 64,
+               num_iterations: int = 3,
+               num_elites: int = 10,
+               early_termination_stddev: float = 0.0,
+               seed: Optional[int] = None):
+    if num_elites > num_samples:
+      raise ValueError("num_elites must be <= num_samples.")
+    self._num_samples = num_samples
+    self._num_iterations = num_iterations
+    self._num_elites = num_elites
+    self._early_stddev = early_termination_stddev
+    self._rng = np.random.RandomState(seed)
+
+  def optimize(self,
+               objective_fn: Callable[[np.ndarray], np.ndarray],
+               mean: np.ndarray,
+               stddev: np.ndarray,
+               low: Optional[np.ndarray] = None,
+               high: Optional[np.ndarray] = None
+               ) -> Tuple[np.ndarray, float]:
+    """Returns (best_action, best_score)."""
+    mean = np.asarray(mean, np.float32)
+    stddev = np.asarray(stddev, np.float32)
+    best_action, best_score = None, -np.inf
+    for _ in range(self._num_iterations):
+      samples = mean + stddev * self._rng.randn(
+          self._num_samples, mean.shape[-1]).astype(np.float32)
+      if low is not None:
+        samples = np.clip(samples, low, high)
+      scores = np.asarray(objective_fn(samples)).reshape(-1)
+      elite_idx = np.argsort(scores)[-self._num_elites:]
+      elites = samples[elite_idx]
+      mean = elites.mean(0)
+      stddev = elites.std(0)
+      if scores[elite_idx[-1]] > best_score:
+        best_score = float(scores[elite_idx[-1]])
+        best_action = samples[elite_idx[-1]]
+      if self._early_stddev and float(stddev.max()) < self._early_stddev:
+        break
+    return best_action, best_score

@@ -1,0 +1,291 @@
+"""Predictors: load trained artifacts, serve `predict(features) -> dict`.
+
+Reference surface (/root/reference/predictors/):
+* `AbstractPredictor` (abstract_predictor.py:26-81) — the robot-side
+  contract: predict / get_feature_specification / restore / close;
+* `ExportedSavedModelPredictor` (exported_savedmodel_predictor.py:53-359)
+  — polls timestamped export dirs, validates them, loads assets, serves;
+* `CheckpointPredictor` (checkpoint_predictor.py:37-215) — rebuilds the
+  PREDICT graph from the model and restores raw training checkpoints;
+* `EnsembleExportedSavedModelPredictor`
+  (ensemble_exported_savedmodel_predictor.py:32-180) — random sub-sampled
+  mean over several exports.
+
+TPU-native redesign: a predictor holds a jitted predict function plus a
+restored variables pytree; "loading an export" = reading the bundle's
+assets + orbax params and (when no model object is supplied)
+reconstructing the model from the bundle's operative config.
+"""
+
+from __future__ import annotations
+
+import abc
+import glob
+import importlib
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from tensor2robot_tpu import checkpoints as checkpoints_lib
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.export import export_generator as export_lib
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.utils import config
+
+__all__ = ["AbstractPredictor", "CheckpointPredictor",
+           "ExportedModelPredictor", "EnsemblePredictor"]
+
+
+class AbstractPredictor(abc.ABC):
+  """The robot-side serving contract."""
+
+  @abc.abstractmethod
+  def predict(self, features: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    ...
+
+  @abc.abstractmethod
+  def get_feature_specification(self) -> specs_lib.SpecStruct:
+    ...
+
+  @abc.abstractmethod
+  def restore(self) -> bool:
+    """Loads the newest artifact; returns True on success."""
+
+  def init_randomly(self) -> None:
+    raise NotImplementedError(
+        f"{type(self).__name__} does not support random init.")
+
+  @property
+  def model_version(self) -> int:
+    return self.global_step
+
+  @property
+  def global_step(self) -> int:
+    return -1
+
+  def assert_is_loaded(self) -> None:
+    if self.global_step < 0:
+      raise ValueError(f"{type(self).__name__} has no model loaded; call "
+                       "restore() first.")
+
+  def close(self) -> None:
+    pass
+
+
+class _JaxPredictorBase(AbstractPredictor):
+  """Common predict plumbing: pack features by spec, run jitted fn."""
+
+  def __init__(self):
+    self._model = None
+    self._state: Optional[ts.TrainState] = None
+    self._predict_fn: Optional[Callable] = None
+    self._global_step = -1
+
+  def _build_predict(self) -> None:
+    model = self._model
+    predict = ts.make_predict_fn(model)
+    preprocessor = model.preprocessor
+
+    def fn(features):
+      features, _ = preprocessor.preprocess(
+          features, specs_lib.SpecStruct(), modes_lib.PREDICT)
+      return predict(self._state, features)
+
+    self._predict_fn = fn
+
+  def get_feature_specification(self) -> specs_lib.SpecStruct:
+    self.assert_is_loaded()
+    return self._model.preprocessor.get_in_feature_specification(
+        modes_lib.PREDICT)
+
+  def get_label_specification(self) -> specs_lib.SpecStruct:
+    self.assert_is_loaded()
+    return specs_lib.flatten_spec_structure(
+        self._model.get_label_specification(modes_lib.PREDICT))
+
+  @property
+  def global_step(self) -> int:
+    return self._global_step
+
+  def predict(self, features) -> Dict[str, np.ndarray]:
+    self.assert_is_loaded()
+    outputs = self._predict_fn(features)
+    return {k: np.asarray(v) for k, v in dict(outputs.items()).items()}
+
+
+@config.configurable
+class CheckpointPredictor(_JaxPredictorBase):
+  """Serves directly from training checkpoints (reference
+  checkpoint_predictor.py:37-215): rebuilds the predict path from the
+  model object and polls model_dir for new steps."""
+
+  def __init__(self, model=None, model_dir: Optional[str] = None,
+               timeout_secs: float = 0.0):
+    super().__init__()
+    if model is None or model_dir is None:
+      raise ValueError("model and model_dir are required.")
+    self._model = model
+    self._checkpoint_dir = os.path.join(model_dir, "checkpoints") \
+        if os.path.isdir(os.path.join(model_dir, "checkpoints")) \
+        or not os.path.isdir(model_dir) else model_dir
+    self._timeout_secs = timeout_secs
+
+  def init_randomly(self) -> None:
+    feature_spec = self._model.preprocessor.get_out_feature_specification(
+        modes_lib.PREDICT)
+    sample = specs_lib.make_random_numpy(feature_spec, batch_size=1, seed=0)
+    self._state, _ = ts.create_train_state(
+        self._model, jax.random.PRNGKey(0), sample)
+    self._global_step = 0
+    self._build_predict()
+
+  def restore(self) -> bool:
+    deadline = time.time() + self._timeout_secs
+    step = checkpoints_lib.latest_step(self._checkpoint_dir)
+    while step is None and time.time() < deadline:
+      time.sleep(1.0)
+      step = checkpoints_lib.latest_step(self._checkpoint_dir)
+    if step is None:
+      return False
+    if self._state is None:
+      self.init_randomly()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state)
+    with checkpoints_lib.CheckpointManager(self._checkpoint_dir) as manager:
+      self._state = manager.restore(step, abstract_state=abstract)
+    self._global_step = step
+    self._build_predict()
+    return True
+
+
+def _valid_export_dirs(export_root: str) -> List[str]:
+  """Newest-last list of complete export bundles (reference dir polling +
+  validation, exported_savedmodel_predictor.py:314-353)."""
+  if not os.path.isdir(export_root):
+    return []
+  out = []
+  for path in sorted(glob.glob(os.path.join(export_root, "*"))):
+    name = os.path.basename(path)
+    if not name.isdigit():
+      continue
+    if (os.path.isfile(os.path.join(path, specs_lib.ASSET_FILENAME))
+        and os.path.isfile(os.path.join(path, export_lib.SIGNATURE_FILENAME))
+        and os.path.isdir(os.path.join(path, export_lib.PARAMS_DIRNAME))):
+      out.append(path)
+  return out
+
+
+def _model_from_bundle(path: str):
+  """Reconstructs the model object from a bundle's signature + config."""
+  with open(os.path.join(path, export_lib.SIGNATURE_FILENAME)) as f:
+    signature = json.load(f)
+  config_path = os.path.join(path, "operative_config.gin")
+  if os.path.isfile(config_path):
+    config.parse_config_file(config_path)
+  module_name, _, class_name = signature["model_class"].rpartition(".")
+  module = importlib.import_module(module_name)
+  cls = module
+  for part in class_name.split("."):
+    cls = getattr(cls, part)
+  return cls()
+
+
+@config.configurable
+class ExportedModelPredictor(_JaxPredictorBase):
+  """Serves from export bundles (reference
+  exported_savedmodel_predictor.py:53-359): polls for the newest valid
+  timestamped dir, loads assets + params, optional async restore."""
+
+  def __init__(self, export_dir: Optional[str] = None, model=None,
+               timeout_secs: float = 0.0):
+    super().__init__()
+    if export_dir is None:
+      raise ValueError("export_dir is required.")
+    self._export_dir = export_dir
+    self._model = model
+    self._timeout_secs = timeout_secs
+    self._loaded_path: Optional[str] = None
+    self._restore_thread: Optional[threading.Thread] = None
+
+  def restore(self) -> bool:
+    deadline = time.time() + self._timeout_secs
+    dirs = _valid_export_dirs(self._export_dir)
+    while not dirs and time.time() < deadline:
+      time.sleep(1.0)
+      dirs = _valid_export_dirs(self._export_dir)
+    if not dirs:
+      return False
+    newest = dirs[-1]
+    if newest == self._loaded_path:
+      return True
+    assets = specs_lib.load_assets(
+        os.path.join(newest, specs_lib.ASSET_FILENAME))
+    if self._model is None:
+      self._model = _model_from_bundle(newest)
+    # Restore eval-time variables and wrap them in a TrainState shell.
+    with ocp.StandardCheckpointer() as checkpointer:
+      variables = checkpointer.restore(
+          os.path.join(newest, export_lib.PARAMS_DIRNAME))
+    self._state = ts.TrainState(
+        step=np.asarray(assets.global_step or 0),
+        params=variables["params"], opt_state=None,
+        mutable_state=variables.get("mutable") or {},
+        ema_params=None, rng=jax.random.PRNGKey(0))
+    self._global_step = int(assets.global_step or 0)
+    self._loaded_path = newest
+    self._build_predict()
+    return True
+
+  def restore_async(self) -> threading.Thread:
+    """Background restore (reference async restore thread,
+    exported_savedmodel_predictor.py:152-159)."""
+    thread = threading.Thread(target=self.restore, daemon=True)
+    thread.start()
+    self._restore_thread = thread
+    return thread
+
+  @property
+  def loaded_path(self) -> Optional[str]:
+    return self._loaded_path
+
+
+@config.configurable
+class EnsemblePredictor(AbstractPredictor):
+  """Mean aggregation over a random subsample of member predictors
+  (reference ensemble_exported_savedmodel_predictor.py:97-122)."""
+
+  def __init__(self, predictors: Optional[Sequence[AbstractPredictor]] = None,
+               num_samples: Optional[int] = None, seed: int = 0):
+    if not predictors:
+      raise ValueError("predictors are required.")
+    self._predictors = list(predictors)
+    self._num_samples = num_samples or len(self._predictors)
+    self._rng = np.random.RandomState(seed)
+
+  def restore(self) -> bool:
+    return all(p.restore() for p in self._predictors)
+
+  def get_feature_specification(self) -> specs_lib.SpecStruct:
+    return self._predictors[0].get_feature_specification()
+
+  @property
+  def global_step(self) -> int:
+    return min(p.global_step for p in self._predictors)
+
+  def predict(self, features) -> Dict[str, np.ndarray]:
+    chosen = self._rng.choice(len(self._predictors), self._num_samples,
+                              replace=False)
+    outputs = [self._predictors[i].predict(features) for i in chosen]
+    keys = outputs[0].keys()
+    return {k: np.mean([o[k] for o in outputs], axis=0) for k in keys}
+
+  def close(self) -> None:
+    for p in self._predictors:
+      p.close()

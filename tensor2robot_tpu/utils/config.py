@@ -476,11 +476,30 @@ def parse_config_files_and_bindings(
 
 def operative_config_str() -> str:
   """Every parameter value actually used by invoked configurables, as
-  re-parseable config text (reference operative-config persistence)."""
+  re-parseable config text (reference operative-config persistence).
+  Values with no config syntax (live objects) are emitted as comments, as
+  gin does, so the file always re-parses."""
   lines = []
   for (name, param), value in sorted(_REGISTRY.operative.items()):
-    lines.append(f"{name}.{param} = {_format_value(value)}")
+    if _is_representable(value):
+      lines.append(f"{name}.{param} = {_format_value(value)}")
+    else:
+      lines.append(f"# {name}.{param} = {value!r}  (not representable)")
   return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _is_representable(value: Any) -> bool:
+  if isinstance(value, (_ConfigurableReference, _MacroReference, str, int,
+                        float, bool, type(None))):
+    return True
+  if callable(value) and hasattr(value, "_configurable_name"):
+    return True
+  if isinstance(value, (list, tuple)):
+    return all(_is_representable(v) for v in value)
+  if isinstance(value, dict):
+    return all(_is_representable(k) and _is_representable(v)
+               for k, v in value.items())
+  return False
 
 
 def _format_value(value: Any) -> str:
@@ -488,8 +507,11 @@ def _format_value(value: Any) -> str:
     return repr(value)
   if callable(value) and hasattr(value, "_configurable_name"):
     return f"@{value._configurable_name}"
-  if isinstance(value, str):
-    return repr(value)
-  if isinstance(value, (list, tuple, dict, int, float, bool, type(None))):
-    return repr(value)
-  return repr(str(value))
+  if isinstance(value, (list, tuple)):
+    inner = ", ".join(_format_value(v) for v in value)
+    return f"[{inner}]" if isinstance(value, list) else f"({inner})"
+  if isinstance(value, dict):
+    inner = ", ".join(f"{_format_value(k)}: {_format_value(v)}"
+                      for k, v in value.items())
+    return "{" + inner + "}"
+  return repr(value)

@@ -1,0 +1,73 @@
+"""Trajectory subsampling index generators.
+
+Reference: /root/reference/utils/subsample.py:22-244 — uniform, random,
+first/last-pinned and randomized-boundary index selection used by
+trajectory models to cut long episodes to fixed length. Implemented for
+numpy (host pipeline) and jax (in-step, jit-safe with explicit keys).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["uniform_indices", "random_indices", "pinned_random_indices",
+           "boundary_segment_indices", "gather_subsequence"]
+
+
+def uniform_indices(sequence_length: int, num_samples: int) -> np.ndarray:
+  """Evenly spaced indices including endpoints."""
+  if num_samples == 1:
+    return np.zeros(1, np.int64)
+  return np.round(np.linspace(0, sequence_length - 1,
+                              num_samples)).astype(np.int64)
+
+
+def random_indices(sequence_length: int, num_samples: int,
+                   rng: Optional[np.random.RandomState] = None
+                   ) -> np.ndarray:
+  """Sorted random indices without replacement (with replacement when the
+  sequence is shorter than the request)."""
+  rng = rng or np.random
+  replace = sequence_length < num_samples
+  idx = rng.choice(sequence_length, size=num_samples, replace=replace)
+  return np.sort(idx).astype(np.int64)
+
+
+def pinned_random_indices(sequence_length: int, num_samples: int,
+                          rng: Optional[np.random.RandomState] = None
+                          ) -> np.ndarray:
+  """First and last frames pinned, interior sampled randomly (reference
+  first-last-pinned generator)."""
+  if num_samples < 2:
+    raise ValueError("pinned_random_indices needs num_samples >= 2")
+  rng = rng or np.random
+  if sequence_length <= 2:
+    return uniform_indices(sequence_length, num_samples)
+  interior = rng.choice(np.arange(1, sequence_length - 1),
+                        size=num_samples - 2,
+                        replace=sequence_length - 2 < num_samples - 2)
+  idx = np.concatenate([[0], np.sort(interior), [sequence_length - 1]])
+  return idx.astype(np.int64)
+
+
+def boundary_segment_indices(sequence_length: int, num_samples: int,
+                             rng: Optional[np.random.RandomState] = None
+                             ) -> np.ndarray:
+  """One random index per equal segment (randomized-boundary generator)."""
+  rng = rng or np.random
+  boundaries = np.linspace(0, sequence_length, num_samples + 1)
+  idx = []
+  for lo, hi in zip(boundaries[:-1], boundaries[1:]):
+    lo_i, hi_i = int(np.floor(lo)), max(int(np.ceil(hi)) - 1, int(np.floor(lo)))
+    idx.append(rng.randint(lo_i, hi_i + 1))
+  return np.asarray(idx, np.int64)
+
+
+def gather_subsequence(sequence: jnp.ndarray,
+                       indices: jnp.ndarray) -> jnp.ndarray:
+  """Gathers [T, ...] -> [K, ...] on device (jit/vmap friendly)."""
+  return jnp.take(sequence, indices, axis=0)

@@ -1,0 +1,266 @@
+"""Tests for predictors, CEM, and policies (the serving stack)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.export import export_generator as export_lib
+from tensor2robot_tpu.ops import cem as cem_lib
+from tensor2robot_tpu.policies import policies as policies_lib
+from tensor2robot_tpu.predictors import predictors as predictors_lib
+from tensor2robot_tpu.utils import config, mocks
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+def _train(tmp_path, steps=40, export=False):
+  model_dir = str(tmp_path / "m")
+  train_eval.train_eval_model(
+      model=mocks.MockT2RModel(device_type="cpu"),
+      model_dir=model_dir, mode="train",
+      max_train_steps=steps, checkpoint_every_n_steps=steps,
+      input_generator_train=mocks.MockInputGenerator(batch_size=16),
+      export_generators=[export_lib.DefaultExportGenerator()] if export
+      else None,
+      log_every_n_steps=20)
+  return model_dir
+
+
+class TestCheckpointPredictor:
+
+  def test_restore_and_predict(self, tmp_path):
+    model_dir = _train(tmp_path)
+    predictor = predictors_lib.CheckpointPredictor(
+        model=mocks.MockT2RModel(device_type="cpu"), model_dir=model_dir)
+    assert predictor.restore()
+    assert predictor.global_step == 40
+    out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+    assert out["prediction"].shape == (2, 1)
+
+  def test_init_randomly(self):
+    predictor = predictors_lib.CheckpointPredictor(
+        model=mocks.MockT2RModel(device_type="cpu"), model_dir="/nonexistent")
+    predictor.init_randomly()
+    out = predictor.predict({"x": np.zeros((1, 3), np.float32)})
+    assert out["prediction"].shape == (1, 1)
+
+  def test_restore_missing_returns_false(self, tmp_path):
+    predictor = predictors_lib.CheckpointPredictor(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=str(tmp_path / "empty"))
+    assert not predictor.restore()
+
+  def test_assert_is_loaded(self):
+    predictor = predictors_lib.CheckpointPredictor(
+        model=mocks.MockT2RModel(device_type="cpu"), model_dir="/nonexistent")
+    with pytest.raises(ValueError, match="no model loaded"):
+      predictor.predict({"x": np.zeros((1, 3), np.float32)})
+
+
+class TestExportedModelPredictor:
+
+  def test_restore_and_predict_with_model(self, tmp_path):
+    model_dir = _train(tmp_path, export=True)
+    predictor = predictors_lib.ExportedModelPredictor(
+        export_dir=os.path.join(model_dir, "export"),
+        model=mocks.MockT2RModel(device_type="cpu"))
+    assert predictor.restore()
+    assert predictor.global_step == 40
+    out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+    assert out["prediction"].shape == (2, 1)
+    spec = predictor.get_feature_specification()
+    assert "x" in spec
+
+  def test_model_reconstruction_from_bundle(self, tmp_path):
+    model_dir = _train(tmp_path, export=True)
+    predictor = predictors_lib.ExportedModelPredictor(
+        export_dir=os.path.join(model_dir, "export"))
+    assert predictor.restore()
+    out = predictor.predict({"x": np.zeros((1, 3), np.float32)})
+    assert "prediction" in out
+
+  def test_picks_newest_and_skips_invalid(self, tmp_path):
+    model_dir = _train(tmp_path, export=True)
+    export_root = os.path.join(model_dir, "export")
+    os.makedirs(os.path.join(export_root, "99999999999999999"))  # invalid
+    predictor = predictors_lib.ExportedModelPredictor(
+        export_dir=export_root,
+        model=mocks.MockT2RModel(device_type="cpu"))
+    assert predictor.restore()
+    assert os.path.basename(predictor.loaded_path) != "99999999999999999"
+
+  def test_restore_empty_returns_false(self, tmp_path):
+    predictor = predictors_lib.ExportedModelPredictor(
+        export_dir=str(tmp_path / "none"))
+    assert not predictor.restore()
+
+
+class TestEnsemblePredictor:
+
+  def test_mean_aggregation(self, tmp_path):
+    model_dir = _train(tmp_path, export=True)
+    members = [
+        predictors_lib.ExportedModelPredictor(
+            export_dir=os.path.join(model_dir, "export"),
+            model=mocks.MockT2RModel(device_type="cpu"))
+        for _ in range(3)]
+    ensemble = predictors_lib.EnsemblePredictor(predictors=members,
+                                                num_samples=2)
+    assert ensemble.restore()
+    out = ensemble.predict({"x": np.zeros((1, 3), np.float32)})
+    assert out["prediction"].shape == (1, 1)
+
+
+class TestCEM:
+
+  def test_numpy_cem_finds_quadratic_max(self):
+    target = np.array([0.3, -0.7], np.float32)
+
+    def objective(actions):
+      return -((actions - target) ** 2).sum(-1)
+
+    cem = cem_lib.CrossEntropyMethod(num_samples=128, num_iterations=10,
+                                     num_elites=16, seed=0)
+    best, score = cem.optimize(objective, mean=np.zeros(2),
+                               stddev=np.ones(2))
+    np.testing.assert_allclose(best, target, atol=0.1)
+
+  def test_jax_cem_jits_and_optimizes(self):
+    target = jnp.array([0.5, -0.25])
+
+    def objective(actions):
+      return -((actions - target) ** 2).sum(-1)
+
+    fn = jax.jit(lambda key: cem_lib.cross_entropy_method(
+        key, objective, mean=jnp.zeros(2), stddev=jnp.ones(2),
+        num_samples=128, num_iterations=10, num_elites=16))
+    best, score, _ = fn(jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(best), np.asarray(target),
+                               atol=0.1)
+
+  def test_elites_bound(self):
+    with pytest.raises(ValueError):
+      cem_lib.CrossEntropyMethod(num_samples=4, num_elites=8)
+
+
+class _FakeCriticPredictor(predictors_lib.AbstractPredictor):
+  """Q = -||action - f(state)||^2 with f(state) = state[:2]."""
+
+  def predict(self, features):
+    action = features["action/action"]
+    state = features["state/obs"][:, :2]
+    q = -((action - state) ** 2).sum(-1, keepdims=True)
+    return {"q_predicted": q}
+
+  def get_feature_specification(self):
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    return SpecStruct({"state/obs": TensorSpec(shape=(3,)),
+                       "action/action": TensorSpec(shape=(2,))})
+
+  def restore(self):
+    return True
+
+  @property
+  def global_step(self):
+    return 7
+
+
+class TestPolicies:
+
+  def test_cem_policy_argmaxes_critic(self):
+    policy = policies_lib.CEMPolicy(
+        predictor=_FakeCriticPredictor(), action_size=2,
+        cem_samples=128, cem_iterations=10, cem_elites=16, seed=0)
+    assert policy.restore()
+    obs = {"obs": np.array([0.4, -0.6, 0.0], np.float32)}
+    action = policy.select_action(obs)
+    np.testing.assert_allclose(action, [0.4, -0.6], atol=0.12)
+    assert policy.global_step == 7
+
+  def test_cem_policy_explore(self):
+    policy = policies_lib.CEMPolicy(
+        predictor=_FakeCriticPredictor(), action_size=2, seed=0)
+    action = policy.select_action(
+        {"obs": np.zeros(3, np.float32)}, explore_prob=1.0)
+    assert action.shape == (2,)
+
+  def _regression_predictor(self):
+    class _P(predictors_lib.AbstractPredictor):
+      def predict(self, features):
+        b = next(iter(features.values())).shape[0]
+        return {"inference_output": np.tile(
+            np.arange(6, dtype=np.float32).reshape(1, 3, 2), (b, 1, 1))}
+
+      def get_feature_specification(self):
+        from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+        return SpecStruct({"obs": TensorSpec(shape=(3,))})
+
+      def restore(self):
+        return True
+
+      @property
+      def global_step(self):
+        return 100
+
+    return _P()
+
+  def test_sequential_regression_policy_steps_through_rows(self):
+    policy = policies_lib.SequentialRegressionPolicy(
+        predictor=self._regression_predictor())
+    policy.reset()
+    obs = {"obs": np.zeros(3, np.float32)}
+    a0 = policy.select_action(obs)
+    a1 = policy.select_action(obs)
+    np.testing.assert_allclose(a0, [0, 1])
+    np.testing.assert_allclose(a1, [2, 3])
+    policy.reset()
+    np.testing.assert_allclose(policy.select_action(obs), [0, 1])
+
+  def test_ou_noise_policy(self):
+    class _P(predictors_lib.AbstractPredictor):
+      def predict(self, features):
+        return {"inference_output": np.zeros((1, 2), np.float32)}
+
+      def get_feature_specification(self):
+        return None
+
+      def restore(self):
+        return True
+
+    policy = policies_lib.OUExploreRegressionPolicy(
+        predictor=_P(), action_size=2, seed=0)
+    policy.reset()
+    obs = {"obs": np.zeros(3, np.float32)}
+    a_noisy = policy.select_action(obs, explore_prob=1.0)
+    assert not np.allclose(a_noisy, 0.0)
+    a_greedy = policy.select_action(obs, explore_prob=0.0)
+    np.testing.assert_allclose(a_greedy, 0.0)
+
+  def test_per_episode_switch(self):
+    class _Const(policies_lib.Policy):
+      def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+      def select_action(self, obs, explore_prob=0.0):
+        return np.full(2, self._value, np.float32)
+
+    policy = policies_lib.PerEpisodeSwitchPolicy(
+        explore_policy=_Const(1.0), greedy_policy=_Const(0.0),
+        explore_prob=0.5, seed=3)
+    seen = set()
+    for _ in range(20):
+      policy.reset()
+      seen.add(float(policy.select_action({})[0]))
+    assert seen == {0.0, 1.0}
