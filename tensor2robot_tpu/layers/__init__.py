@@ -1,0 +1,9 @@
+from tensor2robot_tpu.layers import (
+    bcz_networks,
+    film_resnet,
+    mdn,
+    snail,
+    spatial_softmax,
+    tec,
+    vision,
+)

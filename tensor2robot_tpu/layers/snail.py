@@ -1,0 +1,83 @@
+"""SNAIL: causal dilated temporal convolutions + causal attention.
+
+Reference: /root/reference/layers/snail.py:29-146 (the SNAIL paper's
+CausalConv / DenseBlock / TCBlock / AttentionBlock). TPU notes: causal
+masking is a static triangular mask (no dynamic control flow); dilated
+convs are `nn.Conv` with left padding so all shapes stay static.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["CausalConv", "DenseBlock", "TCBlock", "AttentionBlock"]
+
+
+class CausalConv(nn.Module):
+  """1D causal (left-padded) dilated convolution over [B, T, C]."""
+
+  filters: int
+  kernel_size: int = 2
+  dilation: int = 1
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    pad = self.dilation * (self.kernel_size - 1)
+    x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
+    return nn.Conv(self.filters, (self.kernel_size,),
+                   kernel_dilation=(self.dilation,), padding="VALID",
+                   name="conv")(x)
+
+
+class DenseBlock(nn.Module):
+  """Gated causal conv whose output concatenates onto the input."""
+
+  filters: int
+  dilation: int = 1
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    xf = CausalConv(self.filters, dilation=self.dilation, name="filter")(x)
+    xg = CausalConv(self.filters, dilation=self.dilation, name="gate")(x)
+    activations = jnp.tanh(xf) * nn.sigmoid(xg)
+    return jnp.concatenate([x, activations], axis=-1)
+
+
+class TCBlock(nn.Module):
+  """Stack of DenseBlocks with exponentially growing dilation covering
+  the sequence length."""
+
+  sequence_length: int
+  filters: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    num_blocks = max(1, int(math.ceil(math.log2(self.sequence_length))))
+    for i in range(num_blocks):
+      x = DenseBlock(self.filters, dilation=2 ** i, name=f"dense_{i}")(x)
+    return x
+
+
+class AttentionBlock(nn.Module):
+  """Single-head causal attention; output concatenates onto the input."""
+
+  key_size: int
+  value_size: int
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    t = x.shape[1]
+    keys = nn.Dense(self.key_size, name="keys")(x)
+    queries = nn.Dense(self.key_size, name="queries")(x)
+    values = nn.Dense(self.value_size, name="values")(x)
+    logits = queries @ keys.transpose(0, 2, 1) / math.sqrt(self.key_size)
+    causal_mask = jnp.tril(jnp.ones((t, t), bool))
+    logits = jnp.where(causal_mask, logits,
+                       jnp.asarray(-1e9, logits.dtype))
+    attention = nn.softmax(logits, axis=-1)
+    read = attention @ values
+    return jnp.concatenate([x, read], axis=-1)

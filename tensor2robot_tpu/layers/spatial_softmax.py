@@ -1,0 +1,65 @@
+"""Spatial soft arg-max: feature maps -> expected 2D feature points.
+
+Reference: /root/reference/layers/spatial_softmax.py:29-88 — softmax over
+each channel's spatial extent, returning per-channel expected (x, y)
+coordinates; optional Gumbel sampling for stochastic keypoints. The whole
+op is batched matmuls/reductions, fully fusable by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SpatialSoftmax", "spatial_softmax"]
+
+
+def spatial_softmax(features: jnp.ndarray,
+                    temperature: Optional[jnp.ndarray] = None,
+                    gumbel_key: Optional[jax.Array] = None
+                    ) -> jnp.ndarray:
+  """[B, H, W, C] -> [B, C * 2] expected (x, y) in [-1, 1] per channel."""
+  if features.ndim != 4:
+    raise ValueError(f"Expected [B,H,W,C], got {features.shape}")
+  b, h, w, c = features.shape
+  logits = features.astype(jnp.float32)
+  if temperature is not None:
+    logits = logits / temperature
+  flat = logits.transpose(0, 3, 1, 2).reshape(b, c, h * w)
+  if gumbel_key is not None:
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(gumbel_key, flat.shape, minval=1e-10,
+                           maxval=1.0) + 1e-10))
+    flat = flat + gumbel
+  attention = jax.nn.softmax(flat, axis=-1)  # [B, C, H*W]
+  pos_x, pos_y = jnp.meshgrid(jnp.linspace(-1.0, 1.0, w),
+                              jnp.linspace(-1.0, 1.0, h))
+  pos = jnp.stack([pos_x.ravel(), pos_y.ravel()], axis=-1)  # [H*W, 2]
+  points = attention @ pos  # [B, C, 2]
+  return points.reshape(b, c * 2)
+
+
+class SpatialSoftmax(nn.Module):
+  """Module wrapper with an optional learned temperature."""
+
+  learn_temperature: bool = False
+  initial_temperature: float = 1.0
+  gumbel_sampling: bool = False
+
+  @nn.compact
+  def __call__(self, features: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    temperature = None
+    if self.learn_temperature:
+      log_t = self.param(
+          "log_temperature",
+          lambda key: jnp.asarray(jnp.log(self.initial_temperature),
+                                  jnp.float32))
+      temperature = jnp.exp(log_t)
+    gumbel_key = None
+    if self.gumbel_sampling and train:
+      gumbel_key = self.make_rng("dropout")
+    return spatial_softmax(features, temperature, gumbel_key)

@@ -1,0 +1,110 @@
+"""Task-Embedded Control (TEC) networks: episode embedding reducers and
+contrastive/triplet embedding losses.
+
+Reference: /root/reference/layers/tec.py — episode->embedding reducers
+(:114-169) and the embedding losses including cosine semihard triplet
+(:172-383). Losses are pure jnp functions over [B, D] embeddings with
+integer task labels; the semihard mining is masked matrix algebra (no
+data-dependent shapes), so everything jits.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+__all__ = ["reduce_temporal_embeddings", "EmbedEpisode",
+           "npairs_loss", "triplet_semihard_loss", "cosine_distance_matrix"]
+
+
+def reduce_temporal_embeddings(embeddings: jnp.ndarray,
+                               reduction: str = "mean") -> jnp.ndarray:
+  """[B, T, D] -> [B, D] (reference reducers :114-169)."""
+  if reduction == "mean":
+    return embeddings.mean(axis=1)
+  if reduction == "final":
+    return embeddings[:, -1]
+  if reduction == "max":
+    return embeddings.max(axis=1)
+  raise ValueError(f"Unknown reduction {reduction!r}")
+
+
+class EmbedEpisode(nn.Module):
+  """Per-frame MLP embedding + temporal reduction + L2 normalization."""
+
+  embedding_size: int = 64
+  hidden_size: int = 128
+  reduction: str = "mean"
+  normalize: bool = True
+
+  @nn.compact
+  def __call__(self, frames: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    x = nn.relu(nn.Dense(self.hidden_size, name="fc1")(frames))
+    x = nn.Dense(self.embedding_size, name="fc2")(x)
+    x = reduce_temporal_embeddings(x, self.reduction)
+    if self.normalize:
+      x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-7)
+    return x
+
+
+def cosine_distance_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+  """Pairwise cosine distances, [N, D] x [M, D] -> [N, M]."""
+  a = a / (jnp.linalg.norm(a, axis=-1, keepdims=True) + 1e-7)
+  b = b / (jnp.linalg.norm(b, axis=-1, keepdims=True) + 1e-7)
+  return 1.0 - a @ b.T
+
+
+def npairs_loss(embeddings_anchor: jnp.ndarray,
+                embeddings_positive: jnp.ndarray,
+                labels: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+  """N-pairs loss: softmax cross-entropy of anchor·positive similarities
+  (reference npairs usage in tec.py / grasp2vec losses)."""
+  logits = embeddings_anchor @ embeddings_positive.T
+  n = logits.shape[0]
+  if labels is None:
+    labels = jnp.arange(n)
+  targets = jax.nn.one_hot(labels, n)
+  # symmetrize targets over equal labels
+  same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+  targets = same / same.sum(-1, keepdims=True)
+  log_probs = jax.nn.log_softmax(logits, axis=-1)
+  return -(targets * log_probs).sum(-1).mean()
+
+
+def triplet_semihard_loss(embeddings: jnp.ndarray,
+                          labels: jnp.ndarray,
+                          margin: float = 1.0,
+                          distance: str = "cosine") -> jnp.ndarray:
+  """Semihard triplet mining (reference cosine semihard triplet,
+  tec.py:172-383): for each anchor-positive pair, pick the hardest
+  negative that is still farther than the positive; fall back to the
+  easiest negative when none exists. Fully masked matrix algebra."""
+  if distance == "cosine":
+    dist = cosine_distance_matrix(embeddings, embeddings)
+  else:
+    sq = (embeddings ** 2).sum(-1)
+    dist = jnp.sqrt(jnp.maximum(
+        sq[:, None] + sq[None, :] - 2.0 * embeddings @ embeddings.T, 1e-12))
+  n = labels.shape[0]
+  same = labels[:, None] == labels[None, :]
+  positive_mask = same & ~jnp.eye(n, dtype=bool)
+  negative_mask = ~same
+
+  # For each (anchor i, positive j): semihard negatives k satisfy
+  # dist[i, k] > dist[i, j]; take the smallest such distance.
+  d_ap = dist[:, :, None]                       # [i, j, 1]
+  d_an = dist[:, None, :]                       # [i, 1, k]
+  semihard = (d_an > d_ap) & negative_mask[:, None, :]
+  inf = jnp.asarray(jnp.inf, dist.dtype)
+  semihard_min = jnp.where(semihard, d_an, inf).min(axis=-1)  # [i, j]
+  easiest_neg = jnp.where(negative_mask, dist, -inf).max(
+      axis=-1)                                   # [i]
+  neg_dist = jnp.where(jnp.isfinite(semihard_min), semihard_min,
+                       easiest_neg[:, None])     # [i, j]
+  loss = jnp.maximum(dist + margin - neg_dist, 0.0)
+  num_pairs = jnp.maximum(positive_mask.sum(), 1)
+  return jnp.where(positive_mask, loss, 0.0).sum() / num_pairs

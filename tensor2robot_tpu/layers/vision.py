@@ -1,0 +1,120 @@
+"""Vision towers with FiLM conditioning + pose heads.
+
+Reference: /root/reference/layers/vision_layers.py — the "Berkeley-Net"
+conv tower (`BuildImagesToFeaturesModel` :30-158), its high-res
+multi-scale variant (:185-273), FiLM parameter generators
+(`BuildFILMParams` :162-181) and the FC pose head with bias transform
+(:277-350). Rebuilt as flax modules; convs run in the model's compute
+dtype so the MXU sees bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensor2robot_tpu.layers.spatial_softmax import SpatialSoftmax
+
+__all__ = ["FilmParams", "film", "BerkeleyNet", "HighResBerkeleyNet",
+           "PoseHead"]
+
+
+class FilmParams(nn.Module):
+  """Generates per-channel (gamma, beta) from a conditioning vector
+  (reference BuildFILMParams)."""
+
+  num_channels: int
+
+  @nn.compact
+  def __call__(self, conditioning: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    out = nn.Dense(2 * self.num_channels, name="film_proj")(conditioning)
+    gamma, beta = jnp.split(out, 2, axis=-1)
+    return gamma, beta
+
+
+def film(x: jnp.ndarray, gamma: jnp.ndarray, beta: jnp.ndarray
+         ) -> jnp.ndarray:
+  """Feature-wise linear modulation: (1 + gamma) * x + beta."""
+  gamma = gamma[:, None, None, :]
+  beta = beta[:, None, None, :]
+  return (1.0 + gamma) * x + beta
+
+
+class BerkeleyNet(nn.Module):
+  """Conv tower -> spatial softmax feature points (reference
+  BuildImagesToFeaturesModel): a few stride-y conv layers, optional FiLM
+  after each, ending in spatial soft arg-max."""
+
+  filters: Sequence[int] = (64, 32, 32)
+  kernel_sizes: Sequence[int] = (7, 3, 3)
+  strides: Sequence[int] = (2, 1, 1)
+  use_spatial_softmax: bool = True
+  normalizer: str = "layer_norm"  # 'batch_norm'|'layer_norm'|'none'
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               conditioning: Optional[jnp.ndarray] = None,
+               train: bool = False) -> jnp.ndarray:
+    x = images
+    for i, (f, k, s) in enumerate(zip(self.filters, self.kernel_sizes,
+                                      self.strides)):
+      x = nn.Conv(f, (k, k), strides=(s, s), name=f"conv_{i}")(x)
+      if self.normalizer == "batch_norm":
+        x = nn.BatchNorm(use_running_average=not train,
+                         name=f"norm_{i}")(x)
+      elif self.normalizer == "layer_norm":
+        x = nn.LayerNorm(name=f"norm_{i}")(x)
+      if conditioning is not None:
+        gamma, beta = FilmParams(f, name=f"film_{i}")(conditioning)
+        x = film(x, gamma.astype(x.dtype), beta.astype(x.dtype))
+      x = nn.relu(x)
+    if self.use_spatial_softmax:
+      return SpatialSoftmax(name="spatial_softmax")(x, train=train)
+    return x.reshape(x.shape[0], -1)
+
+
+class HighResBerkeleyNet(nn.Module):
+  """Multi-scale variant (reference :185-273): an extra high-resolution
+  stream pooled and concatenated with the main tower's feature points."""
+
+  filters: Sequence[int] = (64, 32, 32)
+  high_res_filters: int = 16
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               conditioning: Optional[jnp.ndarray] = None,
+               train: bool = False) -> jnp.ndarray:
+    points = BerkeleyNet(filters=self.filters, name="main")(
+        images, conditioning, train=train)
+    hi = nn.Conv(self.high_res_filters, (3, 3), name="high_res_conv")(images)
+    hi = nn.relu(hi)
+    hi_points = SpatialSoftmax(name="high_res_ssm")(hi, train=train)
+    return jnp.concatenate([points, hi_points], axis=-1)
+
+
+class PoseHead(nn.Module):
+  """FC pose regression head with an optional bias-transform input
+  (reference BuildImageFeaturesToPoseModel :277-350): a learned constant
+  vector concatenated to the features — the MAML bias-transform trick."""
+
+  output_size: int = 7
+  hidden_sizes: Sequence[int] = (100, 100)
+  bias_transform_size: int = 0
+
+  @nn.compact
+  def __call__(self, features: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    x = features
+    if self.bias_transform_size:
+      bias_transform = self.param(
+          "bias_transform", nn.initializers.zeros,
+          (self.bias_transform_size,))
+      tiled = jnp.tile(bias_transform[None].astype(x.dtype),
+                       (x.shape[0], 1))
+      x = jnp.concatenate([x, tiled], axis=-1)
+    for i, size in enumerate(self.hidden_sizes):
+      x = nn.relu(nn.Dense(size, name=f"fc_{i}")(x))
+    return nn.Dense(self.output_size, name="pose")(x)

@@ -1,0 +1,260 @@
+"""Tests for the NN layers library."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.layers import (bcz_networks, film_resnet, mdn, snail,
+                                     spatial_softmax, tec, vision)
+
+
+def _init_apply(module, *args, train=False, **kwargs):
+  variables = module.init({"params": jax.random.PRNGKey(0),
+                           "dropout": jax.random.PRNGKey(1)},
+                          *args, train=train, **kwargs)
+  mutable = ["batch_stats"] if train else False
+  out = module.apply(variables, *args, train=train, rngs={
+      "dropout": jax.random.PRNGKey(2)}, mutable=mutable, **kwargs)
+  if mutable:
+    return out[0], variables
+  return out, variables
+
+
+class TestSpatialSoftmax:
+
+  def test_peak_maps_to_coordinates(self):
+    features = np.full((1, 9, 9, 1), -10.0, np.float32)
+    features[0, 4, 4, 0] = 10.0  # center peak
+    points = spatial_softmax.spatial_softmax(jnp.asarray(features))
+    np.testing.assert_allclose(np.asarray(points[0]), [0.0, 0.0], atol=1e-3)
+    features[0, 4, 4, 0] = -10.0
+    features[0, 0, 8, 0] = 10.0  # top-right corner -> x=+1, y=-1
+    points = spatial_softmax.spatial_softmax(jnp.asarray(features))
+    np.testing.assert_allclose(np.asarray(points[0]), [1.0, -1.0], atol=1e-3)
+
+  def test_module_with_learned_temperature(self):
+    module = spatial_softmax.SpatialSoftmax(learn_temperature=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 4))
+    out, variables = _init_apply(module, x)
+    assert out.shape == (2, 8)
+    assert "log_temperature" in variables["params"]
+
+  def test_gumbel_sampling_stochastic(self):
+    module = spatial_softmax.SpatialSoftmax(gumbel_sampling=True)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 2))
+    out, _ = _init_apply(module, x, train=True)
+    assert out.shape == (2, 4)
+
+
+class TestVision:
+
+  def test_berkeley_net_shapes(self):
+    module = vision.BerkeleyNet()
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    out, _ = _init_apply(module, x)
+    assert out.shape == (2, 64)  # 32 channels * 2 coords
+
+  def test_film_conditioning_changes_output(self):
+    module = vision.BerkeleyNet()
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 16, 16, 3))
+    cond1 = jnp.zeros((2, 8))
+    cond2 = jnp.ones((2, 8))
+    variables = module.init(jax.random.PRNGKey(0), x, cond1)
+    out1 = module.apply(variables, x, cond1)
+    out2 = module.apply(variables, x, cond2)
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+  def test_high_res_variant(self):
+    module = vision.HighResBerkeleyNet(high_res_filters=4)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 16, 16, 3))
+    out, _ = _init_apply(module, x)
+    assert out.shape == (1, 64 + 8)
+
+  def test_pose_head_bias_transform(self):
+    module = vision.PoseHead(output_size=7, bias_transform_size=10)
+    x = jnp.ones((3, 16))
+    out, variables = _init_apply(module, x)
+    assert out.shape == (3, 7)
+    assert variables["params"]["bias_transform"].shape == (10,)
+
+
+class TestFilmResnet:
+
+  @pytest.mark.parametrize("size,expect_bottleneck", [(18, False),
+                                                      (50, True)])
+  def test_resnet_shapes(self, size, expect_bottleneck):
+    module = film_resnet.ResNet(resnet_size=size, num_classes=5)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    (logits, endpoints), _ = _init_apply(module, x)
+    assert logits.shape == (2, 5)
+    final = endpoints["final_reduce_mean"]
+    assert final.shape == (2, 2048 if expect_bottleneck else 512)
+    assert "block_layer4" in endpoints
+
+  def test_unsupported_size_raises(self):
+    module = film_resnet.ResNet(resnet_size=99)
+    with pytest.raises(ValueError, match="Unsupported"):
+      module.init(jax.random.PRNGKey(0),
+                  jnp.zeros((1, 32, 32, 3)))
+
+  def test_film_conditioning_changes_output(self):
+    module = film_resnet.ResNet(resnet_size=18)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 32, 32, 3))
+    variables = module.init(jax.random.PRNGKey(0), x, jnp.zeros((1, 4)))
+    out1, _ = module.apply(variables, x, jnp.zeros((1, 4)))
+    out2, _ = module.apply(variables, x, jnp.ones((1, 4)))
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+  def test_batch_stats_collected(self):
+    module = film_resnet.ResNet(resnet_size=18)
+    x = jnp.ones((1, 32, 32, 3))
+    variables = module.init(jax.random.PRNGKey(0), x)
+    assert "batch_stats" in variables
+
+
+class TestMDN:
+
+  def _params(self, b=4, k=3, d=2):
+    head = mdn.MDNHead(num_components=k, output_size=d)
+    x = jax.random.normal(jax.random.PRNGKey(0), (b, 16))
+    variables = head.init(jax.random.PRNGKey(1), x)
+    return head.apply(variables, x)
+
+  def test_shapes(self):
+    params = self._params()
+    assert params.logits.shape == (4, 3)
+    assert params.means.shape == (4, 3, 2)
+    assert params.scales.shape == (4, 3, 2)
+    assert (np.asarray(params.scales) > 0).all()
+
+  def test_log_prob_matches_single_gaussian(self):
+    # one component -> plain diagonal gaussian log prob
+    logits = jnp.zeros((1, 1))
+    means = jnp.zeros((1, 1, 2))
+    scales = jnp.ones((1, 1, 2))
+    params = mdn.MDNParams(logits, means, scales)
+    value = jnp.array([[0.5, -0.5]])
+    expected = -0.5 * (0.5 ** 2 + 0.5 ** 2) - np.log(2 * np.pi)
+    np.testing.assert_allclose(
+        np.asarray(mdn.mdn_log_prob(params, value))[0], expected, rtol=1e-5)
+
+  def test_sample_and_mode(self):
+    params = self._params()
+    sample = mdn.mdn_sample(jax.random.PRNGKey(0), params)
+    assert sample.shape == (4, 2)
+    mode = mdn.mdn_approximate_mode(params)
+    assert mode.shape == (4, 2)
+
+  def test_decoder_loss_decreases_under_training_signal(self):
+    params = mdn.MDNParams(jnp.zeros((8, 2)),
+                           jnp.zeros((8, 2, 3)),
+                           jnp.ones((8, 2, 3)))
+    target = jnp.zeros((8, 3))
+    near = mdn.MDNDecoder.loss(params, target)
+    far = mdn.MDNDecoder.loss(params, target + 3.0)
+    assert float(near) < float(far)
+
+
+class TestSnail:
+
+  def test_causal_conv_shape_and_causality(self):
+    module = snail.CausalConv(filters=4, kernel_size=2, dilation=2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 8, 3))
+    variables = module.init(jax.random.PRNGKey(1), x)
+    out = module.apply(variables, x)
+    assert out.shape == (1, 8, 4)
+    # causality: changing the last frame must not affect earlier outputs
+    x2 = x.at[0, -1].set(99.0)
+    out2 = module.apply(variables, x2)
+    np.testing.assert_allclose(np.asarray(out[0, :-1]),
+                               np.asarray(out2[0, :-1]), atol=1e-5)
+
+  def test_tc_block_grows_channels(self):
+    module = snail.TCBlock(sequence_length=8, filters=4)
+    x = jnp.ones((2, 8, 3))
+    variables = module.init(jax.random.PRNGKey(0), x)
+    out = module.apply(variables, x)
+    assert out.shape == (2, 8, 3 + 3 * 4)  # ceil(log2(8)) = 3 blocks
+
+  def test_attention_block_causal(self):
+    module = snail.AttentionBlock(key_size=8, value_size=4)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 5))
+    variables = module.init(jax.random.PRNGKey(1), x)
+    out = module.apply(variables, x)
+    assert out.shape == (1, 6, 9)
+    x2 = x.at[0, -1].set(5.0)
+    out2 = module.apply(variables, x2)
+    np.testing.assert_allclose(np.asarray(out[0, :-1]),
+                               np.asarray(out2[0, :-1]), atol=1e-5)
+
+
+class TestTEC:
+
+  def test_embed_episode_normalized(self):
+    module = tec.EmbedEpisode(embedding_size=16)
+    frames = jax.random.normal(jax.random.PRNGKey(0), (4, 5, 10))
+    variables = module.init(jax.random.PRNGKey(1), frames)
+    out = module.apply(variables, frames)
+    assert out.shape == (4, 16)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=-1),
+                               1.0, atol=1e-5)
+
+  def test_reducers(self):
+    x = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+    np.testing.assert_allclose(
+        np.asarray(tec.reduce_temporal_embeddings(x, "final")),
+        np.asarray(x[:, -1]))
+    with pytest.raises(ValueError):
+      tec.reduce_temporal_embeddings(x, "nope")
+
+  def test_npairs_loss_prefers_aligned(self):
+    anchors = jnp.eye(4)
+    aligned = float(tec.npairs_loss(anchors, anchors * 10))
+    shuffled = float(tec.npairs_loss(anchors, jnp.roll(anchors * 10, 1,
+                                                       axis=0)))
+    assert aligned < shuffled
+
+  def test_triplet_semihard(self):
+    emb = jnp.array([[1, 0], [0.9, 0.1], [0, 1], [0.1, 0.9]],
+                    jnp.float32)
+    labels = jnp.array([0, 0, 1, 1])
+    good = float(tec.triplet_semihard_loss(emb, labels, margin=0.5))
+    bad_labels = jnp.array([0, 1, 0, 1])
+    bad = float(tec.triplet_semihard_loss(emb, bad_labels, margin=0.5))
+    assert good < bad
+
+
+class TestBCZNetworks:
+
+  def test_conv_gru_encoder(self):
+    module = bcz_networks.ConvGRUEncoder(hidden_size=16, filters=(8, 8))
+    frames = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 16, 16, 3))
+    out, _ = _init_apply(module, frames)
+    assert out.shape == (2, 3, 16)
+
+  def test_snail_encoder(self):
+    module = bcz_networks.SnailEncoder(sequence_length=4, filters=8)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 6))
+    out, _ = _init_apply(module, x)
+    assert out.shape[0:2] == (2, 4)
+
+  def test_multihead_mlp_stop_gradient(self):
+    module = bcz_networks.MultiHeadMLP(num_waypoints=3, action_size=2,
+                                       hidden_sizes=(8,))
+    x = jnp.ones((2, 4))
+    variables = module.init(jax.random.PRNGKey(0), x)
+
+    def loss_later_heads(v, x):
+      out = module.apply(v, x)
+      return (out[:, 1:] ** 2).sum()  # only future waypoints
+
+    grads = jax.grad(lambda v: loss_later_heads(
+        v, x))(variables)["params"]
+    # future-head losses must not flow into (shared) input features -> the
+    # first head's parameters receive zero gradient
+    head0_grad = grads["head0_fc0"]["kernel"]
+    np.testing.assert_allclose(np.asarray(head0_grad), 0.0)
+    out = module.apply(variables, x)
+    assert out.shape == (2, 3, 2)
