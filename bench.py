@@ -1,0 +1,75 @@
+"""Benchmark: QT-Opt grasping-critic training throughput per chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: grasps (examples) per second per chip through the full jitted
+train step (forward + backward + momentum update + EMA) on the flagship
+QT-Opt critic at batch 256, 64x64x3 bfloat16 images.
+
+Baseline anchor: the reference publishes no absolute throughput
+(BASELINE.md). The anchor used here is the BASELINE.json north star's
+8xV100-class setup estimated at ~400 grasps/sec/GPU for this CNN class,
+i.e. vs_baseline = measured_per_chip / 400. The >=4x north-star target
+therefore reads as vs_baseline >= 4.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+BASELINE_PER_CHIP = 400.0  # est. V100-class grasps/sec/device (see docstring)
+BATCH_SIZE = 256
+IMAGE_SIZE = 64
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main() -> None:
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  device = jax.devices()[0]
+  on_tpu = device.platform != "cpu"
+  batch_size = BATCH_SIZE if on_tpu else 16
+  measure_steps = MEASURE_STEPS if on_tpu else 5
+  image_size = IMAGE_SIZE if on_tpu else 32  # CPU smoke only
+  model = qtopt_models.QTOptModel(
+      image_size=image_size, device_type=device.platform,
+      use_bfloat16=on_tpu, use_ema=True)
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  features = jax.device_put(features, device)
+  labels = jax.device_put(labels, device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  step = ts.make_train_step(model)
+
+  for _ in range(WARMUP_STEPS):
+    state, metrics = step(state, features, labels)
+  jax.block_until_ready(metrics["loss"])
+
+  start = time.perf_counter()
+  for _ in range(measure_steps):
+    state, metrics = step(state, features, labels)
+  jax.block_until_ready(metrics["loss"])
+  elapsed = time.perf_counter() - start
+
+  examples_per_sec = measure_steps * batch_size / elapsed
+  print(json.dumps({
+      "metric": "qtopt_grasps_per_sec_per_chip",
+      "value": round(examples_per_sec, 2),
+      "unit": "examples/sec",
+      "vs_baseline": round(examples_per_sec / BASELINE_PER_CHIP, 3),
+  }))
+
+
+if __name__ == "__main__":
+  main()

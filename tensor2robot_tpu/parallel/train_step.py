@@ -139,7 +139,10 @@ def create_train_state(model,
     variables = model.init_variables(init_rng, features, mode=mode)
     params, mutable = _split_variables(variables)
     opt_state = optimizer.init(params)
-    ema = params if model.use_ema else None
+    # Fresh buffers for the EMA shadow: aliasing params would make the
+    # donated train-step receive the same buffer twice.
+    ema = (jax.tree_util.tree_map(jnp.copy, params)
+           if model.use_ema else None)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=opt_state, mutable_state=mutable,
                       ema_params=ema, rng=state_rng)
@@ -170,11 +173,17 @@ def make_train_step(model,
   (state, scalars)."""
   optimizer = model.create_optimizer()
   ema_decay = model.ema_decay
+  # Multi-task gradient surgery (QT-Opt PCGrad,
+  # /root/reference/research/qtopt/pcgrad.py): when the model exposes
+  # model_task_losses_fn and enables use_pcgrad, per-task gradients are
+  # computed via jacrev and combined with conflict projection.
+  use_pcgrad = bool(getattr(model, "use_pcgrad", False)) and (
+      getattr(model, "model_task_losses_fn", None) is not None)
 
   def step_fn(state: TrainState, features, labels):
     step_rng = jax.random.fold_in(state.rng, state.step)
 
-    def loss_fn(params):
+    def _forward(params):
       variables = {"params": params, **state.mutable_state}
       compute_features = model.cast_features_for_compute(features)
       outputs, new_mutable = model.inference_network_fn(
@@ -183,12 +192,41 @@ def make_train_step(model,
       outputs = jax.tree_util.tree_map(
           lambda x: x.astype(jnp.float32)
           if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 else x, outputs)
-      loss, scalars = model.model_train_fn(
-          features, labels, outputs, modes_lib.TRAIN)
-      return loss, (scalars, new_mutable)
+      return outputs, new_mutable
 
-    (loss, (scalars, new_mutable)), grads = jax.value_and_grad(
-        loss_fn, has_aux=True)(state.params)
+    if use_pcgrad:
+      from tensor2robot_tpu.ops import pcgrad as pcgrad_lib
+
+      def losses_vec(params):
+        outputs, new_mutable = _forward(params)
+        task_losses = model.model_task_losses_fn(
+            features, labels, outputs, modes_lib.TRAIN)
+        stacked = jnp.stack([task_losses[k] for k in sorted(task_losses)])
+        return stacked, (task_losses, new_mutable)
+
+      task_grads_tree, (task_losses, new_mutable) = jax.jacrev(
+          losses_vec, has_aux=True)(state.params)
+      n_tasks = len(task_losses)
+      task_grads = [
+          jax.tree_util.tree_map(lambda g, i=i: g[i], task_grads_tree)
+          for i in range(n_tasks)]
+      grads = pcgrad_lib.pcgrad_combine(
+          task_grads,
+          use_flat_projection=getattr(model, "pcgrad_flat_projection",
+                                      False),
+          allowlist=getattr(model, "pcgrad_allowlist", None),
+          denylist=getattr(model, "pcgrad_denylist", None))
+      loss = sum(task_losses.values())
+      scalars = {f"task_loss/{k}": v for k, v in task_losses.items()}
+    else:
+      def loss_fn(params):
+        outputs, new_mutable = _forward(params)
+        loss, scalars = model.model_train_fn(
+            features, labels, outputs, modes_lib.TRAIN)
+        return loss, (scalars, new_mutable)
+
+      (loss, (scalars, new_mutable)), grads = jax.value_and_grad(
+          loss_fn, has_aux=True)(state.params)
     updates, new_opt_state = optimizer.update(grads, state.opt_state,
                                               state.params)
     new_params = optax.apply_updates(state.params, updates)

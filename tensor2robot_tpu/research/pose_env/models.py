@@ -1,0 +1,111 @@
+"""PoseEnv research models: the end-to-end toy task family.
+
+Reference: /root/reference/research/pose_env/pose_env_models.py:41-320 —
+a continuous Monte-Carlo critic and a regression model over the pose
+task, used as the framework's end-to-end integration fixtures. Networks
+here are BerkeleyNet towers from the layers library over the numpy toy
+env's 32x32 grayscale observations (tensor2robot_tpu.envs.pose_env).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import vision
+from tensor2robot_tpu.models import heads
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["PoseEnvRegressionModel", "PoseEnvContinuousMCModel"]
+
+IMAGE_SIZE = 32
+
+
+class _PoseRegressionNet(nn.Module):
+  filters: Tuple[int, ...] = (32, 16)
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    image = features["state/image"].astype(jnp.float32) / 255.0
+    points = vision.BerkeleyNet(
+        filters=self.filters, kernel_sizes=(5, 3), strides=(2, 1),
+        name="torso")(image, train=train)
+    action = vision.PoseHead(output_size=2, hidden_sizes=(64,),
+                             name="head")(points, train=train)
+    return specs_lib.SpecStruct({"inference_output": action})
+
+
+@config.configurable
+class PoseEnvRegressionModel(heads.RegressionModel):
+  """Behavioral cloning of the reach action from the rendered image."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE, **kwargs):
+    super().__init__(target_label_key="target_pose", **kwargs)
+    self._image_size = image_size
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "state/image": TensorSpec(
+            shape=(self._image_size, self._image_size, 1), dtype=np.uint8,
+            name="state/image", data_format="png"),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "target_pose": TensorSpec(shape=(2,), dtype=np.float32,
+                                  name="action/action"),
+    })
+
+  def create_module(self):
+    return _PoseRegressionNet()
+
+
+class _PoseCriticNet(nn.Module):
+  filters: Tuple[int, ...] = (32, 16)
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    image = features["state/image"].astype(jnp.float32) / 255.0
+    points = vision.BerkeleyNet(
+        filters=self.filters, kernel_sizes=(5, 3), strides=(2, 1),
+        name="torso")(image, train=train)
+    action = features["action/action"].astype(points.dtype)
+    x = jnp.concatenate([points, action], axis=-1)
+    for i, size in enumerate((64, 64)):
+      x = nn.relu(nn.Dense(size, name=f"fc_{i}")(x))
+    q = nn.Dense(1, name="q")(x)
+    return specs_lib.SpecStruct({"q_predicted": q})
+
+
+@config.configurable
+class PoseEnvContinuousMCModel(heads.CriticModel):
+  """Q(image, action) regressed onto Monte-Carlo returns from replay
+  episodes (reference PoseEnvContinuousMCModel)."""
+
+  def __init__(self, image_size: int = IMAGE_SIZE, **kwargs):
+    super().__init__(**kwargs)
+    self._image_size = image_size
+
+  def get_state_specification(self, mode):
+    return SpecStruct({
+        "image": TensorSpec(
+            shape=(self._image_size, self._image_size, 1), dtype=np.uint8,
+            name="state/image", data_format="png"),
+    })
+
+  def get_action_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(shape=(2,), dtype=np.float32,
+                             name="action/action"),
+    })
+
+  def create_module(self):
+    return _PoseCriticNet()
