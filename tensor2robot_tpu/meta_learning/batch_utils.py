@@ -1,0 +1,67 @@
+"""Task-batch reshaping utilities for meta-learning.
+
+Reference: /root/reference/meta_learning/meta_tfdata.py —
+`flatten_batch_examples` / `unflatten_batch_examples` (:174-219) merge and
+split the [task, samples_per_task] leading dims, and `multi_batch_apply`
+(:261-281) vectorizes a function over N leading batch dims. In JAX these
+are pure reshapes over pytrees (zero-copy under XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["flatten_batch_examples", "unflatten_batch_examples",
+           "multi_batch_apply", "split_train_val"]
+
+
+def flatten_batch_examples(tree: Any, num_batch_dims: int = 2) -> Any:
+  """Merges the first `num_batch_dims` dims of every leaf."""
+
+  def _flat(x):
+    shape = jnp.shape(x)
+    if len(shape) < num_batch_dims:
+      raise ValueError(
+          f"Leaf rank {len(shape)} < num_batch_dims {num_batch_dims}")
+    merged = 1
+    for d in shape[:num_batch_dims]:
+      merged *= d
+    return jnp.reshape(x, (merged,) + shape[num_batch_dims:])
+
+  return jax.tree_util.tree_map(_flat, tree)
+
+
+def unflatten_batch_examples(tree: Any,
+                             leading_shape: Sequence[int]) -> Any:
+  """Splits the leading dim of every leaf back into `leading_shape`."""
+  leading = tuple(leading_shape)
+
+  def _unflat(x):
+    shape = jnp.shape(x)
+    return jnp.reshape(x, leading + shape[1:])
+
+  return jax.tree_util.tree_map(_unflat, tree)
+
+
+def multi_batch_apply(fn: Callable, num_batch_dims: int, *args, **kwargs):
+  """Applies `fn` (expecting one batch dim) over N leading dims
+  (reference multi_batch_apply)."""
+  leaves = jax.tree_util.tree_leaves(args)
+  if not leaves:
+    return fn(*args, **kwargs)
+  leading = jnp.shape(leaves[0])[:num_batch_dims]
+  flat_args = flatten_batch_examples(args, num_batch_dims)
+  out = fn(*flat_args, **kwargs)
+  return unflatten_batch_examples(out, leading)
+
+
+def split_train_val(tree: Any, num_train: int) -> Tuple[Any, Any]:
+  """Splits the per-task samples dim into (train, val) halves (reference
+  split_train_val, meta_tfdata.py:130-151). Leaves are [task, samples,
+  ...]; returns ([task, num_train, ...], [task, rest, ...])."""
+  train = jax.tree_util.tree_map(lambda x: x[:, :num_train], tree)
+  val = jax.tree_util.tree_map(lambda x: x[:, num_train:], tree)
+  return train, val

@@ -1,0 +1,236 @@
+"""MAML: model-agnostic meta-learning over any base T2RModel.
+
+Reference: /root/reference/meta_learning/maml_model.py:71-549 and
+maml_inner_loop.py:27-327. The reference implements the inner loop with a
+custom variable getter that caches and rewrites variables inside a
+`tf.map_fn` while-loop — ~900 lines of graph surgery. In JAX the same
+semantics are `jax.grad`-of-`jax.grad` + `jax.vmap` over tasks
+(SURVEY.md §7): per-task adapted parameters are just a pytree threaded
+through a scan, second-order gradients fall out of composition, and
+first-order MAML is a `stop_gradient` on the inner grads
+(reference :184-185). Learned per-variable inner learning rates
+(reference :82-94) are extra flax params.
+
+Spec layout (reference maml_model.py:126-137): features carry
+`condition/{features,labels}` and `inference/{features}` subtrees, each
+leaf with a leading per-task samples dim; labels are the inference-split
+labels. The train step's batch dim is the *task* dim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.meta_learning import batch_utils
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.utils import config
+
+__all__ = ["MAMLModel", "create_maml_feature_spec",
+           "create_maml_label_spec"]
+
+
+def create_maml_feature_spec(feature_spec, label_spec,
+                             num_condition_samples: int = 1,
+                             num_inference_samples: int = 1
+                             ) -> specs_lib.SpecStruct:
+  """condition/{features,labels} + inference/features, each with a
+  per-task samples dim (reference preprocessors.py:34-66)."""
+  out = specs_lib.SpecStruct()
+  for key, spec in specs_lib.flatten_spec_structure(feature_spec).items():
+    out["condition/features/" + key] = spec.with_batch(
+        num_condition_samples)
+    out["inference/features/" + key] = spec.with_batch(
+        num_inference_samples)
+  for key, spec in specs_lib.flatten_spec_structure(label_spec).items():
+    out["condition/labels/" + key] = spec.with_batch(num_condition_samples)
+  return out
+
+
+def create_maml_label_spec(label_spec,
+                           num_inference_samples: int = 1
+                           ) -> specs_lib.SpecStruct:
+  out = specs_lib.SpecStruct()
+  for key, spec in specs_lib.flatten_spec_structure(label_spec).items():
+    out[key] = spec.with_batch(num_inference_samples)
+  return out
+
+
+@config.configurable
+class MAMLModel(abstract_model.T2RModel):
+  """Wraps a base model with a per-task adapted inner loop."""
+
+  def __init__(self,
+               base_model=None,
+               num_inner_loop_steps: int = 1,
+               inner_learning_rate: float = 0.1,
+               learn_inner_lr: bool = False,
+               first_order: bool = False,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1,
+               **kwargs):
+    if base_model is None:
+      raise ValueError("base_model is required.")
+    kwargs.setdefault("device_type", base_model.device_type)
+    super().__init__(**kwargs)
+    self._base_model = base_model
+    self._num_inner_loop_steps = num_inner_loop_steps
+    self._inner_learning_rate = inner_learning_rate
+    self._learn_inner_lr = learn_inner_lr
+    self._first_order = first_order
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  @property
+  def base_model(self):
+    return self._base_model
+
+  # -- specs ----------------------------------------------------------------
+
+  def get_feature_specification(self, mode):
+    return create_maml_feature_spec(
+        self._base_model.get_feature_specification(mode),
+        self._base_model.get_label_specification(mode),
+        self._num_condition, self._num_inference)
+
+  def get_label_specification(self, mode):
+    return create_maml_label_spec(
+        self._base_model.get_label_specification(mode),
+        self._num_inference)
+
+  def create_module(self) -> nn.Module:
+    return self._base_model.module
+
+  # -- init -----------------------------------------------------------------
+
+  def init_variables(self, rng, features, mode=modes_lib.TRAIN):
+    """Initializes base variables from one task's condition split, plus
+    (optionally) learned per-variable inner LRs."""
+    base_features = jax.tree_util.tree_map(
+        lambda x: x[0], specs_lib.flatten_spec_structure(
+            features)["condition/features"])
+    variables = dict(self._base_model.init_variables(
+        rng, base_features, mode=mode))
+    if self._learn_inner_lr:
+      lr_tree = jax.tree_util.tree_map(
+          lambda _: jnp.asarray(self._inner_learning_rate, jnp.float32),
+          variables["params"])
+      variables["params"] = {"base": variables["params"],
+                             "inner_lr": lr_tree}
+    return variables
+
+  def _split_params(self, params):
+    if self._learn_inner_lr:
+      return params["base"], params["inner_lr"]
+    return params, None
+
+  # -- the meta forward pass -----------------------------------------------
+
+  def inference_network_fn(self, variables, features, mode,
+                           rng=None, train=False):
+    base = self._base_model
+    params = variables["params"]
+    mutable = {k: v for k, v in variables.items() if k != "params"}
+    base_params, lr_tree = self._split_params(params)
+    features = specs_lib.flatten_spec_structure(features)
+    cond_features = features["condition/features"]
+    cond_labels = features["condition/labels"]
+    inf_features = features["inference/features"]
+
+    def base_forward(p, task_features):
+      outputs, _ = base.inference_network_fn(
+          {"params": p, **mutable}, task_features, mode, rng=rng,
+          train=False)  # inner loop keeps batch stats frozen (BN pain,
+      # reference maml_model.py:300-304)
+      return outputs
+
+    def inner_loss(p, task_cond_features, task_cond_labels):
+      outputs = base_forward(p, task_cond_features)
+      loss, _ = base.model_train_fn(
+          task_cond_features, task_cond_labels, outputs, mode)
+      return loss
+
+    def task_learn(task_cond_f, task_cond_l, task_inf_f):
+      """One task: adapt on condition split, infer on inference split."""
+      adapted = base_params
+      inner_losses = []
+      for _ in range(self._num_inner_loop_steps):
+        loss, grads = jax.value_and_grad(inner_loss)(
+            adapted, task_cond_f, task_cond_l)
+        if self._first_order:
+          grads = jax.lax.stop_gradient(grads)
+        inner_losses.append(loss)
+        if lr_tree is not None:
+          adapted = jax.tree_util.tree_map(
+              lambda p, g, lr: p - lr * g, adapted, grads, lr_tree)
+        else:
+          adapted = jax.tree_util.tree_map(
+              lambda p, g: p - self._inner_learning_rate * g,
+              adapted, grads)
+      inner_losses.append(inner_loss(adapted, task_cond_f, task_cond_l))
+      conditioned = base_forward(adapted, task_inf_f)
+      unconditioned = base_forward(base_params, task_inf_f)
+      return conditioned, unconditioned, jnp.stack(inner_losses)
+
+    conditioned, unconditioned, inner_losses = jax.vmap(task_learn)(
+        cond_features, cond_labels, inf_features)
+
+    out = specs_lib.SpecStruct()
+    out["conditioned_output"] = specs_lib.flatten_spec_structure(
+        conditioned) if isinstance(conditioned, dict) else conditioned
+    out["unconditioned_output"] = specs_lib.flatten_spec_structure(
+        unconditioned) if isinstance(unconditioned, dict) else unconditioned
+    out["inner_losses"] = inner_losses  # [task, steps + 1]
+    return out, {}
+
+  # -- outer loss -----------------------------------------------------------
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    """Outer loss: base train fn on the flattened inference split
+    (reference maml_model.py:415-496)."""
+    base = self._base_model
+    features = specs_lib.flatten_spec_structure(features)
+    flat_features = batch_utils.flatten_batch_examples(
+        features["inference/features"])
+    flat_labels = batch_utils.flatten_batch_examples(labels)
+    flat_outputs = batch_utils.flatten_batch_examples(
+        inference_outputs["conditioned_output"])
+    loss, scalars = base.model_train_fn(
+        flat_features, flat_labels, flat_outputs, mode)
+    inner = inference_outputs["inner_losses"]
+    scalars = dict(scalars)
+    scalars["inner_loss_initial"] = inner[:, 0].mean()
+    scalars["inner_loss_final"] = inner[:, -1].mean()
+    return loss, scalars
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    base = self._base_model
+    features = specs_lib.flatten_spec_structure(features)
+    flat_features = batch_utils.flatten_batch_examples(
+        features["inference/features"])
+    flat_labels = batch_utils.flatten_batch_examples(labels)
+    flat_cond = batch_utils.flatten_batch_examples(
+        inference_outputs["conditioned_output"])
+    flat_uncond = batch_utils.flatten_batch_examples(
+        inference_outputs["unconditioned_output"])
+    metrics = {f"conditioned/{k}": v for k, v in base.model_eval_fn(
+        flat_features, flat_labels, flat_cond).items()}
+    metrics.update({f"unconditioned/{k}": v for k, v in base.model_eval_fn(
+        flat_features, flat_labels, flat_uncond).items()})
+    if "conditioned/loss" in metrics:
+      metrics["loss"] = metrics["conditioned/loss"]
+    else:
+      loss, _ = base.model_train_fn(flat_features, flat_labels, flat_cond,
+                                    modes_lib.EVAL)
+      metrics["loss"] = loss
+    return metrics
+
+  def create_optimizer(self):
+    if self._optimizer_fn is not None:
+      return super().create_optimizer()
+    return self._base_model.create_optimizer()

@@ -1,0 +1,34 @@
+"""MetaExample record construction: merge episode Examples under
+`<prefix>_ep<i>/` key prefixes.
+
+Reference: /root/reference/meta_learning/meta_example.py:27-65 — a
+MetaExample is one wire record carrying N condition episodes and M
+inference episodes, each episode's features renamed with its split/index
+prefix so `FixedLenMetaExamplePreprocessor` can restack them.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+from tensor2robot_tpu.data import example_pb2
+
+__all__ = ["make_meta_example"]
+
+
+def _merge_with_prefix(target: "example_pb2.Example",
+                       source_bytes: bytes, prefix: str) -> None:
+  source = example_pb2.Example.FromString(source_bytes)
+  for name, feature in source.features.feature.items():
+    target.features.feature[f"{prefix}/{name}"].CopyFrom(feature)
+
+
+def make_meta_example(condition_examples: Sequence[bytes],
+                      inference_examples: Sequence[bytes]) -> bytes:
+  """Merges serialized episode Examples into one serialized MetaExample."""
+  merged = example_pb2.Example()
+  for i, episode in enumerate(condition_examples):
+    _merge_with_prefix(merged, episode, f"condition_ep{i}")
+  for i, episode in enumerate(inference_examples):
+    _merge_with_prefix(merged, episode, f"inference_ep{i}")
+  return merged.SerializeToString()

@@ -1,0 +1,143 @@
+"""Meta-learning policies: condition-on-demo action selection.
+
+Reference: /root/reference/meta_learning/meta_policies.py:26-201 —
+`MetaLearningPolicy` (an `adapt()` ABC over demo episodes),
+`MAMLRegressionPolicy` / `MAMLCEMPolicy` (feed condition data alongside
+the live observation), `FixedLengthSequentialRegressionPolicy` and the
+scheduled-exploration variant.
+
+A MAML predictor's features are the meta layout (condition/features,
+condition/labels, inference/features); these policies maintain the
+condition buffer from `adapt()` and splice the live observation into the
+inference split.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+from tensor2robot_tpu.policies import policies as policies_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["MetaLearningPolicy", "MAMLRegressionPolicy", "MAMLCEMPolicy",
+           "FixedLengthSequentialRegressionPolicy"]
+
+
+class MetaLearningPolicy(policies_lib.Policy):
+  """Policy that first adapts to demonstration data (reference adapt())."""
+
+  def __init__(self, predictor=None):
+    super().__init__(predictor)
+    self._condition_features: Optional[Dict[str, np.ndarray]] = None
+    self._condition_labels: Optional[Dict[str, np.ndarray]] = None
+
+  def adapt(self, condition_features: Mapping[str, Any],
+            condition_labels: Mapping[str, Any]) -> None:
+    """Stores the demo (condition) split; arrays are [num_samples, ...]."""
+    self._condition_features = {k: np.asarray(v)
+                                for k, v in dict(condition_features).items()}
+    self._condition_labels = {k: np.asarray(v)
+                              for k, v in dict(condition_labels).items()}
+
+  def reset(self) -> None:
+    self._condition_features = None
+    self._condition_labels = None
+
+  def _meta_features(self, inference_features: Mapping[str, np.ndarray]
+                     ) -> Dict[str, np.ndarray]:
+    if self._condition_features is None:
+      raise ValueError("Call adapt() with demo data before acting.")
+    features: Dict[str, np.ndarray] = {}
+    for key, value in self._condition_features.items():
+      features[f"condition/features/{key}"] = value[None]  # task batch 1
+    for key, value in self._condition_labels.items():
+      features[f"condition/labels/{key}"] = value[None]
+    for key, value in dict(inference_features).items():
+      features[f"inference/features/{key}"] = np.asarray(value)[None]
+    return features
+
+
+@config.configurable
+class MAMLRegressionPolicy(MetaLearningPolicy):
+  """Regression through the adapted model (reference MAMLRegressionPolicy)."""
+
+  def __init__(self, predictor=None, action_key: str = "inference_output",
+               num_inference_samples: int = 1):
+    super().__init__(predictor)
+    self._action_key = action_key
+    self._num_inference = num_inference_samples
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    inference = {k: np.repeat(np.asarray(v)[None], self._num_inference,
+                              axis=0)
+                 for k, v in dict(obs).items()}
+    outputs = self._predictor.predict(self._meta_features(inference))
+    action = np.asarray(outputs["conditioned_output/" + self._action_key])
+    return action[0, 0]  # [task, sample, ...] -> first
+
+
+@config.configurable
+class MAMLCEMPolicy(MetaLearningPolicy):
+  """CEM over an adapted critic (reference MAMLCEMPolicy)."""
+
+  def __init__(self, predictor=None, action_size: int = None,
+               cem_samples: int = 64, cem_iterations: int = 3,
+               cem_elites: int = 10, q_key: str = "q_predicted",
+               seed: Optional[int] = None):
+    super().__init__(predictor)
+    if action_size is None:
+      raise ValueError("action_size is required.")
+    from tensor2robot_tpu.ops import cem as cem_lib
+
+    self._action_size = action_size
+    self._cem = cem_lib.CrossEntropyMethod(
+        num_samples=cem_samples, num_iterations=cem_iterations,
+        num_elites=cem_elites, seed=seed)
+    self._q_key = q_key
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    def objective(actions: np.ndarray) -> np.ndarray:
+      n = actions.shape[0]
+      inference = {("state/" + k): np.repeat(np.asarray(v)[None], n, axis=0)
+                   for k, v in dict(obs).items()}
+      inference["action/action"] = actions
+      outputs = self._predictor.predict(self._meta_features(inference))
+      q = np.asarray(outputs["conditioned_output/" + self._q_key])
+      return q.reshape(-1)
+
+    best, _ = self._cem.optimize(
+        objective, mean=np.zeros(self._action_size),
+        stddev=np.ones(self._action_size))
+    return best
+
+
+@config.configurable
+class FixedLengthSequentialRegressionPolicy(MAMLRegressionPolicy):
+  """Adapted regression over trajectory outputs: walk the waypoint rows
+  (reference FixedLengthSequentialRegressionPolicy)."""
+
+  def __init__(self, **kwargs):
+    super().__init__(**kwargs)
+    self._timestep = 0
+
+  def reset(self) -> None:
+    super().reset()
+    self._timestep = 0
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    inference = {k: np.repeat(np.asarray(v)[None], self._num_inference,
+                              axis=0)
+                 for k, v in dict(obs).items()}
+    outputs = self._predictor.predict(self._meta_features(inference))
+    action_all = np.asarray(
+        outputs["conditioned_output/" + self._action_key])[0, 0]
+    if action_all.ndim >= 2:
+      idx = min(self._timestep, action_all.shape[0] - 1)
+      action = action_all[idx]
+    else:
+      action = action_all
+    self._timestep += 1
+    return action

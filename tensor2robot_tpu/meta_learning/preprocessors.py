@@ -1,0 +1,206 @@
+"""Meta-learning preprocessors: task-structured spec/batch transforms.
+
+Reference: /root/reference/meta_learning/preprocessors.py —
+`create_maml_feature_spec` (:34-66, here in maml.py),
+`MAMLPreprocessor` (:84-284: flatten task x sample dims, run the base
+preprocessor, unflatten), `create_metaexample_spec` (:287-312:
+`<prefix>_ep<i>/` episode-column naming) and
+`FixedLenMetaExamplePreprocessor` (:340-413: stack per-episode columns
+into condition/inference splits).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.meta_learning import batch_utils, maml
+from tensor2robot_tpu.preprocessors import base as preprocessors_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["MAMLPreprocessor", "create_metaexample_spec",
+           "FixedLenMetaExamplePreprocessor"]
+
+
+@config.configurable
+class MAMLPreprocessor(preprocessors_lib.AbstractPreprocessor):
+  """Applies a base preprocessor inside the meta structure.
+
+  In/out specs are the meta versions of the base preprocessor's in/out
+  specs; the transform flattens the [task, samples] leading dims of each
+  split, applies the base `_preprocess_fn`, and restores the dims.
+  """
+
+  def __init__(self, base_preprocessor=None,
+               num_condition_samples_per_task: int = 1,
+               num_inference_samples_per_task: int = 1, **kwargs):
+    super().__init__(**kwargs)
+    if base_preprocessor is None:
+      raise ValueError("base_preprocessor is required.")
+    self._base = base_preprocessor
+    self._num_condition = num_condition_samples_per_task
+    self._num_inference = num_inference_samples_per_task
+
+  def set_model_specifications(self, feature_fn, label_fn):
+    self._base.set_model_specifications(feature_fn, label_fn)
+
+  def _meta_spec(self, feature_spec, label_spec):
+    return maml.create_maml_feature_spec(
+        feature_spec, label_spec, self._num_condition, self._num_inference)
+
+  def get_in_feature_specification(self, mode):
+    return self._meta_spec(self._base.get_in_feature_specification(mode),
+                           self._base.get_in_label_specification(mode))
+
+  def get_in_label_specification(self, mode):
+    return maml.create_maml_label_spec(
+        self._base.get_in_label_specification(mode), self._num_inference)
+
+  def get_out_feature_specification(self, mode):
+    return self._meta_spec(self._base.get_out_feature_specification(mode),
+                           self._base.get_out_label_specification(mode))
+
+  def get_out_label_specification(self, mode):
+    return maml.create_maml_label_spec(
+        self._base.get_out_label_specification(mode), self._num_inference)
+
+  def _apply_base(self, features, labels, mode):
+    out_f, out_l = self._base._preprocess_fn(features, labels, mode)
+    return out_f, out_l
+
+  def _preprocess_fn(self, features, labels, mode):
+    features = specs_lib.flatten_spec_structure(features)
+    out = specs_lib.SpecStruct()
+
+    def _one_split(split_features, split_labels):
+      leading = np.shape(
+          specs_lib.flatten_spec_structure(split_features).to_flat_dict()
+          .popitem()[1])[:2]
+      flat_f = batch_utils.flatten_batch_examples(split_features)
+      flat_l = (batch_utils.flatten_batch_examples(split_labels)
+                if split_labels is not None else specs_lib.SpecStruct())
+      out_f, out_l = self._apply_base(flat_f, flat_l, mode)
+      out_f = batch_utils.unflatten_batch_examples(out_f, leading)
+      if out_l is not None and len(out_l):
+        out_l = batch_utils.unflatten_batch_examples(out_l, leading)
+      return out_f, out_l
+
+    cond_f, cond_l = _one_split(features["condition/features"],
+                                features["condition/labels"])
+    out["condition/features"] = cond_f
+    out["condition/labels"] = cond_l
+    inf_f, _ = _one_split(features["inference/features"], None)
+    out["inference/features"] = inf_f
+    out_labels = labels
+    if labels is not None and len(labels):
+      leading = np.shape(next(iter(
+          specs_lib.flatten_spec_structure(labels).values())))[:2]
+      flat_labels = batch_utils.flatten_batch_examples(labels)
+      _, out_l = self._apply_base(
+          batch_utils.flatten_batch_examples(features["inference/features"]),
+          flat_labels, mode)
+      out_labels = batch_utils.unflatten_batch_examples(out_l, leading)
+    return out, out_labels
+
+
+def create_metaexample_spec(spec_structure,
+                            num_episodes: int,
+                            prefix: str) -> specs_lib.SpecStruct:
+  """`<prefix>_ep<i>/<key>` columns for fixed-length meta-episodes
+  (reference :287-312)."""
+  out = specs_lib.SpecStruct()
+  flat = specs_lib.flatten_spec_structure(spec_structure)
+  for i in range(num_episodes):
+    for key, spec in flat.items():
+      name = spec.name or key
+      out[f"{prefix}_ep{i}/{key}"] = spec.replace(
+          name=f"{prefix}_ep{i}/{name}")
+  return out
+
+
+@config.configurable
+class FixedLenMetaExamplePreprocessor(preprocessors_lib.AbstractPreprocessor):
+  """Parses `<prefix>_ep<i>/` columns and stacks them into the
+  condition/inference meta layout (reference :340-413)."""
+
+  def __init__(self, base_preprocessor=None,
+               num_condition_episodes: int = 1,
+               num_inference_episodes: int = 1, **kwargs):
+    super().__init__(**kwargs)
+    if base_preprocessor is None:
+      raise ValueError("base_preprocessor is required.")
+    self._base = base_preprocessor
+    self._num_condition = num_condition_episodes
+    self._num_inference = num_inference_episodes
+
+  def set_model_specifications(self, feature_fn, label_fn):
+    self._base.set_model_specifications(feature_fn, label_fn)
+
+  def get_in_feature_specification(self, mode):
+    out = specs_lib.SpecStruct()
+    features = self._base.get_in_feature_specification(mode)
+    labels = self._base.get_in_label_specification(mode)
+    merged = specs_lib.SpecStruct()
+    merged["features"] = features
+    merged["labels"] = labels
+    for key, spec in create_metaexample_spec(
+        merged, self._num_condition, "condition").items():
+      out[key] = spec
+    for key, spec in create_metaexample_spec(
+        specs_lib.SpecStruct({"features": features}),
+        self._num_inference, "inference").items():
+      out[key] = spec
+    return out
+
+  def get_in_label_specification(self, mode):
+    return create_metaexample_spec(
+        self._base.get_in_label_specification(mode),
+        self._num_inference, "inference")
+
+  def get_out_feature_specification(self, mode):
+    return maml.create_maml_feature_spec(
+        self._base.get_out_feature_specification(mode),
+        self._base.get_out_label_specification(mode),
+        self._num_condition, self._num_inference)
+
+  def get_out_label_specification(self, mode):
+    return maml.create_maml_label_spec(
+        self._base.get_out_label_specification(mode), self._num_inference)
+
+  def _preprocess_fn(self, features, labels, mode):
+    features = specs_lib.flatten_spec_structure(features)
+    out = specs_lib.SpecStruct()
+
+    def _stack(prefix, count):
+      """[ep_i columns] -> [batch, count, ...] under meta subtree."""
+      collected = {}
+      for i in range(count):
+        episode = specs_lib.flatten_spec_structure(
+            features[f"{prefix}_ep{i}"])
+        for key, value in episode.items():
+          collected.setdefault(key, []).append(value)
+      stacked = specs_lib.SpecStruct()
+      for key, values in collected.items():
+        stacked[key] = np.stack([np.asarray(v) for v in values], axis=1)
+      return stacked
+
+    cond = _stack("condition", self._num_condition)
+    out["condition/features"] = cond["features"]
+    out["condition/labels"] = cond["labels"]
+    inf = _stack("inference", self._num_inference)
+    out["inference/features"] = inf["features"]
+    out_labels = labels
+    if labels is not None and len(labels):
+      label_cols = {}
+      flat_labels = specs_lib.flatten_spec_structure(labels)
+      for i in range(self._num_inference):
+        episode = specs_lib.flatten_spec_structure(
+            flat_labels[f"inference_ep{i}"])
+        for key, value in episode.items():
+          label_cols.setdefault(key, []).append(value)
+      out_labels = specs_lib.SpecStruct()
+      for key, values in label_cols.items():
+        out_labels[key] = np.stack([np.asarray(v) for v in values], axis=1)
+    return out, out_labels

@@ -1,0 +1,175 @@
+"""Tests for meta preprocessors, MetaExample records, meta policies, and
+run_meta_env."""
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.data import codec, example_pb2, parsing
+from tensor2robot_tpu.envs import pose_env, run_meta_env
+from tensor2robot_tpu.meta_learning import (batch_utils, maml, meta_example,
+                                            meta_policies, preprocessors)
+from tensor2robot_tpu.predictors import predictors as predictors_lib
+from tensor2robot_tpu.preprocessors import NoOpPreprocessor
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+def _base_specs():
+  feature_spec = SpecStruct({"x": TensorSpec(shape=(3,), name="x")})
+  label_spec = SpecStruct({"y": TensorSpec(shape=(1,), name="y")})
+  return feature_spec, label_spec
+
+
+def _noop_base():
+  f, l = _base_specs()
+  return NoOpPreprocessor(model_feature_specification_fn=lambda m: f,
+                          model_label_specification_fn=lambda m: l)
+
+
+class TestMAMLPreprocessor:
+
+  def test_meta_spec_layout_and_transform(self):
+    pre = preprocessors.MAMLPreprocessor(
+        base_preprocessor=_noop_base(),
+        num_condition_samples_per_task=4,
+        num_inference_samples_per_task=2)
+    in_spec = pre.get_in_feature_specification(modes.TRAIN)
+    assert in_spec["condition/features/x"].shape == (4, 3)
+    assert in_spec["inference/features/x"].shape == (2, 3)
+    batch = SpecStruct()
+    batch["condition/features/x"] = np.ones((5, 4, 3), np.float32)
+    batch["condition/labels/y"] = np.ones((5, 4, 1), np.float32)
+    batch["inference/features/x"] = np.ones((5, 2, 3), np.float32)
+    labels = SpecStruct({"y": np.ones((5, 2, 1), np.float32)})
+    out_f, out_l = pre.preprocess(batch, labels, modes.TRAIN)
+    assert out_f["condition/features/x"].shape == (5, 4, 3)
+    assert out_l["y"].shape == (5, 2, 1)
+
+
+class TestMetaExample:
+
+  def test_roundtrip_through_fixedlen_preprocessor(self):
+    f, l = _base_specs()
+    episodes_c, episodes_i = [], []
+    for i in range(2):
+      episodes_c.append(codec.encode_example(
+          {"x": np.full(3, i, np.float32), "y": np.array([i], np.float32)},
+          None))
+    episodes_i.append(codec.encode_example(
+        {"x": np.full(3, 9, np.float32), "y": np.array([9], np.float32)},
+        None))
+    record = meta_example.make_meta_example(episodes_c, episodes_i)
+    parsed = example_pb2.Example.FromString(record)
+    assert "condition_ep0/x" in parsed.features.feature
+    assert "condition_ep1/y" in parsed.features.feature
+    assert "inference_ep0/x" in parsed.features.feature
+
+    pre = preprocessors.FixedLenMetaExamplePreprocessor(
+        base_preprocessor=_noop_base(),
+        num_condition_episodes=2, num_inference_episodes=1)
+    in_spec = pre.get_in_feature_specification(modes.TRAIN)
+    in_label_spec = pre.get_in_label_specification(modes.TRAIN)
+    merged = SpecStruct()
+    for key, spec in in_spec.items():
+      merged["features/" + key] = spec
+    for key, spec in in_label_spec.items():
+      merged["labels/" + key] = spec
+    parse_fn = parsing.ParseFn(in_spec, in_label_spec)
+    out = parse_fn.parse_batch([record])
+    features, labels = pre.preprocess(out["features"], out["labels"],
+                                      modes.TRAIN)
+    assert features["condition/features/x"].shape == (1, 2, 3)
+    np.testing.assert_allclose(features["condition/features/x"][0, 1], 1.0)
+    np.testing.assert_allclose(features["inference/features/x"][0, 0], 9.0)
+    assert labels["y"].shape == (1, 1, 1)
+
+
+class _FakeMetaPredictor(predictors_lib.AbstractPredictor):
+  """Returns the mean of condition labels as the action (checks that the
+  condition buffer actually reaches the predictor)."""
+
+  def predict(self, features):
+    cond_y = features["condition/labels/y"]  # [task, samples, 1]
+    inf_x = features["inference/features/x"]
+    mean = cond_y.mean(axis=1, keepdims=True)
+    action = np.tile(mean, (1, inf_x.shape[1], 1)).astype(np.float32)
+    return {"conditioned_output/inference_output":
+            np.concatenate([action, action], axis=-1)}
+
+  def get_feature_specification(self):
+    return None
+
+  def restore(self):
+    return True
+
+
+class TestMetaPolicies:
+
+  def test_maml_regression_policy_uses_condition_buffer(self):
+    policy = meta_policies.MAMLRegressionPolicy(
+        predictor=_FakeMetaPredictor())
+    policy.adapt({"x": np.zeros((4, 3), np.float32)},
+                 {"y": np.full((4, 1), 0.5, np.float32)})
+    action = policy.select_action({"x": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(action, [0.5, 0.5])
+
+  def test_acting_before_adapt_raises(self):
+    policy = meta_policies.MAMLRegressionPolicy(
+        predictor=_FakeMetaPredictor())
+    with pytest.raises(ValueError, match="adapt"):
+      policy.select_action({"x": np.zeros(3, np.float32)})
+
+  def test_reset_clears_buffer(self):
+    policy = meta_policies.MAMLRegressionPolicy(
+        predictor=_FakeMetaPredictor())
+    policy.adapt({"x": np.zeros((1, 3))}, {"y": np.zeros((1, 1))})
+    policy.reset()
+    with pytest.raises(ValueError):
+      policy.select_action({"x": np.zeros(3, np.float32)})
+
+
+class _AdaptToTargetPolicy(meta_policies.MetaLearningPolicy):
+  """Extracts the demo's action mean — perfect for the toy reach task."""
+
+  def select_action(self, obs, explore_prob=0.0):
+    return self._condition_labels["action"].mean(axis=0)
+
+
+class TestRunMetaEnv:
+
+  def test_meta_loop_adaptation_beats_random(self, tmp_path):
+    env = pose_env.PoseToyEnv(seed=0)
+
+    class DemoPolicy:
+      """Oracle demos: acts at the target."""
+
+      def sample_action(self, obs):
+        return env._target.copy()
+
+      def reset(self):
+        pass
+
+    def demo_to_condition(demos):
+      actions = np.stack([step["action"] for episode in demos
+                          for step in episode])
+      obs = np.stack([step["obs"]["image"].ravel()[:3] for episode in demos
+                      for step in episode]).astype(np.float32)
+      return {"obs": obs}, {"action": actions}
+
+    stats = run_meta_env.run_meta_env(
+        env=env, policy=_AdaptToTargetPolicy(),
+        demo_policy=DemoPolicy(),
+        num_tasks=4, num_demos_per_task=1, num_trials_per_task=2,
+        demo_to_condition_fn=demo_to_condition,
+        root_dir=str(tmp_path))
+    # the oracle-derived adapted policy lands on the target: ~0 reward
+    assert stats["meta_eval/reward_mean"] > -0.05
+    assert "meta_eval/reward_trial_0" in stats
