@@ -1,0 +1,243 @@
+"""BC-Z: language/task-conditioned behavioral cloning with trajectory
+(waypoint) outputs.
+
+Reference: /root/reference/research/bcz/ — `BCZPreprocessor`
+(model.py:68-194: crop/resize/mixup/gripper-binarize), the
+spatial-softmax / FiLM-ResNet / stop-prediction networks (:197-319),
+per-action-component losses with huber scaling and stop-token masking
+(:321-638), and `BCZModel` (:641-950: state/action component config,
+language-embedding conditioning) with the pose-components table
+(pose_components_lib.py).
+
+TPU-first notes: the torso is the FiLM-ResNet from the layers library
+running in the model's compute dtype; waypoint heads are the
+stop-gradient MultiHeadMLP; mixup and image distortion run as jnp ops.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import bcz_networks, film_resnet, vision
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.preprocessors import base as preprocessors_lib
+from tensor2robot_tpu.preprocessors import image_ops
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["POSE_COMPONENTS", "BCZPreprocessor", "BCZModel"]
+
+# (name, size, loss_weight) — the action decomposition table
+# (reference pose_components_lib.py).
+POSE_COMPONENTS: Tuple[Tuple[str, int, float], ...] = (
+    ("xyz", 3, 1.0),
+    ("axis_angle", 3, 1.0),
+    ("gripper", 1, 1.0),
+)
+STOP_KEY = "stop"
+
+
+def huber(x: jnp.ndarray, delta: float = 1.0) -> jnp.ndarray:
+  abs_x = jnp.abs(x)
+  return jnp.where(abs_x <= delta, 0.5 * x ** 2,
+                   delta * (abs_x - 0.5 * delta))
+
+
+@config.configurable
+class BCZPreprocessor(preprocessors_lib.SpecTransformationPreprocessor):
+  """Crop/resize + photometric distortion + mixup + gripper binarize
+  (reference model.py:68-194). The wire image is larger than the model
+  image; training crops randomly, eval center-crops."""
+
+  def __init__(self,
+               input_size: Tuple[int, int] = (96, 96),
+               crop_size: Tuple[int, int] = (80, 80),
+               model_size: Tuple[int, int] = (64, 64),
+               mixup_alpha: float = 0.0,
+               binarize_gripper: bool = True,
+               seed: int = 0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._input_size = input_size
+    self._crop_size = crop_size
+    self._model_size = model_size
+    self._mixup_alpha = mixup_alpha
+    self._binarize_gripper = binarize_gripper
+    self._seed = seed
+    self._calls = 0
+
+  def update_in_spec(self, spec, key):
+    if key == "image":
+      return spec.replace(shape=self._input_size + (spec.shape[-1],),
+                          dtype=np.uint8)
+    return spec
+
+  def _preprocess_fn(self, features, labels, mode):
+    features = specs_lib.flatten_spec_structure(features)
+    self._calls += 1
+    key = jax.random.PRNGKey(self._seed + self._calls)
+    is_training = mode == modes_lib.TRAIN
+    image = image_ops.crop_resize_distort(
+        key, jnp.asarray(features["image"]), self._crop_size,
+        self._model_size, is_training=is_training)
+    features["image"] = np.asarray(image, np.float32)
+    if labels is not None and len(labels):
+      labels = specs_lib.flatten_spec_structure(labels)
+      if self._binarize_gripper and "gripper" in labels:
+        labels["gripper"] = (np.asarray(labels["gripper"]) > 0.5).astype(
+            np.float32)
+      if is_training and self._mixup_alpha > 0.0:
+        lam = float(np.random.default_rng(self._calls).beta(
+            self._mixup_alpha, self._mixup_alpha))
+        perm = np.roll(np.arange(features["image"].shape[0]), 1)
+        features["image"] = (lam * features["image"]
+                             + (1 - lam) * features["image"][perm])
+        for k in list(labels.keys()):
+          arr = np.asarray(labels[k], np.float32)
+          labels[k] = lam * arr + (1 - lam) * arr[perm]
+    return features, labels
+
+
+class _BCZNetwork(nn.Module):
+  """FiLM-ResNet (or spatial-softmax tower) -> waypoint heads + stop."""
+
+  components: Tuple[Tuple[str, int, float], ...] = POSE_COMPONENTS
+  num_waypoints: int = 10
+  network: str = "resnet_film"  # 'resnet_film' | 'spatial_softmax'
+  resnet_size: int = 18
+  condition_size: int = 0
+  predict_stop: bool = True
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    image = features["image"]
+    if jnp.issubdtype(image.dtype, jnp.integer):
+      image = image.astype(jnp.float32) / 255.0
+    conditioning = None
+    if self.condition_size:
+      conditioning = features["condition_embedding"]
+    if self.network == "resnet_film":
+      feats, _ = film_resnet.ResNet(
+          resnet_size=self.resnet_size, name="resnet")(
+              image, conditioning, train=train)
+    else:
+      feats = vision.BerkeleyNet(name="tower")(image, conditioning,
+                                               train=train)
+    if "present_pose" in features:
+      feats = jnp.concatenate(
+          [feats, features["present_pose"].astype(feats.dtype)], axis=-1)
+    action_size = sum(size for _, size, _ in self.components)
+    waypoints = bcz_networks.MultiHeadMLP(
+        num_waypoints=self.num_waypoints, action_size=action_size,
+        name="decoder")(feats, train=train)  # [B, W, action_size]
+    outputs = specs_lib.SpecStruct()
+    offset = 0
+    for name, size, _ in self.components:
+      outputs[name] = waypoints[:, :, offset:offset + size]
+      offset += size
+    if self.predict_stop:
+      stop_feats = jax.lax.stop_gradient(feats)
+      x = nn.relu(nn.Dense(64, name="stop_fc")(stop_feats))
+      outputs[STOP_KEY] = nn.Dense(self.num_waypoints,
+                                   name="stop_logits")(x)
+    return outputs
+
+
+@config.configurable
+class BCZModel(abstract_model.T2RModel):
+  """The BC-Z trajectory cloner."""
+
+  def __init__(self,
+               image_size: int = 64,
+               num_waypoints: int = 10,
+               components: Sequence = POSE_COMPONENTS,
+               network: str = "resnet_film",
+               resnet_size: int = 18,
+               condition_size: int = 0,
+               predict_stop: bool = True,
+               huber_delta: float = 1.0,
+               stop_loss_weight: float = 0.1,
+               **kwargs):
+    kwargs.setdefault("preprocessor_cls", BCZPreprocessor)
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._num_waypoints = num_waypoints
+    self._components = tuple(tuple(c) for c in components)
+    self._network = network
+    self._resnet_size = resnet_size
+    self._condition_size = condition_size
+    self._predict_stop = predict_stop
+    self._huber_delta = huber_delta
+    self._stop_loss_weight = stop_loss_weight
+
+  def get_feature_specification(self, mode):
+    out = SpecStruct({
+        "image": TensorSpec(
+            shape=(self._image_size, self._image_size, 3),
+            dtype=np.float32, name="image/encoded", data_format="jpeg"),
+        "present_pose": TensorSpec(shape=(7,), dtype=np.float32,
+                                   name="present_pose", is_optional=True),
+    })
+    if self._condition_size:
+      out["condition_embedding"] = TensorSpec(
+          shape=(self._condition_size,), dtype=np.float32,
+          name="condition_embedding")
+    return out
+
+  def get_label_specification(self, mode):
+    out = SpecStruct()
+    for name, size, _ in self._components:
+      out[name] = TensorSpec(shape=(self._num_waypoints, size),
+                             dtype=np.float32, name=name)
+    if self._predict_stop:
+      out[STOP_KEY] = TensorSpec(shape=(self._num_waypoints,),
+                                 dtype=np.float32, name=STOP_KEY)
+    return out
+
+  def create_module(self):
+    return _BCZNetwork(
+        components=self._components, num_waypoints=self._num_waypoints,
+        network=self._network, resnet_size=self._resnet_size,
+        condition_size=self._condition_size,
+        predict_stop=self._predict_stop)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    scalars: Dict[str, jnp.ndarray] = {}
+    total = 0.0
+    # Steps after the episode stops contribute no action loss
+    # (reference stop-token masking :321-638).
+    mask = 1.0
+    if self._predict_stop and STOP_KEY in labels:
+      stop = labels[STOP_KEY]  # 1.0 once stopped
+      mask = (1.0 - stop)[:, :, None]
+    for name, size, weight in self._components:
+      err = inference_outputs[name] - labels[name]
+      component_loss = (huber(err, self._huber_delta) * mask).mean()
+      scalars[f"loss/{name}"] = component_loss
+      total = total + weight * component_loss
+    if self._predict_stop and STOP_KEY in labels:
+      logits = inference_outputs[STOP_KEY]
+      stop = labels[STOP_KEY]
+      stop_loss = jnp.mean(
+          jnp.maximum(logits, 0) - logits * stop
+          + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+      scalars["loss/stop"] = stop_loss
+      total = total + self._stop_loss_weight * stop_loss
+    return total, scalars
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    metrics = {"loss": loss, **scalars}
+    for name, size, _ in self._components:
+      metrics[f"mae/{name}"] = jnp.abs(
+          inference_outputs[name] - labels[name]).mean()
+    return metrics

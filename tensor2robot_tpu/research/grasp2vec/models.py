@@ -1,0 +1,146 @@
+"""Grasp2Vec: self-supervised grasping representation via embedding
+arithmetic.
+
+Reference: /root/reference/research/grasp2vec/ — scene/goal `Embedding`
+towers (networks.py), `Grasp2VecModel` with the
+phi(pregrasp) - phi(postgrasp) ~= psi(goal) objective
+(grasp2vec_model.py:136-240), the NPairs/Triplet/Arithmetic losses +
+keypoint accuracy (losses.py:29-296) and heatmap visualization
+(visualization.py:31-260).
+
+The scene tower keeps its spatial map so goal embeddings can be
+dot-producted against it for localization heatmaps — all batched matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import tec as tec_lib
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["SceneEmbedding", "GoalEmbedding", "Grasp2VecModel",
+           "keypoint_heatmap"]
+
+
+class SceneEmbedding(nn.Module):
+  """Conv tower -> (pooled embedding, spatial feature map)."""
+
+  embedding_size: int = 64
+  filters: Tuple[int, ...] = (32, 64, 64)
+
+  @nn.compact
+  def __call__(self, image: jnp.ndarray, train: bool = False):
+    x = image
+    for i, f in enumerate(self.filters):
+      x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
+      x = nn.LayerNorm(name=f"norm_{i}")(x)
+      x = nn.relu(x)
+    spatial = nn.Conv(self.embedding_size, (1, 1), name="proj")(x)
+    pooled = spatial.mean(axis=(1, 2))
+    return pooled, spatial
+
+
+class GoalEmbedding(nn.Module):
+  embedding_size: int = 64
+  filters: Tuple[int, ...] = (32, 64, 64)
+
+  @nn.compact
+  def __call__(self, image: jnp.ndarray, train: bool = False):
+    x = image
+    for i, f in enumerate(self.filters):
+      x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
+      x = nn.LayerNorm(name=f"norm_{i}")(x)
+      x = nn.relu(x)
+    x = x.mean(axis=(1, 2))
+    return nn.Dense(self.embedding_size, name="proj")(x)
+
+
+def keypoint_heatmap(spatial_features: jnp.ndarray,
+                     goal_embedding: jnp.ndarray) -> jnp.ndarray:
+  """Dot-product localization heatmap [B, H, W] (reference
+  visualization.py heatmaps)."""
+  return jnp.einsum("bhwc,bc->bhw", spatial_features, goal_embedding)
+
+
+class _Grasp2VecNetwork(nn.Module):
+  embedding_size: int = 64
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    def _norm(img):
+      if jnp.issubdtype(img.dtype, jnp.integer):
+        return img.astype(jnp.float32) / 255.0
+      return img
+
+    scene = SceneEmbedding(self.embedding_size, name="scene")
+    goal = GoalEmbedding(self.embedding_size, name="goal")
+    pregrasp, pregrasp_spatial = scene(_norm(features["pregrasp_image"]),
+                                       train=train)
+    postgrasp, _ = scene(_norm(features["postgrasp_image"]), train=train)
+    goal_emb = goal(_norm(features["goal_image"]), train=train)
+    outputs = specs_lib.SpecStruct()
+    outputs["pregrasp_embedding"] = pregrasp
+    outputs["postgrasp_embedding"] = postgrasp
+    outputs["goal_embedding"] = goal_emb
+    outputs["arithmetic_embedding"] = pregrasp - postgrasp
+    outputs["heatmap"] = keypoint_heatmap(pregrasp_spatial, goal_emb)
+    return outputs
+
+
+@config.configurable
+class Grasp2VecModel(abstract_model.T2RModel):
+  """phi(pre) - phi(post) ~= psi(goal) with an n-pairs objective."""
+
+  def __init__(self, image_size: int = 48, embedding_size: int = 64,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._embedding_size = embedding_size
+
+  def get_feature_specification(self, mode):
+    image = lambda name: TensorSpec(
+        shape=(self._image_size, self._image_size, 3), dtype=np.uint8,
+        name=name, data_format="jpeg")
+    return SpecStruct({
+        "pregrasp_image": image("pregrasp/image"),
+        "postgrasp_image": image("postgrasp/image"),
+        "goal_image": image("goal/image"),
+    })
+
+  def get_label_specification(self, mode):
+    # Self-supervised: no labels beyond the images themselves.
+    return SpecStruct()
+
+  def create_module(self):
+    return _Grasp2VecNetwork(embedding_size=self._embedding_size)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    arithmetic = inference_outputs["arithmetic_embedding"]
+    goal = inference_outputs["goal_embedding"]
+    npairs = tec_lib.npairs_loss(arithmetic, goal)
+    # Symmetric direction (reference uses both anchor orders).
+    npairs_reverse = tec_lib.npairs_loss(goal, arithmetic)
+    loss = 0.5 * (npairs + npairs_reverse)
+    return loss, {"npairs": npairs, "npairs_reverse": npairs_reverse}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    arithmetic = inference_outputs["arithmetic_embedding"]
+    goal = inference_outputs["goal_embedding"]
+    # Retrieval accuracy: does each arithmetic embedding rank its own
+    # goal first (reference keypoint/retrieval accuracy)?
+    sims = arithmetic @ goal.T
+    correct = jnp.argmax(sims, axis=-1) == jnp.arange(sims.shape[0])
+    return {"loss": loss, "retrieval_accuracy": correct.mean(), **scalars}

@@ -1,0 +1,304 @@
+"""VRGripper: episode-structured behavioral cloning (+ MDN heads, TEC
+embeddings, MAML and Watch-Try-Learn variants).
+
+Reference: /root/reference/research/vrgripper/ —
+`DefaultVRGripperPreprocessor` (vrgripper_env_models.py:41-136),
+`VRGripperRegressionModel` (spatial-softmax torso + MDN or MSE head over
+episode batches via multi_batch_apply, :140-323), the TEC + MAML meta
+models (vrgripper_env_meta_models.py:117-520), WTL trial/retrial models
+(vrgripper_env_wtl_models.py:135-560), discrete action binning
+(discrete.py:30-140) and episode->transition converters
+(episode_to_transitions.py:39-140).
+
+Episode batching: features are [B, T, ...]; per-frame networks vectorize
+over time with `multi_batch_apply` (a reshape — free under XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import mdn as mdn_lib
+from tensor2robot_tpu.layers import tec as tec_lib
+from tensor2robot_tpu.layers import vision
+from tensor2robot_tpu.meta_learning import batch_utils
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.preprocessors import base as preprocessors_lib
+from tensor2robot_tpu.preprocessors import image_ops
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["VRGripperPreprocessor", "VRGripperRegressionModel",
+           "VRGripperTECModel", "WTLTrialModel", "discretize_actions",
+           "undiscretize_actions", "episode_to_transitions"]
+
+
+@config.configurable
+class VRGripperPreprocessor(preprocessors_lib.SpecTransformationPreprocessor):
+  """Crop/resize/distort over episode image stacks (reference
+  DefaultVRGripperPreprocessor)."""
+
+  def __init__(self, input_size: Tuple[int, int] = (64, 64),
+               model_size: Tuple[int, int] = (48, 48), seed: int = 0,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._input_size = input_size
+    self._model_size = model_size
+    self._seed = seed
+    self._calls = 0
+
+  def update_in_spec(self, spec, key):
+    if key == "image":
+      return spec.replace(shape=spec.shape[:1] + self._input_size
+                          + (spec.shape[-1],), dtype=np.uint8)
+    return spec
+
+  def _preprocess_fn(self, features, labels, mode):
+    features = specs_lib.flatten_spec_structure(features)
+    self._calls += 1
+    key = jax.random.PRNGKey(self._seed + self._calls)
+    image = jnp.asarray(features["image"])  # [B, T, H, W, C]
+    b, t = image.shape[:2]
+    flat = image.reshape((b * t,) + image.shape[2:])
+    out = image_ops.crop_resize_distort(
+        key, flat, self._input_size, self._model_size,
+        is_training=mode == modes_lib.TRAIN)
+    features["image"] = np.asarray(
+        out.reshape((b, t) + out.shape[1:]), np.float32)
+    return features, labels
+
+
+class _EpisodeRegressionNet(nn.Module):
+  """Per-frame spatial-softmax torso -> action head (MDN or MSE)."""
+
+  action_size: int = 7
+  num_mixture_components: int = 0  # 0 -> plain MSE head
+  num_feature_points: int = 32
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    image = features["image"]  # [B, T, H, W, C]
+    if jnp.issubdtype(image.dtype, jnp.integer):
+      image = image.astype(jnp.float32) / 255.0
+
+    def per_frame(flat_image):
+      points = vision.BerkeleyNet(
+          filters=(self.num_feature_points,),
+          kernel_sizes=(5,), strides=(2,), name="torso")(
+              flat_image, train=train)
+      return points
+
+    points = batch_utils.multi_batch_apply(per_frame, 2, image)
+    x = points
+    if "gripper_pose" in features:
+      x = jnp.concatenate(
+          [x, features["gripper_pose"].astype(x.dtype)], axis=-1)
+    outputs = specs_lib.SpecStruct()
+    if self.num_mixture_components:
+      def mdn_head(flat_x):
+        return mdn_lib.MDNHead(self.num_mixture_components,
+                               self.action_size, name="mdn")(flat_x)
+
+      params = batch_utils.multi_batch_apply(mdn_head, 2, x)
+      outputs["mdn_params"] = params
+      outputs["action"] = mdn_lib.mdn_approximate_mode(params)
+    else:
+      def mse_head(flat_x):
+        h = nn.relu(nn.Dense(128, name="fc")(flat_x))
+        return nn.Dense(self.action_size, name="action")(h)
+
+      outputs["action"] = batch_utils.multi_batch_apply(mse_head, 2, x)
+    outputs["inference_output"] = outputs["action"]
+    return outputs
+
+
+@config.configurable
+class VRGripperRegressionModel(abstract_model.T2RModel):
+  """Episode BC: [B, T] frames -> [B, T] actions, MSE or MDN likelihood."""
+
+  def __init__(self, episode_length: int = 8, image_size: int = 48,
+               action_size: int = 7, num_mixture_components: int = 0,
+               **kwargs):
+    kwargs.setdefault("preprocessor_cls", None)
+    super().__init__(**kwargs)
+    self._episode_length = episode_length
+    self._image_size = image_size
+    self._action_size = action_size
+    self._num_mixture_components = num_mixture_components
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "image": TensorSpec(
+            shape=(self._episode_length, self._image_size,
+                   self._image_size, 3),
+            dtype=np.float32, name="image", data_format="jpeg",
+            is_sequence=False),
+        "gripper_pose": TensorSpec(
+            shape=(self._episode_length, 7), dtype=np.float32,
+            name="gripper_pose", is_optional=True),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(shape=(self._episode_length,
+                                    self._action_size),
+                             dtype=np.float32, name="action"),
+    })
+
+  def create_module(self):
+    return _EpisodeRegressionNet(
+        action_size=self._action_size,
+        num_mixture_components=self._num_mixture_components)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    target = labels["action"]
+    if self._num_mixture_components:
+      params = inference_outputs["mdn_params"]
+      loss = -mdn_lib.mdn_log_prob(params, target).mean()
+      return loss, {"nll": loss}
+    loss = jnp.mean((inference_outputs["action"] - target) ** 2)
+    return loss, {"mse": loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    mae = jnp.abs(inference_outputs["action"] - labels["action"]).mean()
+    return {"loss": loss, "mae": mae, **scalars}
+
+
+class _TECNetwork(nn.Module):
+  """Demo episode -> task embedding; frame + embedding -> action."""
+
+  action_size: int = 7
+  embedding_size: int = 32
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    demo = features["demo_frames"]  # [B, T, D] pre-featurized frames
+    embedding = tec_lib.EmbedEpisode(
+        embedding_size=self.embedding_size, name="embed")(demo, train=train)
+    obs = features["observation"]  # [B, D]
+    x = jnp.concatenate([obs, embedding], axis=-1)
+    x = nn.relu(nn.Dense(128, name="fc1")(x))
+    action = nn.Dense(self.action_size, name="action")(x)
+    return specs_lib.SpecStruct({
+        "action": action,
+        "inference_output": action,
+        "task_embedding": embedding,
+    })
+
+
+@config.configurable
+class VRGripperTECModel(abstract_model.T2RModel):
+  """Task-embedded control: demo-conditioned BC with an embedding
+  contrastive auxiliary (reference vrgripper_env_meta_models TEC model)."""
+
+  def __init__(self, demo_length: int = 8, obs_size: int = 16,
+               action_size: int = 7, embedding_size: int = 32,
+               embedding_loss_weight: float = 0.1, **kwargs):
+    super().__init__(**kwargs)
+    self._demo_length = demo_length
+    self._obs_size = obs_size
+    self._action_size = action_size
+    self._embedding_size = embedding_size
+    self._embedding_loss_weight = embedding_loss_weight
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "demo_frames": TensorSpec(shape=(self._demo_length,
+                                         self._obs_size),
+                                  dtype=np.float32, name="demo_frames"),
+        "observation": TensorSpec(shape=(self._obs_size,),
+                                  dtype=np.float32, name="observation"),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(shape=(self._action_size,), dtype=np.float32,
+                             name="action"),
+        "task_id": TensorSpec(shape=(), dtype=np.int64, name="task_id",
+                              is_optional=True),
+    })
+
+  def create_module(self):
+    return _TECNetwork(action_size=self._action_size,
+                       embedding_size=self._embedding_size)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    bc = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
+    scalars = {"bc_mse": bc}
+    loss = bc
+    if "task_id" in labels and labels["task_id"] is not None:
+      emb_loss = tec_lib.triplet_semihard_loss(
+          inference_outputs["task_embedding"],
+          labels["task_id"].astype(jnp.int32))
+      scalars["embedding_triplet"] = emb_loss
+      loss = loss + self._embedding_loss_weight * emb_loss
+    return loss, scalars
+
+
+@config.configurable
+class WTLTrialModel(VRGripperRegressionModel):
+  """Watch-Try-Learn trial policy: conditions on the demo AND the prior
+  trial's (state, action, reward) stream (reference
+  vrgripper_env_wtl_models.py:135-560)."""
+
+  def __init__(self, trial_length: int = 8, **kwargs):
+    super().__init__(**kwargs)
+    self._trial_length = trial_length
+
+  def get_feature_specification(self, mode):
+    out = super().get_feature_specification(mode)
+    out["trial_frames"] = TensorSpec(
+        shape=(self._trial_length, self._image_size, self._image_size, 3),
+        dtype=np.float32, name="trial_frames", is_optional=True)
+    out["trial_rewards"] = TensorSpec(
+        shape=(self._trial_length, 1), dtype=np.float32,
+        name="trial_rewards", is_optional=True)
+    return out
+
+
+# -- discrete action binning (reference discrete.py:30-140) -----------------
+
+
+def discretize_actions(actions: jnp.ndarray, num_bins: int,
+                       low: float = -1.0, high: float = 1.0) -> jnp.ndarray:
+  """Continuous [-1, 1] actions -> integer bin ids."""
+  clipped = jnp.clip(actions, low, high)
+  scaled = (clipped - low) / (high - low)
+  return jnp.minimum((scaled * num_bins).astype(jnp.int32), num_bins - 1)
+
+
+def undiscretize_actions(bins: jnp.ndarray, num_bins: int,
+                         low: float = -1.0, high: float = 1.0
+                         ) -> jnp.ndarray:
+  """Bin ids -> bin-center continuous values."""
+  return low + (bins.astype(jnp.float32) + 0.5) / num_bins * (high - low)
+
+
+def episode_to_transitions(episode, episode_length: int):
+  """Fixed-length [T, ...] training example from one episode (reference
+  episode_to_transitions.py): pad-or-clip frames/actions to
+  episode_length."""
+  frames = np.stack([step["obs"]["image"] for step in episode])
+  actions = np.stack([np.asarray(step["action"], np.float32)
+                      for step in episode])
+  t = frames.shape[0]
+  if t >= episode_length:
+    frames, actions = frames[:episode_length], actions[:episode_length]
+  else:
+    pad = episode_length - t
+    frames = np.concatenate(
+        [frames, np.repeat(frames[-1:], pad, axis=0)])
+    actions = np.concatenate(
+        [actions, np.repeat(actions[-1:], pad, axis=0)])
+  return {"image": frames, "action": actions}

@@ -1,0 +1,175 @@
+"""Tests for BC-Z, Grasp2Vec and VRGripper research families."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.research.bcz import models as bcz_models
+from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+from tensor2robot_tpu.research.vrgripper import models as vr_models
+from tensor2robot_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+def _random_batch(model, batch_size=4, seed=0):
+  features = specs_lib.make_random_numpy(
+      model.get_feature_specification(modes.TRAIN), batch_size=batch_size,
+      seed=seed)
+  labels = specs_lib.make_random_numpy(
+      model.get_label_specification(modes.TRAIN), batch_size=batch_size,
+      seed=seed + 1)
+  return features, labels
+
+
+def _one_step(model, batch_size=4):
+  features, labels = _random_batch(model, batch_size)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  step = ts.make_train_step(model)
+  state, metrics = step(state, features, labels)
+  return state, metrics
+
+
+class TestBCZ:
+
+  def _model(self, **kwargs):
+    kwargs.setdefault("image_size", 32)
+    kwargs.setdefault("resnet_size", 18)
+    kwargs.setdefault("num_waypoints", 4)
+    return bcz_models.BCZModel(device_type="cpu", **kwargs)
+
+  def test_trains_and_reports_component_losses(self):
+    state, metrics = self._one_or_cached()
+    for name in ("xyz", "axis_angle", "gripper", "stop"):
+      assert f"loss/{name}" in metrics
+    assert np.isfinite(float(metrics["loss"]))
+
+  def _one_or_cached(self):
+    return _one_step(self._model(), batch_size=2)
+
+  def test_language_conditioning(self):
+    model = self._model(condition_size=8, network="spatial_softmax")
+    features, labels = _random_batch(model, 2)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    predict = ts.make_predict_fn(model)
+    out1 = predict(state, features)
+    features2 = specs_lib.SpecStruct(features)
+    features2["condition_embedding"] = (
+        np.asarray(features["condition_embedding"]) + 1.0)
+    out2 = predict(state, features2)
+    assert not np.allclose(np.asarray(out1["xyz"]),
+                           np.asarray(out2["xyz"]))
+
+  def test_stop_mask_zeroes_action_loss(self):
+    model = self._model(network="spatial_softmax")
+    features, labels = _random_batch(model, 2)
+    labels = specs_lib.flatten_spec_structure(labels)
+    labels["stop"] = np.ones_like(np.asarray(labels["stop"]))  # stopped
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model)
+    _, metrics = step(state, features, labels)
+    assert float(metrics["loss/xyz"]) == pytest.approx(0.0, abs=1e-8)
+
+  def test_preprocessor_crop_and_binarize(self):
+    model = self._model(network="spatial_softmax")
+    pre = bcz_models.BCZPreprocessor(
+        input_size=(40, 40), crop_size=(36, 36), model_size=(32, 32),
+        model_feature_specification_fn=model.get_feature_specification,
+        model_label_specification_fn=model.get_label_specification)
+    in_spec = pre.get_in_feature_specification(modes.TRAIN)
+    assert in_spec["image"].shape == (40, 40, 3)
+    assert in_spec["image"].dtype == np.uint8
+    features = specs_lib.make_random_numpy(in_spec, batch_size=2, seed=0)
+    labels = specs_lib.make_random_numpy(
+        pre.get_in_label_specification(modes.TRAIN), batch_size=2, seed=1)
+    out_f, out_l = pre.preprocess(features, labels, modes.TRAIN)
+    assert out_f["image"].shape == (2, 32, 32, 3)
+    assert set(np.unique(out_l["gripper"])) <= {0.0, 1.0}
+
+
+class TestGrasp2Vec:
+
+  def test_trains_and_arithmetic_consistency(self):
+    model = g2v_models.Grasp2VecModel(image_size=32, device_type="cpu")
+    features, _ = _random_batch(model, batch_size=4)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model)
+    state, metrics = step(state, features, specs_lib.SpecStruct())
+    assert np.isfinite(float(metrics["loss"]))
+    assert "npairs" in metrics
+
+  def test_outputs_and_heatmap_shapes(self):
+    model = g2v_models.Grasp2VecModel(image_size=32, device_type="cpu")
+    features, _ = _random_batch(model, batch_size=2)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    predict = ts.make_predict_fn(model)
+    out = predict(state, features)
+    assert out["goal_embedding"].shape == (2, 64)
+    assert out["arithmetic_embedding"].shape == (2, 64)
+    assert out["heatmap"].ndim == 3
+
+  def test_eval_retrieval_metric(self):
+    model = g2v_models.Grasp2VecModel(image_size=32, device_type="cpu")
+    features, _ = _random_batch(model, batch_size=4)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    eval_step = ts.make_eval_step(model)
+    metrics = eval_step(state, features, specs_lib.SpecStruct())
+    assert 0.0 <= float(metrics["retrieval_accuracy"]) <= 1.0
+
+
+class TestVRGripper:
+
+  def test_mse_episode_model_trains(self):
+    model = vr_models.VRGripperRegressionModel(
+        episode_length=3, image_size=32, device_type="cpu")
+    state, metrics = _one_step(model, batch_size=2)
+    assert "mse" in metrics
+
+  def test_mdn_episode_model_trains(self):
+    model = vr_models.VRGripperRegressionModel(
+        episode_length=3, image_size=32, num_mixture_components=3,
+        device_type="cpu")
+    state, metrics = _one_step(model, batch_size=2)
+    assert "nll" in metrics
+    assert np.isfinite(float(metrics["loss"]))
+
+  def test_tec_model_with_embedding_loss(self):
+    model = vr_models.VRGripperTECModel(device_type="cpu")
+    features, labels = _random_batch(model, batch_size=4)
+    labels = specs_lib.flatten_spec_structure(labels)
+    labels["task_id"] = np.array([0, 0, 1, 1], np.int64)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model)
+    _, metrics = step(state, features, labels)
+    assert "embedding_triplet" in metrics
+
+  def test_discretize_roundtrip(self):
+    actions = jnp.array([[-1.0, 0.0, 0.999]])
+    bins = vr_models.discretize_actions(actions, num_bins=10)
+    recovered = vr_models.undiscretize_actions(bins, num_bins=10)
+    # bin-center reconstruction error is at most half a bin (0.1)
+    np.testing.assert_allclose(np.asarray(recovered), np.asarray(actions),
+                               atol=0.1001)
+
+  def test_episode_to_transitions_pads_and_clips(self):
+    episode = [{"obs": {"image": np.zeros((4, 4, 3), np.uint8)},
+                "action": np.zeros(2)} for _ in range(3)]
+    out = vr_models.episode_to_transitions(episode, episode_length=5)
+    assert out["image"].shape == (5, 4, 4, 3)
+    out2 = vr_models.episode_to_transitions(episode, episode_length=2)
+    assert out2["action"].shape == (2, 2)
+
+  def test_wtl_trial_model_spec(self):
+    model = vr_models.WTLTrialModel(episode_length=3, image_size=32,
+                                    trial_length=3, device_type="cpu")
+    spec = model.get_feature_specification(modes.TRAIN)
+    assert "trial_frames" in spec
+    assert spec["trial_rewards"].is_optional
