@@ -1,0 +1,195 @@
+"""Attention ops: fused flash attention + ring attention for sequence
+parallelism.
+
+The reference has no attention-scale sequence machinery at all
+(SURVEY.md §5 "long-context: none") — its longest-sequence handling is
+SequenceExample padding and GRU/SNAIL layers. This module adds the
+long-context capability TPU-first:
+
+* `attention` — reference jnp implementation (any backend);
+* `flash_attention` — Pallas TPU kernel: block-streamed online softmax
+  so the [T, T] score matrix never materializes in HBM (O(T) memory);
+* `ring_attention` — context parallelism over a mesh axis: each device
+  holds a sequence shard, K/V blocks rotate around the ICI ring via
+  `ppermute` inside `shard_map` while the online-softmax accumulator
+  absorbs one block per hop. Exact (not approximate) attention over
+  sequences `axis_size`x longer than one chip's memory; compute and
+  ring transfers overlap under XLA's async collectives.
+
+All functions take [batch, heads, seq, head_dim] ("BHTD") arrays.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+__all__ = ["attention", "flash_attention", "ring_attention"]
+
+
+def _mask_value(dtype) -> jnp.ndarray:
+  return jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+
+
+def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+              causal: bool = False) -> jnp.ndarray:
+  """Reference softmax attention, [B, H, T, D]."""
+  scale = 1.0 / math.sqrt(q.shape[-1])
+  scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+  if causal:
+    tq, tk = scores.shape[-2], scores.shape[-1]
+    mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+    scores = jnp.where(mask, scores, _mask_value(scores.dtype))
+  weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+  return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(q.dtype), v)
+
+
+# -- online-softmax block update (shared by flash + ring) -------------------
+
+
+def _online_block_update(q, k_blk, v_blk, m_prev, l_prev, o_prev,
+                         score_mask=None):
+  """Absorbs one K/V block into the running (max, denom, output).
+
+  q: [..., Tq, D]; k_blk/v_blk: [..., Tk, D];
+  m_prev/l_prev: [..., Tq]; o_prev: [..., Tq, D] (unnormalized
+  numerator). Returns updated (m, l, o).
+  """
+  scale = 1.0 / math.sqrt(q.shape[-1])
+  s = jnp.einsum("...qd,...kd->...qk", q, k_blk).astype(jnp.float32) * scale
+  if score_mask is not None:
+    s = jnp.where(score_mask, s, _mask_value(s.dtype))
+  m_new = jnp.maximum(m_prev, s.max(axis=-1))
+  alpha = jnp.exp(m_prev - m_new)
+  p = jnp.exp(s - m_new[..., None])
+  l_new = l_prev * alpha + p.sum(axis=-1)
+  o_new = (o_prev * alpha[..., None]
+           + jnp.einsum("...qk,...kd->...qd", p.astype(v_blk.dtype),
+                        v_blk).astype(jnp.float32))
+  return m_new, l_new, o_new
+
+
+def _finalize(o, l):
+  return o / jnp.maximum(l[..., None], 1e-30)
+
+
+# -- Pallas flash attention --------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                  causal: bool, q_block: int):
+  """One (batch*head, q_block) program: stream K/V blocks through VMEM."""
+  q = q_ref[:]  # [block_q, D]
+  tq_idx = pl.program_id(1)
+  seq_len = k_ref.shape[0]
+  num_k_blocks = seq_len // block_k
+
+  def body(kb, carry):
+    m, l, o = carry
+    k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+    v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+    mask = None
+    if causal:
+      q_pos = tq_idx * q_block + jax.lax.broadcasted_iota(
+          jnp.int32, (q_block, block_k), 0)
+      k_pos = kb * block_k + jax.lax.broadcasted_iota(
+          jnp.int32, (q_block, block_k), 1)
+      mask = q_pos >= k_pos
+    return _online_block_update(q, k_blk, v_blk, m, l, o, mask)
+
+  m0 = jnp.full((q_block,), -jnp.inf, jnp.float32)
+  l0 = jnp.zeros((q_block,), jnp.float32)
+  o0 = jnp.zeros((q_block, q.shape[-1]), jnp.float32)
+  m, l, o = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, o0))
+  o_ref[:] = _finalize(o, l).astype(o_ref.dtype)
+
+
+try:  # Pallas import kept soft so CPU-only deployments still import us.
+  from jax.experimental import pallas as pl
+  from jax.experimental.pallas import tpu as pltpu
+
+  _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+  _HAS_PALLAS = False
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    causal: bool = False,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False) -> jnp.ndarray:
+  """Pallas flash attention, [B, H, T, D]; falls back to `attention`
+  when the sequence doesn't tile or Pallas is unavailable."""
+  b, h, t, d = q.shape
+  if (not _HAS_PALLAS) or t % block_q or t % block_k:
+    return attention(q, k, v, causal=causal)
+  q3 = q.reshape(b * h, t, d)
+  k3 = k.reshape(b * h, t, d)
+  v3 = v.reshape(b * h, t, d)
+  kernel = functools.partial(_flash_kernel, block_k=block_k,
+                             causal=causal, q_block=block_q)
+  out = pl.pallas_call(
+      kernel,
+      grid=(b * h, t // block_q),
+      in_specs=[
+          pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+          pl.BlockSpec((None, t, d), lambda bh, qb: (bh, 0, 0)),
+          pl.BlockSpec((None, t, d), lambda bh, qb: (bh, 0, 0)),
+      ],
+      out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
+      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+      interpret=interpret,
+  )(q3, k3, v3)
+  return out.reshape(b, h, t, d)
+
+
+# -- ring attention (context parallelism) ------------------------------------
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh,
+                   axis_name: str = "sp",
+                   causal: bool = False,
+                   batch_axis: Optional[str] = "data") -> jnp.ndarray:
+  """Exact attention with the sequence dim sharded over `axis_name`.
+
+  Inputs are global [B, H, T, D] arrays (T divisible by the axis size).
+  Each device keeps its Q shard resident and absorbs one rotating K/V
+  block per ring hop; `ppermute` rides the ICI ring. Returns the global
+  [B, H, T, D] output with the same sharding.
+  """
+  axis_size = mesh.shape[axis_name]
+  io_spec = PartitionSpec(batch_axis, None, axis_name, None)
+
+  def local_fn(q_local, k_local, v_local):
+    idx = jax.lax.axis_index(axis_name)
+    tq = q_local.shape[2]
+    m = jnp.full(q_local.shape[:-1], -jnp.inf, jnp.float32)
+    l = jnp.zeros(q_local.shape[:-1], jnp.float32)
+    o = jnp.zeros(q_local.shape, jnp.float32)
+    k_blk, v_blk = k_local, v_local
+    for step in range(axis_size):
+      src = (idx - step) % axis_size  # whose shard we currently hold
+      mask = None
+      if causal:
+        q_pos = idx * tq + jnp.arange(tq)
+        k_pos = src * tq + jnp.arange(tq)
+        mask = q_pos[:, None] >= k_pos[None, :]
+        mask = mask[None, None]  # broadcast over [B, H]
+      m, l, o = _online_block_update(q_local, k_blk, v_blk, m, l, o, mask)
+      if step + 1 < axis_size:
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+    return _finalize(o, l).astype(q_local.dtype)
+
+  sharded = jax.shard_map(
+      local_fn, mesh=mesh,
+      in_specs=(io_spec, io_spec, io_spec),
+      out_specs=io_spec,
+      check_vma=False)
+  return sharded(q, k, v)
