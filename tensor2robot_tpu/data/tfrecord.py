@@ -57,6 +57,11 @@ def _crc32c(data: bytes) -> int:
 
 
 def _masked_crc(data: bytes) -> int:
+  from tensor2robot_tpu import native
+
+  value = native.masked_crc32c(data)
+  if value is not None:
+    return value
   crc = _crc32c(data)
   return ((((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF)
 
@@ -89,7 +94,13 @@ class RecordWriter:
 
 
 def iter_records(path: str, verify_crc: bool = False) -> Iterator[bytes]:
-  """Streams records from one TFRecord file."""
+  """Streams records from one TFRecord file (native C++ reader when
+  available, pure-Python fallback otherwise)."""
+  from tensor2robot_tpu import native
+
+  if native.available():
+    yield from native.iter_records_native(path, verify_crc=verify_crc)
+    return
   with open(path, "rb") as f:
     while True:
       header = f.read(12)

@@ -1,0 +1,109 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+`tfrecord_io.cc` provides the fast host-side TFRecord reader and CRC32C
+used by the data layer. The shared library is built on first import with
+g++ (cached next to the source); every caller has a pure-Python fallback,
+so environments without a toolchain still work.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCE = os.path.join(_DIR, "tfrecord_io.cc")
+_LIB_PATH = os.path.join(_DIR, "libt2r_tfrecord_io.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LOAD_FAILED = False
+
+
+def _build() -> bool:
+  try:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SOURCE,
+         "-o", _LIB_PATH],
+        check=True, capture_output=True, timeout=120)
+    return True
+  except Exception:
+    return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+  """Returns the native library, building it if needed; None if
+  unavailable."""
+  global _LIB, _LOAD_FAILED
+  with _LOCK:
+    if _LIB is not None or _LOAD_FAILED:
+      return _LIB
+    if not os.path.isfile(_LIB_PATH) or (
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SOURCE)):
+      if not _build():
+        _LOAD_FAILED = True
+        return None
+    try:
+      lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+      _LOAD_FAILED = True
+      return None
+    lib.t2r_crc32c.restype = ctypes.c_uint32
+    lib.t2r_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.t2r_masked_crc32c.restype = ctypes.c_uint32
+    lib.t2r_masked_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.t2r_reader_open.restype = ctypes.c_void_p
+    lib.t2r_reader_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.t2r_reader_close.argtypes = [ctypes.c_void_p]
+    lib.t2r_reader_next_batch.restype = ctypes.c_int64
+    lib.t2r_reader_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.t2r_reader_data.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.t2r_reader_data.argtypes = [ctypes.c_void_p]
+    lib.t2r_reader_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_reader_offsets.argtypes = [ctypes.c_void_p]
+    lib.t2r_reader_lengths.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_reader_lengths.argtypes = [ctypes.c_void_p]
+    lib.t2r_reader_error.restype = ctypes.c_char_p
+    lib.t2r_reader_error.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+  return load() is not None
+
+
+def masked_crc32c(data: bytes) -> Optional[int]:
+  lib = load()
+  if lib is None:
+    return None
+  return lib.t2r_masked_crc32c(data, len(data))
+
+
+def iter_records_native(path: str, verify_crc: bool = False,
+                        batch_records: int = 256) -> Iterator[bytes]:
+  """Streams records via the native reader; raises IOError on corruption."""
+  lib = load()
+  if lib is None:
+    raise RuntimeError("native library unavailable")
+  handle = lib.t2r_reader_open(path.encode(), int(verify_crc))
+  if not handle:
+    raise IOError(f"Cannot open {path}")
+  try:
+    while True:
+      n = lib.t2r_reader_next_batch(handle, batch_records)
+      if n < 0:
+        error = lib.t2r_reader_error(handle).decode()
+        raise IOError(f"Corrupt TFRecord file {path}: {error}")
+      if n == 0:
+        return
+      data = lib.t2r_reader_data(handle)
+      offsets = lib.t2r_reader_offsets(handle)
+      lengths = lib.t2r_reader_lengths(handle)
+      for i in range(n):
+        yield ctypes.string_at(
+            ctypes.addressof(data.contents) + offsets[i], lengths[i])
+  finally:
+    lib.t2r_reader_close(handle)
