@@ -1,0 +1,33 @@
+"""Actor-side CLI: continuous collect/eval against a training job.
+
+Reference twin: /root/reference/bin/run_collect_eval.py:40-43 — parses
+config and runs the collect/eval loop; everything else is injected.
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_collect_eval \
+      --config_files path/to/collect.gin \
+      --config "collect_eval_loop.root_dir = '/tmp/actor1'"
+"""
+
+from __future__ import annotations
+
+from absl import app, flags
+
+from tensor2robot_tpu.envs import run_env
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse.")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  run_env.collect_eval_loop()
+
+
+if __name__ == "__main__":
+  app.run(main)

@@ -53,9 +53,14 @@ def create_mesh(mesh_shape: Optional[Sequence[int]] = None,
   if mesh_shape is None:
     mesh_shape = [n] + [1] * (len(axis_names) - 1)
   mesh_shape = list(mesh_shape)
-  if math.prod(mesh_shape) != n:
+  needed = math.prod(mesh_shape)
+  if needed > n:
     raise ValueError(
         f"mesh_shape {mesh_shape} does not cover {n} devices.")
+  if needed < n:
+    # Explicit smaller meshes use a device prefix (debug / smoke runs).
+    devices = devices[:needed]
+    n = needed
   if len(mesh_shape) != len(axis_names):
     raise ValueError(
         f"mesh_shape rank {len(mesh_shape)} != axis_names "

@@ -1,0 +1,112 @@
+"""Model test fixture: train/predict smoke runs + golden-value checks.
+
+Reference: /root/reference/utils/t2r_test_fixture.py — `random_train`
+(random-input generator + a few steps + output-file assertions, :57-85),
+`random_predict` and `train_and_check_golden_predictions` (golden .npy
+regression with checkpoint pinning, :143-196); and
+train_eval_test_utils.py `assert_output_files` (:26-63).
+
+Goldens are regenerated (not copied) with explicit tolerances — TF1
+initializer/distortion RNG cannot match JAX (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.checkpoints import latest_step
+from tensor2robot_tpu.data import input_generators
+from tensor2robot_tpu.hooks import core as hooks_lib
+
+__all__ = ["assert_output_files", "T2RModelFixture"]
+
+
+def assert_output_files(model_dir: str,
+                        expect_operative_config: bool = True) -> None:
+  """Checkpoint + config + metrics artifacts exist (reference
+  assert_output_files)."""
+  ckpt_dir = os.path.join(model_dir, "checkpoints")
+  assert os.path.isdir(ckpt_dir), f"no checkpoint dir in {model_dir}"
+  assert latest_step(ckpt_dir) is not None, "no checkpoint written"
+  if expect_operative_config:
+    assert os.path.isfile(
+        os.path.join(model_dir, "operative_config-0.gin")), \
+        "operative config not saved"
+  assert glob.glob(os.path.join(model_dir, "*", "metrics.jsonl")), \
+      "no metrics written"
+
+
+class T2RModelFixture:
+  """Drives a model through short train/predict runs."""
+
+  def __init__(self, model_dir: str, batch_size: int = 4, seed: int = 0):
+    self._model_dir = model_dir
+    self._batch_size = batch_size
+    self._seed = seed
+
+  def random_train(self, model, max_train_steps: int = 3,
+                   **train_kwargs) -> Dict[str, float]:
+    """Trains on random spec-shaped data, asserts output files."""
+    metrics = train_eval.train_eval_model(
+        model=model,
+        model_dir=self._model_dir,
+        mode="train",
+        max_train_steps=max_train_steps,
+        checkpoint_every_n_steps=max_train_steps,
+        input_generator_train=input_generators.DefaultRandomInputGenerator(
+            batch_size=self._batch_size, seed=self._seed),
+        hook_builders=[hooks_lib.DefaultHookBuilder()],
+        log_every_n_steps=max(1, max_train_steps),
+        **train_kwargs)
+    assert_output_files(self._model_dir)
+    return metrics
+
+  def random_predict(self, model, num_batches: int = 1):
+    outputs = train_eval.predict_from_model(
+        model=model,
+        model_dir=self._model_dir,
+        input_generator=input_generators.DefaultRandomInputGenerator(
+            batch_size=self._batch_size, seed=self._seed),
+        num_batches=num_batches)
+    assert outputs, "predict produced no outputs"
+    return outputs
+
+  def train_and_check_golden_predictions(
+      self, model, golden_path: str,
+      max_train_steps: int = 3,
+      atol: float = 1e-5,
+      update: Optional[bool] = None) -> None:
+    """Trains deterministically, then compares fixed-batch predictions to
+    a golden file; writes the golden when absent (or update=True)."""
+    from tensor2robot_tpu.parallel import train_step as ts
+    import jax
+
+    self.random_train(model, max_train_steps=max_train_steps)
+    feature_spec = model.preprocessor.get_out_feature_specification(
+        modes_lib.PREDICT)
+    batch = specs_lib.make_random_numpy(
+        feature_spec, batch_size=self._batch_size, seed=123)
+    outputs = train_eval.predict_from_model(
+        model=model, model_dir=self._model_dir,
+        input_generator=input_generators.DefaultRandomInputGenerator(
+            batch_size=self._batch_size, seed=123),
+        num_batches=1)[0]
+    flat = {k: np.asarray(v) for k, v in outputs.items()}
+    if update or not os.path.isfile(golden_path):
+      os.makedirs(os.path.dirname(golden_path) or ".", exist_ok=True)
+      np.save(golden_path, flat, allow_pickle=True)
+      return
+    golden = np.load(golden_path, allow_pickle=True).item()
+    assert set(golden) == set(flat), (
+        f"golden keys {sorted(golden)} != {sorted(flat)}")
+    for key in golden:
+      np.testing.assert_allclose(
+          flat[key], golden[key], atol=atol,
+          err_msg=f"golden mismatch for {key!r}")

@@ -1,0 +1,74 @@
+"""Smoke-trains every shipped research config for a couple of steps —
+the reference's `test_train_eval_gin` strategy
+(/root/reference/utils/train_eval_test_utils.py:68-147)."""
+
+import glob
+import os
+
+import pytest
+
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils.test_fixture import assert_output_files
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONFIG_GLOB = os.path.join(REPO_ROOT, "tensor2robot_tpu", "research", "*",
+                           "configs", "*.gin")
+ALL_CONFIGS = sorted(glob.glob(CONFIG_GLOB))
+
+# Per-config shrink overrides so CI stays fast on CPU.
+_SHRINK = [
+    "train_eval_model.max_train_steps = 2",
+    "train_eval_model.eval_steps = 1",
+    "train_eval_model.eval_every_n_steps = 2",
+    "train_eval_model.checkpoint_every_n_steps = 2",
+    "train_eval_model.log_every_n_steps = 1",
+    "DefaultRandomInputGenerator.batch_size = 2",
+    "train_eval_model.mesh_shape = (1, 1, 1)",
+]
+_EXTRA = {
+    "train_qtopt.gin": ["QTOptModel.image_size = 32",
+                        "QTOptModel.device_type = 'cpu'",
+                        "QTOptModel.use_bfloat16 = False"],
+    "train_bcz.gin": ["BCZModel.image_size = 32",
+                      "BCZModel.network = 'spatial_softmax'",
+                      "BCZModel.num_waypoints = 3",
+                      "BCZModel.device_type = 'cpu'",
+                      "BCZModel.use_bfloat16 = False",
+                      "BCZPreprocessor.input_size = (40, 40)",
+                      "BCZPreprocessor.crop_size = (36, 36)",
+                      "BCZPreprocessor.model_size = (32, 32)"],
+    "train_grasp2vec.gin": ["Grasp2VecModel.image_size = 32",
+                            "Grasp2VecModel.device_type = 'cpu'"],
+    "train_vrgripper_mdn.gin": ["VRGripperRegressionModel.episode_length = 2",
+                                "VRGripperRegressionModel.image_size = 32",
+                                "VRGripperRegressionModel.device_type = 'cpu'"],
+}
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+def test_all_config_families_present():
+  names = {os.path.basename(p) for p in ALL_CONFIGS}
+  assert {"train_pose_regression.gin", "train_qtopt.gin", "train_bcz.gin",
+          "train_grasp2vec.gin", "train_vrgripper_mdn.gin",
+          "train_wtl_maml.gin"} <= names
+
+
+@pytest.mark.parametrize(
+    "config_path", ALL_CONFIGS,
+    ids=[os.path.basename(p) for p in ALL_CONFIGS])
+def test_config_smoke_trains(config_path, tmp_path):
+  model_dir = str(tmp_path / "run")
+  bindings = list(_SHRINK)
+  bindings.extend(_EXTRA.get(os.path.basename(config_path), []))
+  bindings.append(f"train_eval_model.model_dir = {model_dir!r}")
+  config.parse_config_files_and_bindings([config_path], bindings)
+  metrics = train_eval.train_eval_model()
+  assert metrics, f"no metrics from {config_path}"
+  assert_output_files(model_dir, expect_operative_config=False)

@@ -42,9 +42,13 @@ class TestMeshConstruction:
     m = mesh_lib.create_mesh(mesh_shape=(2, 2, 2))
     assert m.shape == {"data": 2, "fsdp": 2, "model": 2}
 
-  def test_bad_shape_raises(self):
+  def test_too_large_shape_raises(self):
     with pytest.raises(ValueError, match="cover"):
-      mesh_lib.create_mesh(mesh_shape=(3, 1, 1))
+      mesh_lib.create_mesh(mesh_shape=(16, 1, 1))
+
+  def test_smaller_shape_uses_device_prefix(self):
+    m = mesh_lib.create_mesh(mesh_shape=(2, 1, 1))
+    assert m.devices.size == 2
 
   def test_local_batch_size(self, dp_mesh):
     assert mesh_lib.local_batch_size(32, dp_mesh) == 32  # single process
