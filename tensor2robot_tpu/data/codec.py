@@ -129,3 +129,27 @@ def encode_sequence_example(
     for step_value in value:
       set_feature(feature_list.feature.add(), step_value, spec)
   return example.SerializeToString()
+
+
+def maybe_recompress_jpeg(data: bytes, quality: int = 95,
+                          max_side: Optional[int] = None) -> bytes:
+  """Re-encodes image bytes as JPEG, optionally capping resolution
+  (reference jpeg re-compress/decompress helpers,
+  /root/reference/utils/tfdata.py:546-626) — shrinks replay/log storage."""
+  from PIL import Image
+  import io as io_lib
+
+  img = Image.open(io_lib.BytesIO(data))
+  if img.mode != "RGB":
+    img = img.convert("RGB")
+  if max_side is not None and max(img.size) > max_side:
+    scale = max_side / max(img.size)
+    img = img.resize((int(img.width * scale), int(img.height * scale)))
+  buf = io_lib.BytesIO()
+  img.save(buf, format="JPEG", quality=quality)
+  return buf.getvalue()
+
+
+def decode_image_batch(datas, channels: Optional[int] = None) -> np.ndarray:
+  """Decodes a list of image byte strings to one [N, H, W, C] array."""
+  return np.stack([decode_image(d, channels=channels) for d in datas])

@@ -170,7 +170,11 @@ class DefaultExportGenerator(AbstractExportGenerator):
         features[key] = array
       return dict(predict(host_state, features).items())
 
-    tf_fn = jax2tf.convert(jax_fn, with_gradient=False)
+    # Dynamic batch dim via shape polymorphism: serving batches (e.g. CEM
+    # candidate sets) vary in size.
+    tf_fn = jax2tf.convert(
+        jax_fn, with_gradient=False,
+        polymorphic_shapes=["b, ..." for _ in keys])
     signature_inputs = [
         tf.TensorSpec([None] + [d or 1 for d in flat_spec[k].shape],
                       tf.dtypes.as_dtype(np.dtype(flat_spec[k].dtype).name),

@@ -1,0 +1,69 @@
+"""TD3 hooks: current + lagged exports and serving warmup requests.
+
+Reference: /root/reference/hooks/td3.py:37-132 — TD3 target networks read
+a one-version-lagged export directory; exports also carry a warmup
+request so serving frontends prime their caches before taking traffic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["write_warmup_request", "TD3HookBuilder"]
+
+WARMUP_FILENAME = "warmup_request.json"
+
+
+def write_warmup_request(export_path: str,
+                         feature_spec: specs_lib.SpecStructLike,
+                         batch_size: int = 1) -> str:
+  """Writes a sample dense-feed request (spec-shaped random data) next to
+  an export bundle (reference warmup-request writer,
+  abstract_export_generator.py:109-142)."""
+  sample = specs_lib.make_random_numpy(feature_spec, batch_size=batch_size,
+                                       seed=0)
+  payload = {key: np.asarray(value).tolist()
+             for key, value in sample.items()}
+  path = os.path.join(export_path, WARMUP_FILENAME)
+  with open(path, "w") as f:
+    json.dump({"inputs": payload}, f)
+  return path
+
+
+class _WarmupExportHook(hooks_lib.ExportHook):
+
+  def after_checkpoint(self, ctx, step):
+    path = super().after_checkpoint(ctx, step)
+    if path:
+      feature_spec = (
+          ctx.model.preprocessor.get_in_feature_specification(
+              modes_lib.PREDICT))
+      write_warmup_request(path, feature_spec)
+    return path
+
+
+@config.configurable
+class TD3HookBuilder(hooks_lib.HookBuilder):
+  """Current + lagged export dirs with warmup requests (reference
+  TD3Hooks)."""
+
+  def __init__(self, export_generator=None, num_versions: int = 3,
+               batch_size: int = 1):
+    self._export_generator = export_generator
+    self._num_versions = num_versions
+    self._batch_size = batch_size
+
+  def create_hooks(self, model, model_dir) -> List[hooks_lib.Hook]:
+    return [_WarmupExportHook(
+        export_generator=self._export_generator,
+        num_versions=self._num_versions,
+        lagged_export_dir_name="lagged_export")]

@@ -1,0 +1,43 @@
+"""Converter: legacy pickled assets -> JSON asset sidecars.
+
+Reference parity: /root/reference/utils/convert_pkl_assets_to_proto_assets
+.py:44-60 converted pickled feature/label spec dicts to t2r_assets.pbtxt;
+this converts the same pickles to our JSON asset format.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+from tensor2robot_tpu import specs as specs_lib
+
+__all__ = ["convert_pickle_assets"]
+
+
+def _to_spec_struct(obj) -> specs_lib.SpecStruct:
+  out = specs_lib.SpecStruct()
+  for key, value in specs_lib.flatten_spec_structure(dict(obj)).items():
+    if isinstance(value, specs_lib.TensorSpec):
+      out[key] = value
+    elif isinstance(value, dict):
+      out[key] = specs_lib.TensorSpec.from_dict(value)
+    else:  # (shape, dtype[, name]) tuples from legacy pickles
+      shape, dtype = value[0], value[1]
+      name = value[2] if len(value) > 2 else None
+      out[key] = specs_lib.TensorSpec(shape=tuple(shape), dtype=dtype,
+                                      name=name)
+  return out
+
+
+def convert_pickle_assets(pickle_path: str, output_path: str,
+                          global_step: int = 0) -> specs_lib.Assets:
+  """Reads {'feature_spec': ..., 'label_spec': ...} pickles and writes
+  the JSON asset file."""
+  with open(pickle_path, "rb") as f:
+    payload = pickle.load(f)
+  assets = specs_lib.Assets(
+      feature_spec=_to_spec_struct(payload["feature_spec"]),
+      label_spec=_to_spec_struct(payload.get("label_spec", {})),
+      global_step=global_step)
+  specs_lib.write_assets(assets, output_path)
+  return assets

@@ -54,6 +54,7 @@ class T2RModelFixture:
   def random_train(self, model, max_train_steps: int = 3,
                    **train_kwargs) -> Dict[str, float]:
     """Trains on random spec-shaped data, asserts output files."""
+    train_kwargs.setdefault("mesh_shape", (1, 1, 1))
     metrics = train_eval.train_eval_model(
         model=model,
         model_dir=self._model_dir,

@@ -1,0 +1,145 @@
+"""Tests for aux components: profiler hook, TD3 hooks/warmup, SavedModel
+predictor, jpeg recompress, pickle asset converter."""
+
+import glob
+import json
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs as specs_lib, train_eval
+from tensor2robot_tpu.data import codec
+from tensor2robot_tpu.export import export_generator as export_lib
+from tensor2robot_tpu.hooks import core as hooks_lib, profiler, td3
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config, convert_pkl_assets, mocks
+from tensor2robot_tpu.utils.test_fixture import T2RModelFixture
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+class TestProfilerHook:
+
+  def test_trace_files_written(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+
+    class Builder(hooks_lib.HookBuilder):
+      def create_hooks(self, model, model_dir):
+        return [profiler.ProfilerHook(start_step=2, num_steps=2)]
+
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=6,
+        checkpoint_every_n_steps=6,
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        mesh_shape=(1, 1, 1),
+        hook_builders=[Builder()], log_every_n_steps=6)
+    traces = glob.glob(os.path.join(model_dir, "profile", "**", "*"),
+                       recursive=True)
+    assert traces, "no profiler artifacts written"
+
+
+class TestTD3Hooks:
+
+  def test_lagged_export_and_warmup(self, tmp_path):
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=40,
+        checkpoint_every_n_steps=10,
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        mesh_shape=(1, 1, 1),
+        hook_builders=[td3.TD3HookBuilder(
+            export_generator=export_lib.DefaultExportGenerator())],
+        log_every_n_steps=20)
+    exports = sorted(glob.glob(os.path.join(model_dir, "export", "*")))
+    assert exports
+    warmup = os.path.join(exports[-1], td3.WARMUP_FILENAME)
+    assert os.path.isfile(warmup)
+    payload = json.load(open(warmup))
+    assert "x" in payload["inputs"]
+    lagged = sorted(glob.glob(os.path.join(model_dir, "lagged_export", "*")))
+    assert lagged, "no lagged export dir"
+    # the lagged version is strictly older than the newest live one
+    assert int(os.path.basename(lagged[-1])) < int(
+        os.path.basename(exports[-1]))
+
+
+class TestSavedModelPredictor:
+
+  def test_tf_runtime_serving(self, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu.predictors import saved_model_predictor
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10,
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        mesh_shape=(1, 1, 1),
+        export_generators=[export_lib.DefaultExportGenerator(
+            write_saved_model=True)],
+        log_every_n_steps=10)
+    predictor = saved_model_predictor.SavedModelPredictor(
+        export_dir=os.path.join(model_dir, "export"))
+    assert predictor.restore()
+    out = predictor.predict({"x": np.zeros((2, 3), np.float32)})
+    assert out["prediction"].shape == (2, 1)
+    assert predictor.global_step == 10
+
+
+class TestJpegHelpers:
+
+  def test_recompress_shrinks_and_caps_resolution(self):
+    rng = np.random.RandomState(0)
+    image = rng.randint(0, 255, (64, 64, 3), np.uint8)
+    png = codec.encode_image(image, "png")
+    jpeg = codec.maybe_recompress_jpeg(png, quality=60, max_side=32)
+    decoded = codec.decode_image(jpeg, channels=3)
+    assert max(decoded.shape[:2]) == 32
+    assert len(jpeg) < len(png)
+
+  def test_decode_image_batch(self):
+    imgs = [codec.encode_image(np.zeros((8, 8, 3), np.uint8), "png")] * 3
+    out = codec.decode_image_batch(imgs, channels=3)
+    assert out.shape == (3, 8, 8, 3)
+
+
+class TestPickleConverter:
+
+  def test_convert_legacy_pickle(self, tmp_path):
+    legacy = {
+        "feature_spec": {"image": ((32, 32, 3), "uint8", "img/encoded")},
+        "label_spec": {"y": ((1,), "float32")},
+    }
+    pkl = tmp_path / "assets.pkl"
+    pkl.write_bytes(pickle.dumps(legacy))
+    out = str(tmp_path / "t2r_assets.json")
+    assets = convert_pkl_assets.convert_pickle_assets(str(pkl), out, 7)
+    loaded = specs_lib.load_assets(out)
+    assert loaded.feature_spec["image"].shape == (32, 32, 3)
+    assert loaded.feature_spec["image"].name == "img/encoded"
+    assert loaded.label_spec["y"].dtype == np.float32
+    assert loaded.global_step == 7
+
+
+class TestFixtureGoldens:
+
+  def test_golden_roundtrip(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "run1"), batch_size=4)
+    golden = str(tmp_path / "golden.npy")
+    fixture.train_and_check_golden_predictions(
+        mocks.MockT2RModel(device_type="cpu"), golden)
+    assert os.path.isfile(golden)
+    # second run from identical seeds matches the stored golden
+    fixture2 = T2RModelFixture(str(tmp_path / "run2"), batch_size=4)
+    fixture2.train_and_check_golden_predictions(
+        mocks.MockT2RModel(device_type="cpu"), golden)
