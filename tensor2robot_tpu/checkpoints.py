@@ -81,6 +81,16 @@ class CheckpointManager:
   def wait_until_finished(self) -> None:
     self._manager.wait_until_finished()
 
+  def reached_preemption(self, step: int) -> bool:
+    """True when the orchestrator signaled preemption (SIGTERM on Borg /
+    GCE maintenance events). The train loop saves and exits cleanly so
+    the next incarnation resumes losslessly — elastic behavior the
+    reference lacks (SURVEY.md §5 'no preemption handling')."""
+    try:
+      return bool(self._manager.reached_preemption(step))
+    except Exception:
+      return False
+
   def close(self) -> None:
     self._manager.close()
 

@@ -275,6 +275,12 @@ def train_eval_model(
       final_metrics = scalars
     if step % checkpoint_every_n_steps == 0:
       _checkpoint(step)
+    if manager.reached_preemption(step):
+      logging.warning("Preemption signal at step %d: checkpoint + exit.",
+                      step)
+      _checkpoint(step, force=True)
+      manager.wait_until_finished()
+      raise SystemExit(42)
     if eval_step is not None and (step % eval_every_n_steps == 0
                                   or step == max_train_steps):
       eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
