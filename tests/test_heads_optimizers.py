@@ -1,0 +1,154 @@
+"""Tests for task-head model bases and optimizer/schedule factories."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.models import heads, optimizers
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+class _TinyClassifier(heads.ClassificationModel):
+
+  def __init__(self, num_classes=1, **kwargs):
+    super().__init__(num_classes=num_classes, device_type="cpu", **kwargs)
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({"x": TensorSpec(shape=(4,), dtype=np.float32)})
+
+  def get_label_specification(self, mode):
+    shape = (1,) if self.num_classes == 1 else (self.num_classes,)
+    return SpecStruct({"class": TensorSpec(shape=shape, dtype=np.float32)})
+
+  def create_module(self):
+    num_out = self.num_classes
+
+    class Net(nn.Module):
+      @nn.compact
+      def __call__(self, features, mode=modes.TRAIN, train=False):
+        return specs_lib.SpecStruct(
+            {"logits": nn.Dense(num_out)(features["x"])})
+
+    return Net()
+
+
+class TestClassificationModel:
+
+  def test_binary_metrics(self):
+    model = _TinyClassifier()
+    logits = jnp.array([[2.0], [-2.0], [2.0], [-2.0]])
+    labels = {"class": jnp.array([[1.0], [0.0], [0.0], [1.0]])}
+    metrics = model.model_eval_fn({}, labels, {"logits": logits})
+    assert float(metrics["accuracy"]) == 0.5
+    assert float(metrics["precision"]) == 0.5
+    assert float(metrics["recall"]) == 0.5
+
+  def test_multiclass_sparse_and_onehot(self):
+    model = _TinyClassifier(num_classes=3)
+    logits = jnp.array([[5.0, 0, 0], [0, 5.0, 0]])
+    sparse = {"class": jnp.array([0, 1])}
+    loss_sparse, _ = model.model_train_fn({}, sparse, {"logits": logits},
+                                          modes.TRAIN)
+    onehot = {"class": jnp.eye(3)[jnp.array([0, 1])]}
+    loss_onehot, _ = model.model_train_fn({}, onehot, {"logits": logits},
+                                          modes.TRAIN)
+    np.testing.assert_allclose(float(loss_sparse), float(loss_onehot),
+                               rtol=1e-6)
+
+  def test_export_outputs_scores(self):
+    model = _TinyClassifier()
+    out = model.create_export_outputs_fn(
+        {}, {"logits": jnp.array([[0.0]])})
+    np.testing.assert_allclose(np.asarray(out["scores"]), 0.5)
+
+  def test_trains_end_to_end(self):
+    model = _TinyClassifier()
+    features = {"x": np.random.RandomState(0).randn(16, 4).astype(
+        np.float32)}
+    labels = {"class": (features["x"][:, :1] > 0).astype(np.float32)}
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model)
+    first = None
+    for _ in range(100):
+      state, metrics = step(state, features, labels)
+      first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first
+
+
+class TestSchedules:
+
+  def test_constant(self):
+    sched = optimizers.create_constant_learning_rate(0.5)
+    assert float(sched(100)) == 0.5
+
+  def test_exponential_decay_staircase(self):
+    sched = optimizers.create_exponential_decay_learning_rate(
+        initial_learning_rate=1.0, decay_steps=10, decay_rate=0.5,
+        staircase=True)
+    assert float(sched(0)) == 1.0
+    assert float(sched(9)) == 1.0
+    np.testing.assert_allclose(float(sched(10)), 0.5)
+    np.testing.assert_allclose(float(sched(25)), 0.25)
+
+  def test_piecewise_linear(self):
+    sched = optimizers.create_piecewise_linear_learning_rate(
+        boundaries=(0, 10, 20), values=(0.0, 1.0, 0.0))
+    np.testing.assert_allclose(float(sched(5)), 0.5)
+    np.testing.assert_allclose(float(sched(10)), 1.0)
+    np.testing.assert_allclose(float(sched(15)), 0.5)
+    np.testing.assert_allclose(float(sched(30)), 0.0)
+
+  def test_piecewise_validates(self):
+    with pytest.raises(ValueError):
+      optimizers.create_piecewise_linear_learning_rate(
+          boundaries=(0,), values=(1.0, 2.0))
+
+
+class TestOptimizerFactories:
+
+  @pytest.mark.parametrize("factory", [
+      optimizers.create_adam_optimizer,
+      optimizers.create_sgd_optimizer,
+      optimizers.create_momentum_optimizer,
+      optimizers.create_rms_prop_optimizer,
+  ])
+  def test_updates_reduce_quadratic(self, factory):
+    tx = factory(learning_rate=0.1)
+    params = {"w": jnp.array([1.0, -2.0])}
+    opt_state = tx.init(params)
+    for _ in range(50):
+      grads = jax.grad(lambda p: (p["w"] ** 2).sum())(params)
+      updates, opt_state = tx.update(grads, opt_state, params)
+      params = optax.apply_updates(params, updates)
+    assert float(jnp.abs(params["w"]).max()) < 1.0
+
+  def test_gradient_clipping(self):
+    tx = optimizers.create_sgd_optimizer(learning_rate=1.0,
+                                         gradient_clip_norm=0.1)
+    params = {"w": jnp.zeros(2)}
+    opt_state = tx.init(params)
+    grads = {"w": jnp.array([100.0, 0.0])}
+    updates, _ = tx.update(grads, opt_state, params)
+    assert float(jnp.linalg.norm(updates["w"])) <= 0.1 + 1e-6
+
+  def test_config_injection(self):
+    config.parse_config("create_adam_optimizer.learning_rate = 0.25")
+    tx = optimizers.create_adam_optimizer()
+    # hyperparams captured: apply one step and check magnitude ~ lr
+    params = {"w": jnp.array([1.0])}
+    opt_state = tx.init(params)
+    updates, _ = tx.update({"w": jnp.array([1.0])}, opt_state, params)
+    np.testing.assert_allclose(float(-updates["w"][0]), 0.25, rtol=1e-2)
