@@ -88,8 +88,14 @@ class CheckpointManager:
     reference lacks (SURVEY.md §5 'no preemption handling')."""
     try:
       return bool(self._manager.reached_preemption(step))
-    except Exception:
-      return False
+    except (AttributeError, NotImplementedError):
+      return False  # orbax without preemption support on this platform
+    except Exception:  # noqa: BLE001 - never lose the save, but say why
+      from absl import logging
+
+      logging.exception("reached_preemption check failed; treating as "
+                        "preempted so the state is saved.")
+      return True
 
   def close(self) -> None:
     self._manager.close()

@@ -41,13 +41,18 @@ def write_warmup_request(export_path: str,
 
 class _WarmupExportHook(hooks_lib.ExportHook):
 
+  def __init__(self, warmup_batch_size: int = 1, **kwargs):
+    super().__init__(**kwargs)
+    self._warmup_batch_size = warmup_batch_size
+
   def after_checkpoint(self, ctx, step):
     path = super().after_checkpoint(ctx, step)
     if path:
       feature_spec = (
           ctx.model.preprocessor.get_in_feature_specification(
               modes_lib.PREDICT))
-      write_warmup_request(path, feature_spec)
+      write_warmup_request(path, feature_spec,
+                           batch_size=self._warmup_batch_size)
     return path
 
 
@@ -64,6 +69,7 @@ class TD3HookBuilder(hooks_lib.HookBuilder):
 
   def create_hooks(self, model, model_dir) -> List[hooks_lib.Hook]:
     return [_WarmupExportHook(
+        warmup_batch_size=self._batch_size,
         export_generator=self._export_generator,
         num_versions=self._num_versions,
         lagged_export_dir_name="lagged_export")]

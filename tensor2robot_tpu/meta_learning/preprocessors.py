@@ -91,17 +91,14 @@ class MAMLPreprocessor(preprocessors_lib.AbstractPreprocessor):
                                 features["condition/labels"])
     out["condition/features"] = cond_f
     out["condition/labels"] = cond_l
-    inf_f, _ = _one_split(features["inference/features"], None)
+    # One joint base call for the inference split so stateful/random base
+    # transforms (crops, mixup) keep features and labels synchronized.
+    inf_f, out_labels = _one_split(
+        features["inference/features"],
+        labels if labels is not None and len(labels) else None)
     out["inference/features"] = inf_f
-    out_labels = labels
-    if labels is not None and len(labels):
-      leading = np.shape(next(iter(
-          specs_lib.flatten_spec_structure(labels).values())))[:2]
-      flat_labels = batch_utils.flatten_batch_examples(labels)
-      _, out_l = self._apply_base(
-          batch_utils.flatten_batch_examples(features["inference/features"]),
-          flat_labels, mode)
-      out_labels = batch_utils.unflatten_batch_examples(out_l, leading)
+    if out_labels is None or not len(out_labels):
+      out_labels = labels
     return out, out_labels
 
 

@@ -105,18 +105,24 @@ void t2r_reader_close(void* handle) {
 // Reads up to max_records records into the reader's arena.
 // Returns: number of records read; 0 on clean EOF; -1 on corruption.
 // After the call, t2r_reader_data/offsets/lengths expose the batch.
-int64_t t2r_reader_next_batch(void* handle, int64_t max_records) {
+int64_t t2r_reader_next_batch(void* handle, int64_t max_records) try {
   Reader* r = static_cast<Reader*>(handle);
   r->arena.clear();
   r->offsets.clear();
   r->lengths.clear();
   uint8_t header[12];
+  // Sanity cap: a corrupt length field must not drive a huge allocation.
+  constexpr uint64_t kMaxRecordBytes = 1ull << 31;  // 2 GiB
   for (int64_t i = 0; i < max_records; ++i) {
     size_t got = std::fread(header, 1, 12, r->file);
     if (got == 0) break;               // clean EOF
     if (got < 12) { r->error = "truncated header"; return -1; }
     uint64_t length;
     std::memcpy(&length, header, 8);
+    if (length > kMaxRecordBytes) {
+      r->error = "implausible record length (corrupt file?)";
+      return -1;
+    }
     if (r->verify_crc) {
       uint32_t expect;
       std::memcpy(&expect, header + 8, 4);
@@ -148,6 +154,10 @@ int64_t t2r_reader_next_batch(void* handle, int64_t max_records) {
     r->lengths.push_back(static_cast<int64_t>(length));
   }
   return static_cast<int64_t>(r->offsets.size());
+} catch (const std::exception& e) {
+  // Exceptions must not cross the C ABI: report as a corrupt-file error.
+  static_cast<Reader*>(handle)->error = e.what();
+  return -1;
 }
 
 const uint8_t* t2r_reader_data(void* handle) {

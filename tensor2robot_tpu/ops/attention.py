@@ -88,6 +88,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
   tq_idx = pl.program_id(1)
   seq_len = k_ref.shape[0]
   num_k_blocks = seq_len // block_k
+  if causal:
+    # Future blocks are fully masked: stop the stream at the diagonal.
+    num_k_blocks = jnp.minimum(
+        num_k_blocks,
+        ((tq_idx + 1) * q_block + block_k - 1) // block_k)
 
   def body(kb, carry):
     m, l, o = carry

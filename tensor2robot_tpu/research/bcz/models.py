@@ -94,7 +94,7 @@ class BCZPreprocessor(preprocessors_lib.SpecTransformationPreprocessor):
         labels["gripper"] = (np.asarray(labels["gripper"]) > 0.5).astype(
             np.float32)
       if is_training and self._mixup_alpha > 0.0:
-        lam = float(np.random.default_rng(self._calls).beta(
+        lam = float(np.random.default_rng(self._seed + self._calls).beta(
             self._mixup_alpha, self._mixup_alpha))
         perm = np.roll(np.arange(features["image"].shape[0]), 1)
         features["image"] = (lam * features["image"]
@@ -214,13 +214,20 @@ class BCZModel(abstract_model.T2RModel):
     total = 0.0
     # Steps after the episode stops contribute no action loss
     # (reference stop-token masking :321-638).
-    mask = 1.0
+    mask = None
     if self._predict_stop and STOP_KEY in labels:
       stop = labels[STOP_KEY]  # 1.0 once stopped
       mask = (1.0 - stop)[:, :, None]
     for name, size, weight in self._components:
       err = inference_outputs[name] - labels[name]
-      component_loss = (huber(err, self._huber_delta) * mask).mean()
+      elementwise = huber(err, self._huber_delta)
+      if mask is None:
+        component_loss = elementwise.mean()
+      else:
+        # Normalize by the number of *active* elements so the per-step
+        # training signal is independent of episode length.
+        denom = jnp.maximum((mask * jnp.ones_like(elementwise)).sum(), 1.0)
+        component_loss = (elementwise * mask).sum() / denom
       scalars[f"loss/{name}"] = component_loss
       total = total + weight * component_loss
     if self._predict_stop and STOP_KEY in labels:

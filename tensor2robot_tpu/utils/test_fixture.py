@@ -18,8 +18,6 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from tensor2robot_tpu import modes as modes_lib
-from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu import train_eval
 from tensor2robot_tpu.checkpoints import latest_step
 from tensor2robot_tpu.data import input_generators
@@ -86,14 +84,7 @@ class T2RModelFixture:
       update: Optional[bool] = None) -> None:
     """Trains deterministically, then compares fixed-batch predictions to
     a golden file; writes the golden when absent (or update=True)."""
-    from tensor2robot_tpu.parallel import train_step as ts
-    import jax
-
     self.random_train(model, max_train_steps=max_train_steps)
-    feature_spec = model.preprocessor.get_out_feature_specification(
-        modes_lib.PREDICT)
-    batch = specs_lib.make_random_numpy(
-        feature_spec, batch_size=self._batch_size, seed=123)
     outputs = train_eval.predict_from_model(
         model=model, model_dir=self._model_dir,
         input_generator=input_generators.DefaultRandomInputGenerator(
