@@ -174,11 +174,70 @@ class ParseFn:
     self._dataset_keys = specs_lib.dataset_keys(merged)
     self._plans: Dict[str, List[_LeafPlan]] = {}
     self._sequence_datasets: Dict[str, bool] = {}
+    self._native_parsers: Dict[str, Any] = {}
     for dkey in self._dataset_keys:
       subset = specs_lib.filter_by_dataset(merged, dkey)
       self._plans[dkey] = _plan_for(subset)
       self._sequence_datasets[dkey] = any(
           spec.is_sequence for spec in subset.values())
+      self._native_parsers[dkey] = self._maybe_native_parser(
+          self._plans[dkey], self._sequence_datasets[dkey])
+
+  def _maybe_native_parser(self, plans: List[_LeafPlan],
+                           is_sequence: bool):
+    """Builds the C++ columnar parser when every leaf fits its profile:
+    fixed-shape float/int features and single-value bytes/images, no
+    sequences/optionals/varlen (those take the Python path)."""
+    if is_sequence:
+      return None
+    native_plan = []
+    for plan in plans:
+      spec = plan.spec
+      if spec.is_optional or spec.varlen_default_value is not None:
+        return None
+      if spec.is_image and not spec.is_extracted:
+        native_plan.append((plan.feature_name, 2, 0, False))  # KIND_BYTES
+        continue
+      if any(d is None for d in spec.shape):
+        return None
+      size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
+      if plan.parse_dtype == np.float32:
+        native_plan.append((plan.feature_name, 0, size, False))
+      elif np.issubdtype(plan.parse_dtype, np.integer):
+        native_plan.append((plan.feature_name, 1, size, False))
+      else:
+        return None
+    try:
+      from tensor2robot_tpu import native
+
+      if not native.available():
+        return None
+      return native.BatchExampleParser(native_plan)
+    except Exception:
+      return None
+
+  def _parse_batch_native(self, dkey: str,
+                          serialized_list: Sequence[bytes]
+                          ) -> Dict[str, np.ndarray]:
+    """Fast path: columnar native parse producing full batch arrays."""
+    parser = self._native_parsers[dkey]
+    plans = self._plans[dkey]
+    float_buffers, int_buffers, bytes_lists = parser.parse(
+        list(serialized_list))
+    out: Dict[str, np.ndarray] = {}
+    for i, plan in enumerate(plans):
+      spec = plan.spec
+      if spec.is_image and not spec.is_extracted:
+        out[plan.out_key] = np.stack(
+            [_decode_image_feature([data], plan)
+             for data in bytes_lists[i]])
+        continue
+      buf = float_buffers.get(i)
+      if buf is None:
+        buf = int_buffers[i]
+      out[plan.out_key] = buf.reshape(
+          (len(serialized_list),) + spec.shape)
+    return out
 
   @property
   def dataset_keys(self) -> Tuple[str, ...]:
@@ -208,10 +267,14 @@ class ParseFn:
       records = {self._dataset_keys[0]: records}
     columns: Dict[str, List[Any]] = {}
     lengths: Dict[str, List[int]] = {}
+    batched: Dict[str, np.ndarray] = {}  # native fast-path outputs
     batch_sizes = {k: len(v) for k, v in records.items()}
     if len(set(batch_sizes.values())) > 1:
       raise ValueError(f"Dataset batch sizes differ: {batch_sizes}")
     for dkey, serialized_list in records.items():
+      if self._native_parsers.get(dkey) is not None:
+        batched.update(self._parse_batch_native(dkey, serialized_list))
+        continue
       plans = self._plans[dkey]
       is_sequence = self._sequence_datasets[dkey]
       for serialized in serialized_list:
@@ -256,6 +319,8 @@ class ParseFn:
     merged_specs = {**{f"features/{k}": v for k, v in
                        self._feature_spec.items()},
                     **{f"labels/{k}": v for k, v in self._label_spec.items()}}
+    for out_key, array in batched.items():
+      out[out_key] = self._maybe_cast(array, merged_specs[out_key])
     for out_key, values in columns.items():
       spec = merged_specs[out_key]
       if all(v is None for v in values):

@@ -15,8 +15,9 @@ import threading
 from typing import Iterator, List, Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCE = os.path.join(_DIR, "tfrecord_io.cc")
-_LIB_PATH = os.path.join(_DIR, "libt2r_tfrecord_io.so")
+_SOURCES = [os.path.join(_DIR, "tfrecord_io.cc"),
+            os.path.join(_DIR, "example_parser.cc")]
+_LIB_PATH = os.path.join(_DIR, "libt2r_native.so")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _LOAD_FAILED = False
@@ -25,7 +26,7 @@ _LOAD_FAILED = False
 def _build() -> bool:
   try:
     subprocess.run(
-        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SOURCE,
+        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SOURCES,
          "-o", _LIB_PATH],
         check=True, capture_output=True, timeout=120)
     return True
@@ -40,8 +41,9 @@ def load() -> Optional[ctypes.CDLL]:
   with _LOCK:
     if _LIB is not None or _LOAD_FAILED:
       return _LIB
-    if not os.path.isfile(_LIB_PATH) or (
-        os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SOURCE)):
+    if not os.path.isfile(_LIB_PATH) or any(
+        os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
+        for src in _SOURCES):
       if not _build():
         _LOAD_FAILED = True
         return None
@@ -67,6 +69,24 @@ def load() -> Optional[ctypes.CDLL]:
     lib.t2r_reader_lengths.argtypes = [ctypes.c_void_p]
     lib.t2r_reader_error.restype = ctypes.c_char_p
     lib.t2r_reader_error.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_create.restype = ctypes.c_void_p
+    lib.t2r_parser_create.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+    lib.t2r_parser_destroy.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_error.restype = ctypes.c_char_p
+    lib.t2r_parser_error.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_bytes_ptrs.restype = ctypes.POINTER(ctypes.c_void_p)
+    lib.t2r_parser_bytes_ptrs.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_bytes_lens.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_parser_bytes_lens.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_parse_batch.restype = ctypes.c_int
+    lib.t2r_parser_parse_batch.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint8)]
     _LIB = lib
     return _LIB
 
@@ -107,3 +127,82 @@ def iter_records_native(path: str, verify_crc: bool = False,
             ctypes.addressof(data.contents) + offsets[i], lengths[i])
   finally:
     lib.t2r_reader_close(handle)
+
+
+KIND_FLOAT, KIND_INT64, KIND_BYTES = 0, 1, 2
+
+
+class BatchExampleParser:
+  """Columnar batched Example parsing through the native library.
+
+  Plan: a list of (name, kind, size, missing_ok) tuples. `parse` returns
+  (float_buffers, int_buffers, bytes_lists): dense numpy arrays of shape
+  [batch, size] for float/int features and python lists of bytes (or
+  None) for bytes features, in plan order.
+  """
+
+  def __init__(self, plan):
+    import numpy as np
+
+    lib = load()
+    if lib is None:
+      raise RuntimeError("native library unavailable")
+    self._lib = lib
+    self._plan = list(plan)
+    n = len(self._plan)
+    names = (ctypes.c_char_p * n)(
+        *[name.encode() for name, _, _, _ in self._plan])
+    kinds = (ctypes.c_int * n)(*[k for _, k, _, _ in self._plan])
+    sizes = (ctypes.c_int64 * n)(*[s for _, _, s, _ in self._plan])
+    self._missing_ok = (ctypes.c_uint8 * n)(
+        *[1 if m else 0 for _, _, _, m in self._plan])
+    self._handle = lib.t2r_parser_create(names, kinds, sizes, n)
+    self._np = np
+
+  def __del__(self):
+    if getattr(self, "_handle", None) and self._lib is not None:
+      self._lib.t2r_parser_destroy(self._handle)
+      self._handle = None
+
+  def parse(self, records):
+    np = self._np
+    batch = len(records)
+    n = len(self._plan)
+    rec_array = (ctypes.c_char_p * batch)(*records)
+    len_array = (ctypes.c_int64 * batch)(*[len(r) for r in records])
+    float_outs = (ctypes.c_void_p * n)()
+    int_outs = (ctypes.c_void_p * n)()
+    float_buffers, int_buffers = {}, {}
+    for i, (name, kind, size, _) in enumerate(self._plan):
+      if kind == KIND_FLOAT:
+        buf = np.zeros((batch, size), np.float32)
+        float_buffers[i] = buf
+        float_outs[i] = buf.ctypes.data_as(ctypes.c_void_p)
+      elif kind == KIND_INT64:
+        buf = np.zeros((batch, size), np.int64)
+        int_buffers[i] = buf
+        int_outs[i] = buf.ctypes.data_as(ctypes.c_void_p)
+    status = self._lib.t2r_parser_parse_batch(
+        self._handle, rec_array, len_array, batch, float_outs, int_outs,
+        self._missing_ok)
+    if status != 0:
+      raise ValueError(
+          "native example parse failed: "
+          + self._lib.t2r_parser_error(self._handle).decode())
+    num_bytes = sum(1 for _, k, _, _ in self._plan if k == KIND_BYTES)
+    bytes_lists = {}
+    if num_bytes:
+      ptrs = self._lib.t2r_parser_bytes_ptrs(self._handle)
+      lens = self._lib.t2r_parser_bytes_lens(self._handle)
+      slot = 0
+      for i, (name, kind, _, _) in enumerate(self._plan):
+        if kind != KIND_BYTES:
+          continue
+        values = []
+        for r in range(batch):
+          ptr = ptrs[r * num_bytes + slot]
+          length = lens[r * num_bytes + slot]
+          values.append(ctypes.string_at(ptr, length) if ptr else b"")
+        bytes_lists[i] = values
+        slot += 1
+    return float_buffers, int_buffers, bytes_lists

@@ -1,0 +1,321 @@
+// Native batched tf.Example parser.
+//
+// Parses batches of serialized Example protos directly (hand-rolled
+// varint/wire walking, no protobuf runtime) into dense columnar buffers
+// for the spec-driven data layer — the host-side hot path that must keep
+// a TPU pod fed (SURVEY.md §7). Scope: Example messages with
+// fixed-length float/int64 features and single-value bytes features
+// (images); everything else takes the Python path.
+//
+// Wire layout (proto3):
+//   Example        { Features features = 1; }
+//   Features       { map<string, Feature> feature = 1; }
+//   map entry      { string key = 1; Feature value = 2; }
+//   Feature        { oneof { BytesList=1; FloatList=2; Int64List=3 } }
+//   BytesList      { repeated bytes value = 1; }
+//   FloatList      { repeated float value = 1 [packed]; }
+//   Int64List      { repeated int64 value = 1 [packed]; }
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Slice {
+  const uint8_t* data;
+  size_t size;
+};
+
+bool read_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    uint8_t byte = *p++;
+    result |= static_cast<uint64_t>(byte & 0x7F) << shift;
+    if (!(byte & 0x80)) {
+      *out = result;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+bool skip_field(const uint8_t*& p, const uint8_t* end, uint32_t wire_type) {
+  uint64_t tmp;
+  switch (wire_type) {
+    case 0:  // varint
+      return read_varint(p, end, &tmp);
+    case 1:  // 64-bit
+      if (end - p < 8) return false;
+      p += 8;
+      return true;
+    case 2: {  // length-delimited
+      if (!read_varint(p, end, &tmp) || static_cast<uint64_t>(end - p) < tmp)
+        return false;
+      p += tmp;
+      return true;
+    }
+    case 5:  // 32-bit
+      if (end - p < 4) return false;
+      p += 4;
+      return true;
+    default:
+      return false;
+  }
+}
+
+bool get_subfield(Slice message, uint32_t want_field, Slice* out) {
+  // Finds the first length-delimited occurrence of `want_field`.
+  const uint8_t* p = message.data;
+  const uint8_t* end = message.data + message.size;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (field == want_field && wire == 2) {
+      uint64_t len;
+      if (!read_varint(p, end, &len) ||
+          static_cast<uint64_t>(end - p) < len)
+        return false;
+      out->data = p;
+      out->size = len;
+      return true;
+    }
+    if (!skip_field(p, end, wire)) return false;
+  }
+  return false;
+}
+
+// Feature kinds (must match the Python wrapper).
+enum Kind { KIND_FLOAT = 0, KIND_INT64 = 1, KIND_BYTES = 2 };
+
+struct Plan {
+  std::vector<std::string> names;
+  std::vector<int> kinds;
+  std::vector<int64_t> sizes;  // expected element count (floats/ints)
+  std::unordered_map<std::string, int> index;
+  std::string error;
+  // per-parse outputs
+  std::vector<const uint8_t*> bytes_ptrs;
+  std::vector<int64_t> bytes_lens;
+};
+
+bool parse_float_list(Slice feature_payload, float* out, int64_t expect,
+                      Plan* plan) {
+  // feature_payload is the FloatList message; field 1 packed (or
+  // repeated unpacked 32-bit).
+  const uint8_t* p = feature_payload.data;
+  const uint8_t* end = p + feature_payload.size;
+  int64_t count = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (field == 1 && wire == 2) {  // packed
+      uint64_t len;
+      if (!read_varint(p, end, &len) || len % 4 ||
+          static_cast<uint64_t>(end - p) < len)
+        return false;
+      int64_t n = static_cast<int64_t>(len / 4);
+      if (count + n > expect) return false;
+      std::memcpy(out + count, p, len);
+      count += n;
+      p += len;
+    } else if (field == 1 && wire == 5) {  // unpacked
+      if (end - p < 4 || count + 1 > expect) return false;
+      std::memcpy(out + count, p, 4);
+      ++count;
+      p += 4;
+    } else if (!skip_field(p, end, wire)) {
+      return false;
+    }
+  }
+  return count == expect;
+}
+
+bool parse_int64_list(Slice feature_payload, int64_t* out, int64_t expect) {
+  const uint8_t* p = feature_payload.data;
+  const uint8_t* end = p + feature_payload.size;
+  int64_t count = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!read_varint(p, end, &tag)) return false;
+    uint32_t field = static_cast<uint32_t>(tag >> 3);
+    uint32_t wire = static_cast<uint32_t>(tag & 7);
+    if (field == 1 && wire == 2) {  // packed varints
+      uint64_t len;
+      if (!read_varint(p, end, &len) ||
+          static_cast<uint64_t>(end - p) < len)
+        return false;
+      const uint8_t* sub_end = p + len;
+      while (p < sub_end) {
+        uint64_t v;
+        if (!read_varint(p, sub_end, &v) || count + 1 > expect) return false;
+        out[count++] = static_cast<int64_t>(v);
+      }
+    } else if (field == 1 && wire == 0) {
+      uint64_t v;
+      if (!read_varint(p, end, &v) || count + 1 > expect) return false;
+      out[count++] = static_cast<int64_t>(v);
+    } else if (!skip_field(p, end, wire)) {
+      return false;
+    }
+  }
+  return count == expect;
+}
+
+bool parse_bytes_first(Slice feature_payload, const uint8_t** out_ptr,
+                       int64_t* out_len) {
+  Slice value;
+  if (!get_subfield(feature_payload, 1, &value)) {
+    *out_ptr = nullptr;
+    *out_len = 0;
+    return true;  // empty bytes list -> empty value
+  }
+  *out_ptr = value.data;
+  *out_len = static_cast<int64_t>(value.size);
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* t2r_parser_create(const char** names, const int* kinds,
+                        const int64_t* sizes, int n) {
+  Plan* plan = new Plan();
+  for (int i = 0; i < n; ++i) {
+    plan->names.emplace_back(names[i]);
+    plan->kinds.push_back(kinds[i]);
+    plan->sizes.push_back(sizes[i]);
+    plan->index[plan->names.back()] = i;
+  }
+  return plan;
+}
+
+void t2r_parser_destroy(void* handle) {
+  delete static_cast<Plan*>(handle);
+}
+
+const char* t2r_parser_error(void* handle) {
+  return static_cast<Plan*>(handle)->error.c_str();
+}
+
+const uint8_t** t2r_parser_bytes_ptrs(void* handle) {
+  return static_cast<Plan*>(handle)->bytes_ptrs.data();
+}
+
+const int64_t* t2r_parser_bytes_lens(void* handle) {
+  return static_cast<Plan*>(handle)->bytes_lens.data();
+}
+
+// Parses `batch` records. float/int features land in dense buffers of
+// shape [batch, size] supplied per feature (float_outs[i] / int_outs[i],
+// null for other kinds). Bytes features are exposed via
+// t2r_parser_bytes_ptrs/lens as [batch * num_bytes_features] pairs in
+// (record-major, plan-order) layout; pointers alias the input records.
+// `missing_ok` features absent from a record leave zeros / null entries.
+// Returns 0 on success, -1 on malformed input (error() says why).
+int t2r_parser_parse_batch(void* handle,
+                           const uint8_t** records, const int64_t* lens,
+                           int64_t batch,
+                           float** float_outs, int64_t** int_outs,
+                           const uint8_t* missing_ok) try {
+  Plan* plan = static_cast<Plan*>(handle);
+  int num_features = static_cast<int>(plan->names.size());
+  int num_bytes = 0;
+  for (int k : plan->kinds) num_bytes += (k == KIND_BYTES);
+  plan->bytes_ptrs.assign(static_cast<size_t>(batch) * num_bytes, nullptr);
+  plan->bytes_lens.assign(static_cast<size_t>(batch) * num_bytes, 0);
+
+  std::vector<uint8_t> seen(num_features);
+  for (int64_t r = 0; r < batch; ++r) {
+    Slice record{records[r], static_cast<size_t>(lens[r])};
+    Slice features_msg;
+    if (!get_subfield(record, 1, &features_msg)) {
+      plan->error = "record has no features message";
+      return -1;
+    }
+    std::fill(seen.begin(), seen.end(), 0);
+    // Walk the feature map entries.
+    const uint8_t* p = features_msg.data;
+    const uint8_t* end = features_msg.data + features_msg.size;
+    while (p < end) {
+      uint64_t tag;
+      if (!read_varint(p, end, &tag)) { plan->error = "bad tag"; return -1; }
+      uint32_t field = static_cast<uint32_t>(tag >> 3);
+      uint32_t wire = static_cast<uint32_t>(tag & 7);
+      if (field != 1 || wire != 2) {
+        if (!skip_field(p, end, wire)) { plan->error = "bad skip"; return -1; }
+        continue;
+      }
+      uint64_t entry_len;
+      if (!read_varint(p, end, &entry_len) ||
+          static_cast<uint64_t>(end - p) < entry_len) {
+        plan->error = "bad map entry";
+        return -1;
+      }
+      Slice entry{p, entry_len};
+      p += entry_len;
+      Slice key_slice, feature_msg;
+      if (!get_subfield(entry, 1, &key_slice)) continue;
+      std::string key(reinterpret_cast<const char*>(key_slice.data),
+                      key_slice.size);
+      auto it = plan->index.find(key);
+      if (it == plan->index.end()) continue;  // feature not in plan
+      int i = it->second;
+      if (!get_subfield(entry, 2, &feature_msg)) continue;
+      int kind = plan->kinds[i];
+      bool ok = true;
+      if (kind == KIND_FLOAT) {
+        Slice payload;
+        ok = get_subfield(feature_msg, 2, &payload) &&
+             parse_float_list(payload,
+                              float_outs[i] + r * plan->sizes[i],
+                              plan->sizes[i], plan);
+      } else if (kind == KIND_INT64) {
+        Slice payload;
+        ok = get_subfield(feature_msg, 3, &payload) &&
+             parse_int64_list(payload,
+                              int_outs[i] + r * plan->sizes[i],
+                              plan->sizes[i]);
+      } else {  // KIND_BYTES
+        Slice payload;
+        int bytes_slot = 0;
+        for (int j = 0; j < i; ++j)
+          bytes_slot += (plan->kinds[j] == KIND_BYTES);
+        const uint8_t* ptr = nullptr;
+        int64_t blen = 0;
+        ok = get_subfield(feature_msg, 1, &payload) &&
+             parse_bytes_first(payload, &ptr, &blen);
+        if (ok) {
+          plan->bytes_ptrs[r * num_bytes + bytes_slot] = ptr;
+          plan->bytes_lens[r * num_bytes + bytes_slot] = blen;
+        }
+      }
+      if (!ok) {
+        plan->error = "malformed feature '" + key + "'";
+        return -1;
+      }
+      seen[i] = 1;
+    }
+    for (int i = 0; i < num_features; ++i) {
+      if (!seen[i] && !missing_ok[i]) {
+        plan->error = "missing required feature '" + plan->names[i] + "'";
+        return -1;
+      }
+    }
+  }
+  return 0;
+} catch (const std::exception& e) {
+  static_cast<Plan*>(handle)->error = e.what();
+  return -1;
+}
+
+}  // extern "C"
