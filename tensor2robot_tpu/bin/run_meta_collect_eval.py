@@ -1,0 +1,32 @@
+"""Meta-learning actor CLI: demo-conditioned collect/eval.
+
+Reference twin of driving `run_meta_env` from a binary
+(/root/reference/meta_learning/run_meta_env.py).
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_meta_collect_eval \
+      --config_files path/to/meta_eval.gin
+"""
+
+from __future__ import annotations
+
+from absl import app, flags
+
+from tensor2robot_tpu.envs import run_meta_env
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse.")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  run_meta_env.run_meta_env()
+
+
+if __name__ == "__main__":
+  app.run(main)

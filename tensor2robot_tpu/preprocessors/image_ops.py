@@ -230,3 +230,24 @@ def crop_resize_distort(key: jax.Array,
   if is_training and distort:
     image = apply_photometric_distortions(key_dist, image)
   return image
+
+
+def random_gamma(key: jax.Array, image: jnp.ndarray,
+                 max_log_gamma: float = 0.3) -> jnp.ndarray:
+  """Cheap photometric variant: per-image gamma curve (the reference's
+  low-cost distortion path, image_transformations.py 'cheap gamma')."""
+  _check_batched(image)
+  log_gamma = _per_image_uniform(key, image.shape[0], -max_log_gamma,
+                                 max_log_gamma)
+  return jnp.clip(image, 1e-6, 1.0) ** jnp.exp(log_gamma)
+
+
+def apply_cheap_photometric_distortions(key: jax.Array,
+                                        image: jnp.ndarray,
+                                        max_log_gamma: float = 0.3,
+                                        max_brightness_delta: float = 0.05
+                                        ) -> jnp.ndarray:
+  """Gamma + small brightness only — for host-CPU-bound pipelines."""
+  key_gamma, key_bright = jax.random.split(key)
+  image = random_gamma(key_gamma, image, max_log_gamma)
+  return random_brightness(key_bright, image, max_brightness_delta)

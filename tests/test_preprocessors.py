@@ -174,3 +174,18 @@ class TestImageOps:
     img = np.random.RandomState(0).randint(0, 255, (2, 4, 4, 3), np.uint8)
     rt = image_ops.to_uint8_image(image_ops.to_float_image(jnp.asarray(img)))
     np.testing.assert_array_equal(np.asarray(rt), img)
+
+
+class TestCheapDistortions:
+
+  def test_gamma_in_range_and_stochastic(self):
+    import jax
+
+    from tensor2robot_tpu.preprocessors import image_ops
+
+    img = jax.random.uniform(jax.random.PRNGKey(0), (4, 8, 8, 3))
+    out = image_ops.apply_cheap_photometric_distortions(
+        jax.random.PRNGKey(1), img)
+    assert out.shape == img.shape
+    assert float(out.min()) >= 0.0 and float(out.max()) <= 1.0
+    assert not np.allclose(np.asarray(out), np.asarray(img))
