@@ -155,17 +155,22 @@ class ExportHook(Hook):
   optionally maintains a one-version-lagged directory (reference
   CheckpointExportListener + LaggedCheckpointListener,
   /root/reference/hooks/checkpoint_hooks.py:51-201; TD3 target networks
-  read the lagged dir)."""
+  read the lagged dir). With `async_export=True` the export runs on a
+  background thread so the train loop never stalls (the reference's
+  AsyncCheckpointSaverHook listener behavior)."""
 
   def __init__(self,
                export_generator=None,
                export_dir_name: str = "export",
                num_versions: int = 3,
-               lagged_export_dir_name: Optional[str] = None):
+               lagged_export_dir_name: Optional[str] = None,
+               async_export: bool = False):
     self._export_generator = export_generator
     self._export_dir_name = export_dir_name
     self._num_versions = num_versions
     self._lagged_dir_name = lagged_export_dir_name
+    self._async = async_export
+    self._worker = None
 
   def begin(self, ctx: TrainContext) -> None:
     if self._export_generator is not None:
@@ -174,10 +179,22 @@ class ExportHook(Hook):
   def after_checkpoint(self, ctx: TrainContext, step: int) -> None:
     if self._export_generator is None:
       return
+    if self._async:
+      import threading
+
+      if self._worker is not None and self._worker.is_alive():
+        self._worker.join()  # one in-flight export at a time
+      state = jax.device_get(ctx.get_state())
+      self._worker = threading.Thread(
+          target=self._do_export, args=(ctx, step, state), daemon=True)
+      self._worker.start()
+      return None
+    return self._do_export(ctx, step, ctx.get_state())
+
+  def _do_export(self, ctx: TrainContext, step: int, state) -> Optional[str]:
     base = os.path.join(ctx.model_dir, self._export_dir_name)
     previous = _numeric_subdirs(base)
-    path = self._export_generator.export(
-        ctx.get_state(), base, global_step=step)
+    path = self._export_generator.export(state, base, global_step=step)
     if self._lagged_dir_name and previous:
       lagged_base = os.path.join(ctx.model_dir, self._lagged_dir_name)
       lagged_target = os.path.join(lagged_base, os.path.basename(previous[-1]))
@@ -189,6 +206,10 @@ class ExportHook(Hook):
     for old in _numeric_subdirs(base)[:-self._num_versions]:
       shutil.rmtree(old, ignore_errors=True)
     return path
+
+  def end(self, ctx: TrainContext) -> None:
+    if self._worker is not None and self._worker.is_alive():
+      self._worker.join()
 
 
 def _numeric_subdirs(base: str) -> List[str]:
@@ -223,3 +244,55 @@ class AsyncExportHookBuilder(HookBuilder):
         export_generator=self._export_generator,
         num_versions=self._num_versions,
         lagged_export_dir_name="lagged_export" if self._lagged else None)]
+
+
+@config.configurable
+class BestExportHook(Hook):
+  """Exports only when an eval metric improves (reference BestExporter,
+  /root/reference/utils/train_eval.py:295-386 best/latest compare fns).
+
+  Keeps a `best_export/` dir with the single best bundle plus a
+  `best_metric.json` record of the winning value.
+  """
+
+  def __init__(self,
+               export_generator=None,
+               metric_key: str = "loss",
+               higher_is_better: bool = False,
+               export_dir_name: str = "best_export"):
+    self._export_generator = export_generator
+    self._metric_key = metric_key
+    self._higher = higher_is_better
+    self._export_dir_name = export_dir_name
+    self._best: Optional[float] = None
+
+  def begin(self, ctx: TrainContext) -> None:
+    if self._export_generator is not None:
+      self._export_generator.set_specification_from_model(ctx.model)
+    # Resume comparison state across restarts.
+    record = os.path.join(ctx.model_dir, self._export_dir_name,
+                          "best_metric.json")
+    if os.path.isfile(record):
+      import json
+
+      self._best = json.load(open(record)).get("value")
+
+  def after_eval(self, ctx: TrainContext, step: int, metrics) -> None:
+    if self._export_generator is None or self._metric_key not in metrics:
+      return
+    import json
+
+    value = float(np.asarray(metrics[self._metric_key]))
+    improved = (self._best is None
+                or (value > self._best if self._higher
+                    else value < self._best))
+    if not improved:
+      return
+    self._best = value
+    base = os.path.join(ctx.model_dir, self._export_dir_name)
+    self._export_generator.export(ctx.get_state(), base, global_step=step)
+    for old in _numeric_subdirs(base)[:-1]:
+      shutil.rmtree(old, ignore_errors=True)
+    with open(os.path.join(base, "best_metric.json"), "w") as f:
+      json.dump({"metric": self._metric_key, "value": value,
+                 "step": step}, f)

@@ -48,8 +48,12 @@ CHECKPOINT_DIRNAME = "checkpoints"
 def provide_input_generator_with_model_information(
     input_generator, model, mode: str):
   """Injects the model's (preprocessor) specs + preprocess fn into an
-  input generator (reference :97-128)."""
+  input generator (reference :97-128), plus host-sharding info for
+  record readers (per-host file shards on multi-process pods)."""
   input_generator.set_specification_from_model(model, mode)
+  if hasattr(input_generator, "set_process_info"):
+    input_generator.set_process_info(jax.process_index(),
+                                     jax.process_count())
   return input_generator
 
 

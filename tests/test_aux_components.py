@@ -143,3 +143,54 @@ class TestFixtureGoldens:
     fixture2 = T2RModelFixture(str(tmp_path / "run2"), batch_size=4)
     fixture2.train_and_check_golden_predictions(
         mocks.MockT2RModel(device_type="cpu"), golden)
+
+
+class TestBestAndAsyncExport:
+
+  def test_best_export_only_on_improvement(self, tmp_path):
+    from tensor2robot_tpu.export import export_generator as export_lib
+
+    model_dir = str(tmp_path / "m")
+    hook = hooks_lib.BestExportHook(
+        export_generator=export_lib.DefaultExportGenerator(),
+        metric_key="accuracy", higher_is_better=True)
+
+    class Builder(hooks_lib.HookBuilder):
+      def create_hooks(self, model, model_dir):
+        return [hook]
+
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train_and_evaluate",
+        max_train_steps=60, eval_steps=2, eval_every_n_steps=30,
+        checkpoint_every_n_steps=30,
+        input_generator_train=mocks.MockInputGenerator(batch_size=16),
+        mesh_shape=(1, 1, 1),
+        input_generator_eval=mocks.MockInputGenerator(batch_size=16),
+        hook_builders=[Builder()], log_every_n_steps=30)
+    best_dir = os.path.join(model_dir, "best_export")
+    bundles = [d for d in os.listdir(best_dir) if d.isdigit()]
+    assert len(bundles) == 1  # only the best survives
+    record = json.load(open(os.path.join(best_dir, "best_metric.json")))
+    assert record["metric"] == "accuracy"
+
+  def test_async_export_completes(self, tmp_path):
+    from tensor2robot_tpu.export import export_generator as export_lib
+
+    model_dir = str(tmp_path / "m")
+
+    class Builder(hooks_lib.HookBuilder):
+      def create_hooks(self, model, model_dir):
+        return [hooks_lib.ExportHook(
+            export_generator=export_lib.DefaultExportGenerator(),
+            async_export=True)]
+
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=20,
+        checkpoint_every_n_steps=10,
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        mesh_shape=(1, 1, 1),
+        hook_builders=[Builder()], log_every_n_steps=10)
+    exports = glob.glob(os.path.join(model_dir, "export", "*"))
+    assert exports, "async export produced no bundles"
