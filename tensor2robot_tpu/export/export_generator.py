@@ -183,6 +183,49 @@ class DefaultExportGenerator(AbstractExportGenerator):
     module = tf.Module()
     module.fn = tf.function(tf_fn, input_signature=signature_inputs,
                             autograph=False)
+
+    # tf_example receiver: serialized Example protos in, TF-side parse
+    # generated from the specs (reference tf_example serving receiver,
+    # default_export_generator.py:99-133).
+    feature_description = {}
+    for k in keys:
+      spec = flat_spec[k]
+      name = spec.name or k
+      if spec.is_image:
+        feature_description[name] = tf.io.FixedLenFeature([], tf.string)
+      elif np.issubdtype(np.dtype(spec.dtype), np.integer):
+        feature_description[name] = tf.io.FixedLenFeature(
+            [int(np.prod(spec.shape, dtype=np.int64))], tf.int64)
+      else:
+        feature_description[name] = tf.io.FixedLenFeature(
+            [int(np.prod(spec.shape, dtype=np.int64))], tf.float32)
+
+    def tf_example_fn(serialized):
+      parsed = tf.io.parse_example(serialized, feature_description)
+      arrays = []
+      for k in keys:
+        spec = flat_spec[k]
+        name = spec.name or k
+        value = parsed[name]
+        if spec.is_image:
+          value = tf.map_fn(
+              lambda b, s=spec: tf.io.decode_image(
+                  b, channels=s.shape[-1], expand_animations=False),
+              value, fn_output_signature=tf.uint8)
+          value = tf.reshape(value, [-1] + [int(d) for d in spec.shape])
+        else:
+          target = np.dtype(spec.dtype).name
+          value = tf.reshape(value, [-1] + [int(d) for d in spec.shape])
+          if value.dtype != tf.dtypes.as_dtype(target):
+            value = tf.cast(value, tf.dtypes.as_dtype(target))
+        arrays.append(value)
+      return module.fn(*arrays)
+
+    module.tf_example_fn = tf.function(
+        tf_example_fn,
+        input_signature=[tf.TensorSpec([None], tf.string,
+                                       name="input_example_tensor")],
+        autograph=False)
     tf.saved_model.save(module, saved_model_dir)
 
 

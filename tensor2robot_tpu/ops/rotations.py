@@ -1,0 +1,102 @@
+"""Rotation representations: quaternion / axis-angle / matrix conversions.
+
+The reference leans on tensorflow_graphics for quaternion math in BC-Z
+(/root/reference/research/bcz/model.py:32 imports tensorflow_graphics;
+pose components use axis-angle and quaternion forms). That dependency is
+unavailable here, so the needed closed forms are implemented directly in
+jnp — batched, branch-free where possible, jit/grad-safe.
+
+Conventions: quaternions are [..., 4] in (w, x, y, z) order, normalized;
+axis-angle is [..., 3] with angle encoded as the vector norm.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quaternion_normalize", "quaternion_multiply",
+           "quaternion_conjugate", "quaternion_rotate",
+           "quaternion_to_axis_angle", "axis_angle_to_quaternion",
+           "quaternion_to_rotation_matrix", "geodesic_distance"]
+
+_EPS = 1e-8
+
+
+def quaternion_normalize(q: jnp.ndarray) -> jnp.ndarray:
+  return q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), _EPS)
+
+
+def quaternion_multiply(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+  aw, ax, ay, az = jnp.split(a, 4, axis=-1)
+  bw, bx, by, bz = jnp.split(b, 4, axis=-1)
+  return jnp.concatenate([
+      aw * bw - ax * bx - ay * by - az * bz,
+      aw * bx + ax * bw + ay * bz - az * by,
+      aw * by - ax * bz + ay * bw + az * bx,
+      aw * bz + ax * by - ay * bx + az * bw,
+  ], axis=-1)
+
+
+def quaternion_conjugate(q: jnp.ndarray) -> jnp.ndarray:
+  return q * jnp.asarray([1.0, -1.0, -1.0, -1.0], q.dtype)
+
+
+def quaternion_rotate(q: jnp.ndarray, v: jnp.ndarray) -> jnp.ndarray:
+  """Rotates vectors [..., 3] by quaternions [..., 4]."""
+  zeros = jnp.zeros_like(v[..., :1])
+  qv = jnp.concatenate([zeros, v], axis=-1)
+  return quaternion_multiply(
+      quaternion_multiply(q, qv), quaternion_conjugate(q))[..., 1:]
+
+
+def axis_angle_to_quaternion(axis_angle: jnp.ndarray) -> jnp.ndarray:
+  # Safe norm: sqrt of a clamped sum keeps gradients finite at zero
+  # (plain jnp.linalg.norm has a NaN gradient at 0).
+  sq = (axis_angle ** 2).sum(-1, keepdims=True)
+  angle = jnp.sqrt(jnp.maximum(sq, _EPS ** 2))
+  half = 0.5 * angle
+  small = sq < 1e-12
+  # Double-where so the untaken branch contributes no NaN gradients.
+  safe_angle = jnp.where(small, 1.0, angle)
+  sinc_half = jnp.where(small, 0.5 - sq / 48.0,
+                        jnp.sin(0.5 * safe_angle) / safe_angle)
+  w = jnp.cos(half)
+  xyz = axis_angle * sinc_half
+  return jnp.concatenate([w, xyz], axis=-1)
+
+
+def quaternion_to_axis_angle(q: jnp.ndarray) -> jnp.ndarray:
+  q = quaternion_normalize(q)
+  # Force w >= 0 so the angle is in [0, pi] (shortest arc).
+  q = jnp.where(q[..., :1] < 0, -q, q)
+  w = jnp.clip(q[..., :1], -1.0, 1.0)
+  xyz = q[..., 1:]
+  sin_half = jnp.linalg.norm(xyz, axis=-1, keepdims=True)
+  angle = 2.0 * jnp.arctan2(sin_half, w)
+  small = sin_half < 1e-6
+  scale = jnp.where(small, 2.0, angle / jnp.maximum(sin_half, _EPS))
+  return xyz * scale
+
+
+def quaternion_to_rotation_matrix(q: jnp.ndarray) -> jnp.ndarray:
+  q = quaternion_normalize(q)
+  w, x, y, z = jnp.split(q, 4, axis=-1)
+  row0 = jnp.concatenate([1 - 2 * (y ** 2 + z ** 2),
+                          2 * (x * y - w * z),
+                          2 * (x * z + w * y)], axis=-1)
+  row1 = jnp.concatenate([2 * (x * y + w * z),
+                          1 - 2 * (x ** 2 + z ** 2),
+                          2 * (y * z - w * x)], axis=-1)
+  row2 = jnp.concatenate([2 * (x * z - w * y),
+                          2 * (y * z + w * x),
+                          1 - 2 * (x ** 2 + y ** 2)], axis=-1)
+  return jnp.stack([row0, row1, row2], axis=-2)
+
+
+def geodesic_distance(q1: jnp.ndarray, q2: jnp.ndarray) -> jnp.ndarray:
+  """Angle of the relative rotation — the natural orientation loss."""
+  q1 = quaternion_normalize(q1)
+  q2 = quaternion_normalize(q2)
+  dot = jnp.abs((q1 * q2).sum(-1))
+  return 2.0 * jnp.arccos(jnp.clip(dot, 0.0, 1.0))

@@ -1,0 +1,113 @@
+"""Tests for rotation ops and the tf_example SavedModel receiver."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.ops import rotations
+
+
+class TestRotations:
+
+  def _random_q(self, n=8, seed=0):
+    q = jax.random.normal(jax.random.PRNGKey(seed), (n, 4))
+    return rotations.quaternion_normalize(q)
+
+  def test_normalize(self):
+    q = self._random_q()
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(q), axis=-1), 1.0,
+                               atol=1e-6)
+
+  def test_identity_rotation(self):
+    identity = jnp.array([[1.0, 0, 0, 0]])
+    v = jnp.array([[1.0, 2.0, 3.0]])
+    np.testing.assert_allclose(
+        np.asarray(rotations.quaternion_rotate(identity, v)),
+        np.asarray(v), atol=1e-6)
+
+  def test_z_axis_90deg(self):
+    half = np.pi / 4
+    q = jnp.array([[np.cos(half), 0, 0, np.sin(half)]])  # 90° about z
+    v = jnp.array([[1.0, 0.0, 0.0]])
+    out = rotations.quaternion_rotate(q, v)
+    np.testing.assert_allclose(np.asarray(out), [[0.0, 1.0, 0.0]],
+                               atol=1e-6)
+
+  def test_axis_angle_roundtrip(self):
+    aa = jax.random.normal(jax.random.PRNGKey(1), (16, 3)) * 0.8
+    q = rotations.axis_angle_to_quaternion(aa)
+    back = rotations.quaternion_to_axis_angle(q)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(aa), atol=1e-5)
+
+  def test_small_angle_stability(self):
+    aa = jnp.array([[1e-9, 0, 0], [0.0, 0, 0]])
+    q = rotations.axis_angle_to_quaternion(aa)
+    assert np.isfinite(np.asarray(q)).all()
+    back = rotations.quaternion_to_axis_angle(q)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(aa), atol=1e-7)
+    # gradients stay finite at zero rotation
+    g = jax.grad(lambda a: rotations.axis_angle_to_quaternion(a).sum())(
+        jnp.zeros(3))
+    assert np.isfinite(np.asarray(g)).all()
+
+  def test_rotation_matrix_orthonormal(self):
+    q = self._random_q()
+    R = rotations.quaternion_to_rotation_matrix(q)
+    eye = np.einsum("bij,bkj->bik", np.asarray(R), np.asarray(R))
+    np.testing.assert_allclose(eye, np.tile(np.eye(3), (8, 1, 1)),
+                               atol=1e-5)
+    np.testing.assert_allclose(np.linalg.det(np.asarray(R)), 1.0,
+                               atol=1e-5)
+
+  def test_matrix_matches_quaternion_rotate(self):
+    q = self._random_q(4)
+    v = jax.random.normal(jax.random.PRNGKey(2), (4, 3))
+    via_q = rotations.quaternion_rotate(q, v)
+    via_m = jnp.einsum("bij,bj->bi",
+                       rotations.quaternion_to_rotation_matrix(q), v)
+    np.testing.assert_allclose(np.asarray(via_q), np.asarray(via_m),
+                               atol=1e-5)
+
+  def test_geodesic_distance(self):
+    q = self._random_q(4)
+    np.testing.assert_allclose(
+        np.asarray(rotations.geodesic_distance(q, q)), 0.0, atol=1e-3)
+    np.testing.assert_allclose(
+        np.asarray(rotations.geodesic_distance(q, -q)), 0.0, atol=1e-3)
+
+
+class TestTfExampleReceiver:
+
+  def test_saved_model_tf_example_signature(self, tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu import train_eval
+    from tensor2robot_tpu.data import codec
+    from tensor2robot_tpu.export import export_generator as export_lib
+    from tensor2robot_tpu.utils import config, mocks
+
+    config.clear_config()
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        export_generators=[export_lib.DefaultExportGenerator(
+            write_saved_model=True)],
+        log_every_n_steps=10)
+    import glob
+
+    bundles = sorted(glob.glob(os.path.join(model_dir, "export", "*")))
+    module = tf.saved_model.load(os.path.join(bundles[-1], "saved_model"))
+    record = codec.encode_example(
+        {"measured_position": np.array([0.5, -0.5, 0.1], np.float32)}, None)
+    out = module.tf_example_fn(tf.constant([record, record]))
+    assert out["prediction"].shape == (2, 1)
+    # agrees with the dense-feed signature
+    dense = module.fn(tf.constant([[0.5, -0.5, 0.1]], tf.float32))
+    np.testing.assert_allclose(out["prediction"].numpy()[0],
+                               dense["prediction"].numpy()[0], atol=1e-6)
+    config.clear_config()
