@@ -107,6 +107,55 @@ class CheckpointManager:
     self.close()
 
 
+def warm_start_params(params, checkpoint_path: str,
+                      filter_fn=None,
+                      strict: bool = False):
+  """Partial restore from a foreign checkpoint into freshly-init params.
+
+  The reference's warm-start machinery: `default_init_from_checkpoint_fn`
+  partial restore (/root/reference/models/abstract_model.py:86-126) and
+  ResNet-pretrain init (/root/reference/layers/resnet.py:213-232). Leaves
+  whose flattened path exists in the checkpoint with a matching shape are
+  replaced; everything else keeps its fresh init. `filter_fn(path)` can
+  deny-list leaves (e.g. heads). Returns (merged_params, restored_paths).
+  """
+  import jax
+  import numpy as np
+
+  with ocp.StandardCheckpointer() as checkpointer:
+    restored = checkpointer.restore(os.path.abspath(checkpoint_path))
+  # Accept either a bare params tree, an export-bundle variables dict, or
+  # a full TrainState tree.
+  if isinstance(restored, dict):
+    if "params" in restored:
+      restored = restored["params"]
+  flat_restored = {
+      jax.tree_util.keystr(path): leaf
+      for path, leaf in jax.tree_util.tree_leaves_with_path(restored)}
+
+  restored_paths = []
+
+  def _merge(path, leaf):
+    key = jax.tree_util.keystr(path)
+    if filter_fn is not None and not filter_fn(key):
+      return leaf
+    candidate = flat_restored.get(key)
+    if candidate is None or tuple(np.shape(candidate)) != tuple(
+        np.shape(leaf)):
+      if strict and candidate is None:
+        raise ValueError(f"warm start: {key!r} missing from checkpoint")
+      return leaf
+    restored_paths.append(key)
+    return np.asarray(candidate).astype(leaf.dtype)
+
+  merged = jax.tree_util.tree_map_with_path(_merge, params)
+  if not restored_paths:
+    raise ValueError(
+        f"Warm start from {checkpoint_path} restored nothing; checkpoint "
+        f"keys: {sorted(flat_restored)[:10]}...")
+  return merged, restored_paths
+
+
 def latest_step(directory: str) -> Optional[int]:
   """Latest checkpoint step in a directory, without holding a manager."""
   if not os.path.isdir(directory):

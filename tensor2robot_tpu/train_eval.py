@@ -172,6 +172,16 @@ def train_eval_model(
       model, jax.random.PRNGKey(seed), sample_features, mesh=mesh,
       rules=partition_rules)
   restored_step = manager.latest_step()
+  if restored_step is None and model.init_checkpoint:
+    # Warm start from a foreign checkpoint (pretrained towers etc.);
+    # only on fresh runs — a resume keeps its own weights.
+    merged, restored_paths = checkpoints_lib.warm_start_params(
+        jax.device_get(state.params), model.init_checkpoint,
+        filter_fn=model.init_checkpoint_filter)
+    state = state.replace(params=jax.device_put(
+        merged, jax.tree_util.tree_map(lambda x: x.sharding, state.params)))
+    logging.info("Warm-started %d param arrays from %s",
+                 len(restored_paths), model.init_checkpoint)
   if restored_step is not None:
     abstract = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,

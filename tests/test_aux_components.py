@@ -194,3 +194,59 @@ class TestBestAndAsyncExport:
         hook_builders=[Builder()], log_every_n_steps=10)
     exports = glob.glob(os.path.join(model_dir, "export", "*"))
     assert exports, "async export produced no bundles"
+
+
+class TestWarmStart:
+
+  def test_partial_restore_from_foreign_checkpoint(self, tmp_path):
+    from tensor2robot_tpu import checkpoints as checkpoints_lib
+
+    # Train a source model and locate its checkpoint params.
+    src_dir = str(tmp_path / "src")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=src_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=10)
+    ckpt = os.path.join(src_dir, "checkpoints", "10")
+    # orbax StandardSave layout: <step>/default holds the state tree
+    candidates = [os.path.join(ckpt, d) for d in os.listdir(ckpt)]
+    state_dir = next(p for p in candidates if os.path.isdir(p))
+
+    # Warm start a fresh model from it; deny-list the head.
+    import jax
+
+    model = mocks.MockT2RModel(device_type="cpu")
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    x, y = mocks.make_separable_data(4)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(7),
+                                     {"x": x})
+    merged, restored = checkpoints_lib.warm_start_params(
+        jax.device_get(state.params), state_dir,
+        filter_fn=lambda path: "head" not in path)
+    assert restored, "nothing restored"
+    assert all("head" not in p for p in restored)
+    assert any("dense_0" in p for p in restored)
+
+  def test_model_init_checkpoint_in_trainer(self, tmp_path):
+    src_dir = str(tmp_path / "src")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=src_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=10)
+    ckpt = os.path.join(src_dir, "checkpoints", "10")
+    state_dir = next(os.path.join(ckpt, d) for d in os.listdir(ckpt)
+                     if os.path.isdir(os.path.join(ckpt, d)))
+    dst_dir = str(tmp_path / "dst")
+    metrics = train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu",
+                                 init_checkpoint=state_dir),
+        model_dir=dst_dir, mode="train", max_train_steps=5,
+        checkpoint_every_n_steps=5, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=5)
+    assert metrics
