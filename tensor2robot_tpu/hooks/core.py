@@ -29,7 +29,7 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["Hook", "HookBuilder", "ConfigSaverHook", "GoldenValuesHook",
            "VariableLoggerHook", "ExportHook", "DefaultHookBuilder",
-           "AsyncExportHookBuilder", "add_golden_outputs"]
+           "AsyncExportHookBuilder", "BestExportHook", "add_golden_outputs"]
 
 
 class TrainContext:

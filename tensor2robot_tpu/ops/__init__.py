@@ -1,0 +1,1 @@
+from tensor2robot_tpu.ops import attention, cem, pcgrad, rotations

@@ -72,3 +72,35 @@ def test_config_smoke_trains(config_path, tmp_path):
   metrics = train_eval.train_eval_model()
   assert metrics, f"no metrics from {config_path}"
   assert_output_files(model_dir, expect_operative_config=False)
+
+
+def test_config_runs_in_fresh_process(tmp_path):
+  """Guards against configs that only work due to test-process import
+  pollution: the trainer CLI must self-register every configurable."""
+  import subprocess
+  import sys
+
+  model_dir = str(tmp_path / "fresh")
+  code = f"""
+import jax; jax.config.update('jax_platforms', 'cpu')
+import sys
+sys.argv = ['t',
+  '--config_files', {ALL_CONFIGS[0]!r},
+  '--config', "train_eval_model.model_dir = {model_dir!r}",
+  '--config', 'train_eval_model.max_train_steps = 2',
+  '--config', 'train_eval_model.eval_steps = 1',
+  '--config', 'train_eval_model.eval_every_n_steps = 2',
+  '--config', 'train_eval_model.checkpoint_every_n_steps = 2',
+  '--config', 'train_eval_model.log_every_n_steps = 1',
+  '--config', 'train_eval_model.mesh_shape = (1, 1, 1)',
+  '--config', 'DefaultRandomInputGenerator.batch_size = 2']
+from absl import app
+from tensor2robot_tpu.bin import run_t2r_trainer
+app.run(run_t2r_trainer.main)
+"""
+  result = subprocess.run(
+      [sys.executable, "-c", code], capture_output=True, text=True,
+      timeout=240, env={**os.environ, "PYTHONPATH": REPO_ROOT,
+                        "JAX_PLATFORMS": "cpu"})
+  assert result.returncode == 0, result.stderr[-2000:]
+  assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
