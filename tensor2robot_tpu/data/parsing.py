@@ -195,7 +195,9 @@ class ParseFn:
       spec = plan.spec
       if spec.is_optional or spec.varlen_default_value is not None:
         return None
-      if spec.is_image and not spec.is_extracted:
+      if spec.is_extracted:
+        return None  # raw-bytes tensor planes: python path
+      if spec.is_image:
         native_plan.append((plan.feature_name, 2, 0, False))  # KIND_BYTES
         continue
       if any(d is None for d in spec.shape):

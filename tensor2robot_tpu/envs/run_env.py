@@ -47,6 +47,7 @@ def run_env(env=config.REQUIRED,
                   else 0.0)
   episode_rewards: List[float] = []
   episode_lengths: List[int] = []
+  q_values: List[float] = []
   for episode_idx in range(num_episodes):
     policy.reset()
     obs, _ = env.reset()
@@ -54,6 +55,9 @@ def run_env(env=config.REQUIRED,
     total_reward, steps, done = 0.0, 0, False
     while not done:
       action = policy.sample_action(obs, explore_prob=explore_prob)
+      q = getattr(policy, "last_q_value", None)
+      if q is not None:
+        q_values.append(float(q))
       next_obs, reward, terminated, truncated, info = env.step(action)
       episode.append({"obs": obs, "action": action, "reward": reward,
                       "done": terminated or truncated, "info": info})
@@ -72,6 +76,8 @@ def run_env(env=config.REQUIRED,
       f"{tag}/episode_length_mean": float(np.mean(episode_lengths)),
       f"{tag}/explore_prob": float(explore_prob),
   }
+  if q_values:
+    stats[f"{tag}/q_value_mean"] = float(np.mean(q_values))
   if root_dir is not None:
     writer = summaries_lib.SummaryWriter(os.path.join(root_dir, tag),
                                          use_tensorboard=False)

@@ -104,8 +104,11 @@ class CEMPolicy(Policy):
       return np.random.uniform(self._low, self._high).astype(np.float32)
     mean = (self._low + self._high) / 2.0
     stddev = (self._high - self._low) / 2.0
-    action, _ = self._cem.optimize(self._objective(obs), mean, stddev,
-                                   low=self._low, high=self._high)
+    action, score = self._cem.optimize(self._objective(obs), mean, stddev,
+                                       low=self._low, high=self._high)
+    # Exposed for actor-side Q-value summaries (reference run_env logs
+    # the served Q alongside rewards).
+    self.last_q_value = score
     return action
 
 

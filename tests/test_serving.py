@@ -264,3 +264,49 @@ class TestPolicies:
       policy.reset()
       seen.add(float(policy.select_action({})[0]))
     assert seen == {0.0, 1.0}
+
+
+class _FakeRecurrentCritic(predictors_lib.AbstractPredictor):
+  """Echoes a hidden state that increments per call."""
+
+  def __init__(self):
+    self._counter = 0
+
+  def predict(self, features):
+    n = features["action/action"].shape[0]
+    hidden_in = features.get("state/hidden_state")
+    base = 0.0 if hidden_in is None else float(hidden_in[0, 0])
+    q = -np.abs(features["action/action"]).sum(-1, keepdims=True) + base
+    self._counter += 1
+    return {"q_predicted": q,
+            "hidden_state": np.full((n, 1), self._counter, np.float32)}
+
+  def get_feature_specification(self):
+    return None
+
+  def restore(self):
+    return True
+
+
+class TestLSTMCEMPolicy:
+
+  def test_hidden_state_threads_between_steps(self):
+    policy = policies_lib.LSTMCEMPolicy(
+        predictor=_FakeRecurrentCritic(), action_size=2, cem_samples=16,
+        cem_iterations=2, cem_elites=4, seed=0)
+    obs = {"obs": np.zeros(3, np.float32)}
+    policy.reset()
+    assert policy._hidden_state is None
+    policy.select_action(obs)
+    first = policy._hidden_state.copy()
+    assert first is not None
+    policy.select_action(obs)
+    assert policy._hidden_state[0, 0] > first[0, 0]
+    policy.reset()
+    assert policy._hidden_state is None
+
+  def test_cem_policy_exposes_q_value(self):
+    policy = policies_lib.CEMPolicy(
+        predictor=_FakeCriticPredictor(), action_size=2, seed=0)
+    policy.select_action({"obs": np.zeros(3, np.float32)})
+    assert np.isfinite(policy.last_q_value)
