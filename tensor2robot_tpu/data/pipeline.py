@@ -107,6 +107,30 @@ def _shuffled(stream: Iterator[Any], buffer_size: int,
   yield from buffer
 
 
+def parallel_map_ordered(fn: Callable[[Any], Any],
+                         stream: Iterator[Any],
+                         num_workers: int = 2,
+                         max_inflight: Optional[int] = None
+                         ) -> Iterator[Any]:
+  """Order-preserving parallel map with bounded in-flight work.
+
+  The parse stage scales across threads because the native parser and
+  image decode release the GIL (tf.data's parallel map equivalent for
+  this pipeline)."""
+  import collections
+  from concurrent.futures import ThreadPoolExecutor
+
+  max_inflight = max_inflight or 2 * num_workers
+  with ThreadPoolExecutor(num_workers) as pool:
+    futures: "collections.deque" = collections.deque()
+    for item in stream:
+      futures.append(pool.submit(fn, item))
+      while len(futures) >= max_inflight:
+        yield futures.popleft().result()
+    while futures:
+      yield futures.popleft().result()
+
+
 def prefetch(stream: Iterator[Any], size: int = 2) -> Iterator[Any]:
   """Background-thread prefetch (tf.data prefetch(AUTOTUNE) equivalent)."""
   q: "queue.Queue" = queue.Queue(maxsize=size)
@@ -156,6 +180,7 @@ class RecordBatchPipeline:
                preprocess_fn: Optional[PreprocessFn] = None,
                mixture_weights: Optional[Sequence[float]] = None,
                prefetch_size: int = 2,
+               num_parallel_parses: int = 2,
                process_index: int = 0,
                process_count: int = 1):
     self._parse_fn = parse_fn
@@ -170,6 +195,7 @@ class RecordBatchPipeline:
     self._preprocess_fn = preprocess_fn
     self._mixture_weights = mixture_weights
     self._prefetch_size = prefetch_size
+    self._num_parallel_parses = num_parallel_parses
     dataset_keys = parse_fn.dataset_keys
     if isinstance(file_patterns, Mapping):
       self._files = {
@@ -215,7 +241,7 @@ class RecordBatchPipeline:
         return
       yield item
 
-  def _batches(self) -> Iterator[specs_lib.SpecStruct]:
+  def _raw_batches(self) -> Iterator[List[Dict[str, bytes]]]:
     epoch = 0
     while True:
       epoch_seed = None if self._seed is None else self._seed + epoch
@@ -226,13 +252,20 @@ class RecordBatchPipeline:
       for item in stream:
         batch.append(item)
         if len(batch) == self._batch_size:
-          yield self._finalize(batch)
+          yield batch
           batch = []
       if batch and not self._drop_remainder:
-        yield self._finalize(batch)
+        yield batch
       if not self._repeat:
         return
       epoch += 1
+
+  def _batches(self) -> Iterator[specs_lib.SpecStruct]:
+    raw = self._raw_batches()
+    if self._num_parallel_parses > 1:
+      return parallel_map_ordered(self._finalize, raw,
+                                  num_workers=self._num_parallel_parses)
+    return map(self._finalize, raw)
 
   def _finalize(self, batch: List[Dict[str, bytes]]) -> specs_lib.SpecStruct:
     records = {k: [item[k] for item in batch] for k in batch[0]}
