@@ -1,0 +1,83 @@
+"""Pipeline parallelism: GPipe-style fill/drain over a mesh axis.
+
+Beyond the reference (SURVEY.md §2.5: PP absent there). Stage parameters
+carry a leading [num_stages] dim sharded over the `pp` axis; microbatches
+flow through a `lax.scan` of compute+`ppermute` ticks, so activations hop
+stage-to-stage over ICI while every stage works on a different
+microbatch (the classic bubble is (S-1)/(M+S-1)). Differentiable: the
+scan/ppermute pair transposes cleanly, so the same function trains.
+
+The stage function must be shape-preserving stage-to-stage (classic
+homogeneous-block pipelining, e.g. transformer/MLP block stacks).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
+
+__all__ = ["pipelined_apply", "stack_stage_params"]
+
+
+def stack_stage_params(params_list):
+  """Stacks per-stage param pytrees into leading-[S] arrays (the layout
+  `pp` sharding expects)."""
+  return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipelined_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+                    stage_params: Any,
+                    microbatches: jnp.ndarray,
+                    mesh: Mesh,
+                    axis_name: str = "pp") -> jnp.ndarray:
+  """Runs microbatches through a pipeline of stages.
+
+  Args:
+    stage_fn: (one stage's params, activation [mb, ...]) -> activation of
+      the same shape.
+    stage_params: pytree with leading [num_stages] dim on every leaf;
+      sharded over `axis_name`.
+    microbatches: [num_microbatches, mb, ...] global input (replicated
+      over the pp axis).
+    mesh: mesh containing `axis_name` with size == num_stages.
+
+  Returns:
+    [num_microbatches, mb, ...] outputs (replicated over the pp axis).
+  """
+  num_stages = mesh.shape[axis_name]
+  num_micro = microbatches.shape[0]
+  total_ticks = num_micro + num_stages - 1
+
+  params_spec = PartitionSpec(axis_name)
+  replicated = PartitionSpec()
+
+  def local_fn(local_params, micro):
+    # local_params leaves: [1, ...] (this device's stage); squeeze.
+    my_params = jax.tree_util.tree_map(lambda x: x[0], local_params)
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def tick(carry, t):
+      incoming = carry
+      inject = micro[jnp.clip(t, 0, num_micro - 1)]
+      x = jnp.where(idx == 0, inject, incoming)
+      y = stage_fn(my_params, x)
+      shifted = jax.lax.ppermute(y, axis_name, perm)
+      return shifted, y
+
+    zeros = jnp.zeros_like(micro[0])
+    _, ys = jax.lax.scan(tick, zeros, jnp.arange(total_ticks))
+    # The last stage's outputs at ticks [S-1, T) are the results for
+    # microbatches [0, M). Broadcast them to every pp rank via psum.
+    outs = ys[num_stages - 1:]
+    outs = jnp.where(idx == num_stages - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+  return jax.shard_map(
+      local_fn, mesh=mesh,
+      in_specs=(params_spec, replicated),
+      out_specs=replicated,
+      check_vma=False)(stage_params, microbatches)
