@@ -1,0 +1,103 @@
+"""On-device CEM serving: the whole argmax_a Q(s, a) loop under one jit.
+
+The reference's CEM serving runs numpy on the robot workstation, calling
+the TF session once per CEM iteration
+(/root/reference/policies/policies.py:133-184). Here the sampling loop,
+candidate scoring and elite refitting all live inside a single jitted
+function (`ops.cem.cross_entropy_method` + the critic forward), so action
+selection is one device round-trip — the candidate batch rides the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu.ops import cem as cem_lib
+from tensor2robot_tpu.policies import policies as policies_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["make_device_cem_fn", "DeviceCEMPolicy"]
+
+
+def make_device_cem_fn(model,
+                       action_size: int,
+                       cem_samples: int = 64,
+                       cem_iterations: int = 3,
+                       cem_elites: int = 10,
+                       action_low: float = -1.0,
+                       action_high: float = 1.0,
+                       q_key: str = "q_predicted") -> Callable:
+  """Builds jit(select)(state, obs_tree, rng) -> (action, q).
+
+  `obs_tree` holds one observation (unbatched state features, keys
+  without the 'state/' prefix).
+  """
+  low = jnp.full((action_size,), action_low)
+  high = jnp.full((action_size,), action_high)
+
+  @jax.jit
+  def select(state, obs_tree, rng):
+    def objective(actions):  # [num_samples, action_size]
+      features = {f"state/{k}": jnp.repeat(v[None], cem_samples, axis=0)
+                  for k, v in obs_tree.items()}
+      features["action/action"] = actions
+      variables = {"params": state.eval_params(use_ema=True),
+                   **state.mutable_state}
+      compute = model.cast_features_for_compute(features)
+      outputs, _ = model.inference_network_fn(
+          variables, compute, modes_lib.PREDICT, train=False)
+      return outputs[q_key].astype(jnp.float32).reshape(-1)
+
+    best, score, _ = cem_lib.cross_entropy_method(
+        rng, objective, mean=(low + high) / 2.0,
+        stddev=(high - low) / 2.0,
+        num_samples=cem_samples, num_iterations=cem_iterations,
+        num_elites=cem_elites, low=low, high=high)
+    return best, score
+
+  return select
+
+
+@config.configurable
+class DeviceCEMPolicy(policies_lib.Policy):
+  """Policy wrapper over the jitted device CEM (state held on device)."""
+
+  def __init__(self, model=None, state=None, action_size: int = None,
+               cem_samples: int = 64, cem_iterations: int = 3,
+               cem_elites: int = 10, seed: int = 0, **kwargs):
+    super().__init__()
+    if model is None or action_size is None:
+      raise ValueError("model and action_size are required.")
+    self._model = model
+    self._state = state
+    self._select = make_device_cem_fn(
+        model, action_size, cem_samples=cem_samples,
+        cem_iterations=cem_iterations, cem_elites=cem_elites, **kwargs)
+    self._rng = jax.random.PRNGKey(seed)
+
+  def set_state(self, state) -> None:
+    """Hot-swaps the served train state (e.g. from a checkpoint poll)."""
+    self._state = state
+
+  def restore(self) -> bool:
+    return self._state is not None
+
+  @property
+  def global_step(self) -> int:
+    if self._state is None:
+      return -1
+    return int(self._state.step)
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    if self._state is None:
+      raise ValueError("No state set; call set_state() first.")
+    self._rng, key = jax.random.split(self._rng)
+    obs_tree = {k: jnp.asarray(v) for k, v in dict(obs).items()}
+    action, score = self._select(self._state, obs_tree, key)
+    self.last_q_value = float(score)
+    return np.asarray(action)

@@ -310,3 +310,31 @@ class TestLSTMCEMPolicy:
         predictor=_FakeCriticPredictor(), action_size=2, seed=0)
     policy.select_action({"obs": np.zeros(3, np.float32)})
     assert np.isfinite(policy.last_q_value)
+
+
+class TestDeviceCEMPolicy:
+
+  def test_on_device_cem_beats_random_on_trained_critic(self, tmp_path):
+    import jax
+
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.policies import device_cem
+    from tensor2robot_tpu.research.pose_env import models as pose_models
+    from tensor2robot_tpu import specs as specs_lib, modes
+
+    model = pose_models.PoseEnvContinuousMCModel(device_type="cpu")
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification(modes.TRAIN), batch_size=4, seed=0)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    policy = device_cem.DeviceCEMPolicy(
+        model=model, state=state, action_size=2, cem_samples=32,
+        cem_iterations=2, cem_elites=8)
+    assert policy.restore()
+    obs = {"image": np.zeros((32, 32, 1), np.uint8)}
+    action = policy.select_action(obs)
+    assert action.shape == (2,)
+    assert np.isfinite(policy.last_q_value)
+    # deterministic state hot-swap works
+    policy.set_state(state)
+    action2 = policy.select_action(obs)
+    assert action2.shape == (2,)
