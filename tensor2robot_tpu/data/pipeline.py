@@ -263,13 +263,20 @@ class RecordBatchPipeline:
   def _batches(self) -> Iterator[specs_lib.SpecStruct]:
     raw = self._raw_batches()
     if self._num_parallel_parses > 1:
-      return parallel_map_ordered(self._finalize, raw,
-                                  num_workers=self._num_parallel_parses)
+      # Parse in parallel; preprocess serially in consumption order so
+      # stateful/seeded preprocessors keep deterministic behavior.
+      parsed = parallel_map_ordered(self._parse_only, raw,
+                                    num_workers=self._num_parallel_parses)
+      return map(self._apply_preprocess, parsed)
     return map(self._finalize, raw)
 
-  def _finalize(self, batch: List[Dict[str, bytes]]) -> specs_lib.SpecStruct:
+  def _parse_only(self, batch: List[Dict[str, bytes]]
+                  ) -> specs_lib.SpecStruct:
     records = {k: [item[k] for item in batch] for k in batch[0]}
-    parsed = self._parse_fn.parse_batch(records)
+    return self._parse_fn.parse_batch(records)
+
+  def _apply_preprocess(self, parsed: specs_lib.SpecStruct
+                        ) -> specs_lib.SpecStruct:
     features = parsed["features"] if "features" in parsed \
         else specs_lib.SpecStruct()
     labels = parsed["labels"] if "labels" in parsed else specs_lib.SpecStruct()
@@ -282,6 +289,9 @@ class RecordBatchPipeline:
     if len(labels):
       out["labels"] = labels
     return out
+
+  def _finalize(self, batch: List[Dict[str, bytes]]) -> specs_lib.SpecStruct:
+    return self._apply_preprocess(self._parse_only(batch))
 
   def __iter__(self) -> Iterator[specs_lib.SpecStruct]:
     stream = self._batches()

@@ -234,16 +234,18 @@ class AsyncExportHookBuilder(HookBuilder):
   async_export_hook_builder.py:87-134)."""
 
   def __init__(self, export_generator=None, num_versions: int = 3,
-               lagged: bool = False):
+               lagged: bool = False, async_export: bool = True):
     self._export_generator = export_generator
     self._num_versions = num_versions
     self._lagged = lagged
+    self._async_export = async_export
 
   def create_hooks(self, model, model_dir):
     return [ExportHook(
         export_generator=self._export_generator,
         num_versions=self._num_versions,
-        lagged_export_dir_name="lagged_export" if self._lagged else None)]
+        lagged_export_dir_name="lagged_export" if self._lagged else None,
+        async_export=self._async_export)]
 
 
 @config.configurable
@@ -283,7 +285,9 @@ class BestExportHook(Hook):
     import json
 
     value = float(np.asarray(metrics[self._metric_key]))
-    improved = (self._best is None
+    if not np.isfinite(value):
+      return  # a NaN baseline would lock out every future export
+    improved = (self._best is None or not np.isfinite(self._best)
                 or (value > self._best if self._higher
                     else value < self._best))
     if not improved:

@@ -148,6 +148,9 @@ class BatchExampleParser:
     if lib is None:
       raise RuntimeError("native library unavailable")
     self._lib = lib
+    # The C++ Plan handle stores per-call results (bytes ptr/len
+    # vectors), so concurrent parse() calls on one parser must serialize.
+    self._parse_lock = threading.Lock()
     self._plan = list(plan)
     n = len(self._plan)
     names = (ctypes.c_char_p * n)(
@@ -165,6 +168,10 @@ class BatchExampleParser:
       self._handle = None
 
   def parse(self, records):
+    with self._parse_lock:
+      return self._parse_locked(records)
+
+  def _parse_locked(self, records):
     np = self._np
     batch = len(records)
     n = len(self._plan)

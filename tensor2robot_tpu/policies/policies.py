@@ -101,6 +101,8 @@ class CEMPolicy(Policy):
 
   def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
     if explore_prob > 0.0 and np.random.rand() < explore_prob:
+      self.last_q_value = None  # no Q for random actions (keeps
+      # actor-side Q summaries unbiased by stale greedy scores)
       return np.random.uniform(self._low, self._high).astype(np.float32)
     mean = (self._low + self._high) / 2.0
     stddev = (self._high - self._low) / 2.0
