@@ -1,0 +1,68 @@
+"""Export CLI: produce a serving bundle from a training checkpoint.
+
+Counterpart of driving estimator.export_saved_model by hand (reference
+export flow, SURVEY.md §3.2) without a training job.
+
+Usage:
+  python -m tensor2robot_tpu.bin.export_saved_model \
+      --config_files my_experiment.gin \
+      --config "export_checkpoint.model_dir = '/tmp/run1'" \
+      --config "export_checkpoint.export_dir = '/tmp/run1/export'"
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+from absl import app, flags, logging
+
+from tensor2robot_tpu import checkpoints as checkpoints_lib
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.export import export_generator as export_lib
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse.")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+
+
+@config.configurable
+def export_checkpoint(model=config.REQUIRED,
+                      model_dir: str = config.REQUIRED,
+                      export_dir: Optional[str] = None,
+                      checkpoint_step: Optional[int] = None,
+                      write_saved_model: bool = False) -> str:
+  """Restores a checkpoint and writes one export bundle; returns path."""
+  export_dir = export_dir or os.path.join(model_dir, "export")
+  feature_spec = model.preprocessor.get_out_feature_specification(
+      modes_lib.PREDICT)
+  sample = specs_lib.make_random_numpy(feature_spec, batch_size=1, seed=0)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), sample)
+  manager = checkpoints_lib.CheckpointManager(
+      os.path.join(model_dir, "checkpoints"))
+  abstract = jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+  state = manager.restore(checkpoint_step, abstract_state=abstract)
+  manager.close()
+  generator = export_lib.DefaultExportGenerator(
+      write_saved_model=write_saved_model)
+  generator.set_specification_from_model(model)
+  path = generator.export(state, export_dir, global_step=int(state.step))
+  logging.info("Exported %s (step %d)", path, int(state.step))
+  return path
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  export_checkpoint()
+
+
+if __name__ == "__main__":
+  app.run(main)

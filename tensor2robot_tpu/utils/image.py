@@ -1,0 +1,13 @@
+"""Image encoding helpers for replay writing (reference
+/root/reference/utils/image.py:24-49). Thin aliases over the data codec
+so actor-side code has the same import surface."""
+
+from tensor2robot_tpu.data.codec import (  # noqa: F401
+    decode_image,
+    decode_image_batch,
+    encode_image,
+    maybe_recompress_jpeg,
+)
+
+__all__ = ["encode_image", "decode_image", "decode_image_batch",
+           "maybe_recompress_jpeg"]

@@ -250,3 +250,22 @@ class TestWarmStart:
         input_generator_train=mocks.MockInputGenerator(batch_size=4),
         log_every_n_steps=5)
     assert metrics
+
+
+class TestExportCLI:
+
+  def test_export_checkpoint_function(self, tmp_path):
+    from tensor2robot_tpu.bin import export_saved_model
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=10)
+    path = export_saved_model.export_checkpoint(
+        model=mocks.MockT2RModel(device_type="cpu"), model_dir=model_dir)
+    assert os.path.isfile(os.path.join(path, "t2r_assets.json"))
+    sig = json.load(open(os.path.join(path, "signature.json")))
+    assert sig["global_step"] == 10
