@@ -200,6 +200,17 @@ class T2RModel(ModelInterface):
     """
     rngs = {"dropout": rng} if rng is not None else {}
     mutable = ["batch_stats"] if train else False
+    if self._use_bfloat16:
+      # Mixed precision: float32 master params, bfloat16 compute. Flax
+      # modules promote to the widest input dtype, so bf16 activations
+      # against f32 params would silently compute in f32 — cast the
+      # params down for the forward (XLA fuses the casts); gradients
+      # flow back through the cast to the f32 masters.
+      variables = dict(variables)
+      variables["params"] = jax.tree_util.tree_map(
+          lambda x: x.astype(jnp.bfloat16)
+          if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+          variables["params"])
     out = self.module.apply(variables, features, mode=mode, train=train,
                             rngs=rngs, mutable=mutable)
     if mutable:

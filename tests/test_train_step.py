@@ -190,3 +190,40 @@ class TestShardingRules:
     spec = ts._leaf_partition("dense/bias", (16,),
                               ((r".*", (None, "model")),), mesh)
     assert spec == PartitionSpec()
+
+
+class TestMixedPrecision:
+
+  def test_bfloat16_forward_actually_computes_in_bfloat16(self):
+    """f32 params + bf16 inputs must not silently promote back to f32
+    (flax's default dtype promotion would defeat the MXU bf16 path)."""
+    model = mocks.MockT2RModel(device_type="cpu", use_bfloat16=True,
+                               use_batch_norm=False)
+    features = {"x": np.zeros((2, 3), np.float32)}
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    compute_features = model.cast_features_for_compute(
+        jax.tree_util.tree_map(jnp.asarray, features))
+    assert compute_features["x"].dtype == jnp.bfloat16
+    variables = {"params": state.params, **state.mutable_state}
+    outputs, _ = model.inference_network_fn(
+        variables, compute_features, modes.TRAIN, train=False)
+    assert outputs["logit"].dtype == jnp.bfloat16
+    # master params stay float32
+    assert jax.tree_util.tree_leaves(state.params)[0].dtype == jnp.float32
+
+  def test_bfloat16_training_still_converges(self):
+    model = mocks.MockT2RModel(device_type="cpu", use_bfloat16=True,
+                               use_batch_norm=False)
+    gen = mocks.MockInputGenerator(batch_size=32)
+    gen.set_specification_from_model(model, modes.TRAIN)
+    dataset = gen.create_dataset(modes.TRAIN)
+    batch = next(dataset)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                     batch["features"])
+    step = ts.make_train_step(model)
+    first = None
+    for _ in range(150):
+      b = next(dataset)
+      state, metrics = step(state, b["features"], b["labels"])
+      first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first * 0.5
