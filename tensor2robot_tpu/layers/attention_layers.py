@@ -1,0 +1,59 @@
+"""Multi-head attention module over the fused/parallel attention ops.
+
+Model-facing wrapper for `ops/attention`: QKV/output projections as flax
+params, with the core score/softmax/combine delegated to the reference
+jnp implementation, the Pallas flash kernel, or ring attention over a
+sequence-parallel mesh axis — selected by a constructor argument so the
+same module scales from one chip to a long-context pod.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tensor2robot_tpu.ops import attention as attention_ops
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(nn.Module):
+  """[B, T, F] -> [B, T, F] self-attention (or cross via `kv`)."""
+
+  num_heads: int = 4
+  head_dim: int = 32
+  causal: bool = False
+  backend: str = "reference"  # 'reference' | 'flash' | 'ring'
+  mesh: Optional[Mesh] = None  # required for 'ring'
+  sp_axis: str = "sp"
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray,
+               kv: Optional[jnp.ndarray] = None,
+               train: bool = False) -> jnp.ndarray:
+    kv = x if kv is None else kv
+    b, t, _ = x.shape
+    proj = self.num_heads * self.head_dim
+    q = nn.Dense(proj, name="q_proj")(x)
+    k = nn.Dense(proj, name="k_proj")(kv)
+    v = nn.Dense(proj, name="v_proj")(kv)
+
+    def heads(y):
+      return y.reshape(b, -1, self.num_heads,
+                       self.head_dim).transpose(0, 2, 1, 3)
+
+    q, k, v = heads(q), heads(k), heads(v)  # [B, H, T, D]
+    if self.backend == "flash":
+      out = attention_ops.flash_attention(q, k, v, causal=self.causal)
+    elif self.backend == "ring":
+      if self.mesh is None:
+        raise ValueError("ring backend requires a mesh.")
+      out = attention_ops.ring_attention(
+          q, k, v, self.mesh, axis_name=self.sp_axis, causal=self.causal)
+    else:
+      out = attention_ops.attention(q, k, v, causal=self.causal)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, proj)
+    return nn.Dense(x.shape[-1], name="out_proj")(out)

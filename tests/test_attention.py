@@ -89,3 +89,33 @@ class TestRingAttention:
 
     g = jax.grad(loss)(q, k, v)
     assert np.isfinite(np.asarray(g)).all()
+
+
+class TestMultiHeadAttentionModule:
+
+  def test_backends_agree(self):
+    import flax.linen as nn
+
+    from tensor2robot_tpu.layers.attention_layers import MultiHeadAttention
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 12))
+    ref = MultiHeadAttention(num_heads=2, head_dim=8, causal=True)
+    variables = ref.init(jax.random.PRNGKey(1), x)
+    out_ref = ref.apply(variables, x)
+    sp_mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                   axis_names=("data", "sp", "model"))
+    ring = MultiHeadAttention(num_heads=2, head_dim=8, causal=True,
+                              backend="ring", mesh=sp_mesh)
+    out_ring = ring.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_ref),
+                               atol=2e-5)
+
+  def test_cross_attention_shape(self):
+    from tensor2robot_tpu.layers.attention_layers import MultiHeadAttention
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 12))
+    kv = jax.random.normal(jax.random.PRNGKey(1), (2, 9, 12))
+    module = MultiHeadAttention(num_heads=2, head_dim=8)
+    variables = module.init(jax.random.PRNGKey(2), x, kv)
+    out = module.apply(variables, x, kv)
+    assert out.shape == (2, 4, 12)
