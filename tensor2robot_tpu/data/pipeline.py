@@ -132,29 +132,47 @@ def parallel_map_ordered(fn: Callable[[Any], Any],
 
 
 def prefetch(stream: Iterator[Any], size: int = 2) -> Iterator[Any]:
-  """Background-thread prefetch (tf.data prefetch(AUTOTUNE) equivalent)."""
+  """Background-thread prefetch (tf.data prefetch(AUTOTUNE) equivalent).
+
+  The worker watches a stop event so an abandoned consumer (finished
+  eval round, dropped iterator) releases the thread and its upstream
+  file handles instead of blocking on a full queue forever."""
   q: "queue.Queue" = queue.Queue(maxsize=size)
   _END = object()
+  stop = threading.Event()
   error: List[BaseException] = []
+
+  def _put(item) -> bool:
+    while not stop.is_set():
+      try:
+        q.put(item, timeout=0.1)
+        return True
+      except queue.Full:
+        continue
+    return False
 
   def _worker():
     try:
       for item in stream:
-        q.put(item)
+        if not _put(item):
+          return
     except BaseException as e:  # propagate into consumer
       error.append(e)
     finally:
-      q.put(_END)
+      _put(_END)
 
   thread = threading.Thread(target=_worker, daemon=True)
   thread.start()
-  while True:
-    item = q.get()
-    if item is _END:
-      if error:
-        raise error[0]
-      return
-    yield item
+  try:
+    while True:
+      item = q.get()
+      if item is _END:
+        if error:
+          raise error[0]
+        return
+      yield item
+  finally:
+    stop.set()
 
 
 @config.configurable
