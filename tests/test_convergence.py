@@ -93,3 +93,42 @@ class TestVRGripperLearns:
         first = float(metrics["loss"])
     assert float(metrics["loss"]) < first * 0.5, (first,
                                                   float(metrics["loss"]))
+
+
+class TestBCZLearns:
+
+  def test_waypoints_track_visual_target(self):
+    """BC-Z must learn waypoints from a rendered target position."""
+    import optax
+
+    from tensor2robot_tpu.research.bcz import models as bcz_models
+
+    model = bcz_models.BCZModel(
+        image_size=24, num_waypoints=2,
+        components=(("xyz", 2, 1.0),), predict_stop=False,
+        network="spatial_softmax", device_type="cpu",
+        optimizer_fn=lambda: optax.adam(1e-3))
+    rng = np.random.RandomState(0)
+
+    def make_batch(n=16):
+      images = np.zeros((n, 24, 24, 3), np.float32)
+      targets = np.zeros((n, 2, 2), np.float32)
+      for i in range(n):
+        y, x = rng.randint(2, 22, 2)
+        images[i, y - 1:y + 2, x - 1:x + 2] = 1.0
+        pos = np.array([x / 24.0, y / 24.0], np.float32)
+        targets[i] = pos[None]
+      features = specs_lib.SpecStruct({"image": images})
+      labels = specs_lib.SpecStruct({"xyz": targets})
+      return features, labels
+
+    f0, l0 = make_batch()
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), f0)
+    step = ts.make_train_step(model)
+    first = None
+    for _ in range(150):
+      f, l = make_batch()
+      state, metrics = step(state, f, l)
+      first = first if first is not None else float(metrics["loss"])
+    assert float(metrics["loss"]) < first * 0.3, (first,
+                                                  float(metrics["loss"]))
