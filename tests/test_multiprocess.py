@@ -1,0 +1,53 @@
+"""Two-process jax.distributed smoke: global mesh + cross-host batch
+assembly + collective — the multi-host coordination path the reference
+delegated to TF_CONFIG clusters (SURVEY.md §2.5)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address="127.0.0.1:%d",
+                               num_processes=2,
+                               process_id=int(sys.argv[1]))
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+    mesh = mesh_lib.create_mesh()
+    assert jax.process_count() == 2
+    local = np.full((2, 3), jax.process_index(), np.float32)
+    batch = mesh_lib.put_host_batch(mesh, {"x": local})
+    total = jax.jit(lambda b: b["x"].sum(),
+                    out_shardings=NamedSharding(mesh, PartitionSpec()))(batch)
+    print(f"RESULT {float(total)} {jax.device_count()}")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_mesh_and_collective(tmp_path):
+  port = 9917
+  script = tmp_path / "worker.py"
+  script.write_text(_WORKER % port)
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+  procs = [subprocess.Popen([sys.executable, str(script), str(i)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, env=env)
+           for i in range(2)]
+  outputs = []
+  for p in procs:
+    out, _ = p.communicate(timeout=120)
+    outputs.append(out)
+    assert p.returncode == 0, out[-2000:]
+  for out in outputs:
+    # proc0 contributes 0*6, proc1 contributes 1*6 -> global sum 6
+    assert "RESULT 6.0 2" in out, out[-500:]
