@@ -102,6 +102,7 @@ def train_eval_model(
     max_train_steps: int = 1000,
     eval_steps: int = 100,
     eval_every_n_steps: int = 500,
+    eval_throttle_secs: float = 0.0,
     checkpoint_every_n_steps: int = 500,
     keep_checkpoints: int = 5,
     input_generator_train=None,
@@ -272,6 +273,7 @@ def train_eval_model(
   step = int(state.step)
   batch = first_batch
   last_log = time.time()
+  last_eval_time = 0.0
   while step < max_train_steps:
     features, labels = _device_batch(mesh, batch)
     state, metrics = train_step(state, features, labels)
@@ -297,15 +299,24 @@ def train_eval_model(
       raise SystemExit(42)
     if eval_step is not None and (step % eval_every_n_steps == 0
                                   or step == max_train_steps):
-      eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
-      eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                               eval_steps)
-      writer.write_scalars(step, {f"eval/{k}": v
-                                  for k, v in eval_metrics.items()})
-      for hook in hooks:
-        hook.after_eval(ctx, step, eval_metrics)
-      logging.info("eval @%d: %s", step, eval_metrics)
-      final_metrics.update({f"eval/{k}": v for k, v in eval_metrics.items()})
+      # Wall-clock throttle (reference eval_throttle default 600 s,
+      # /root/reference/utils/train_eval.py:428-431): skip step-triggered
+      # evals that come too soon after the previous one.
+      now = time.time()
+      throttled = (eval_throttle_secs and step != max_train_steps
+                   and now - last_eval_time < eval_throttle_secs)
+      if not throttled:
+        last_eval_time = now
+        eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+        eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                                 eval_steps)
+        writer.write_scalars(step, {f"eval/{k}": v
+                                    for k, v in eval_metrics.items()})
+        for hook in hooks:
+          hook.after_eval(ctx, step, eval_metrics)
+        logging.info("eval @%d: %s", step, eval_metrics)
+        final_metrics.update(
+            {f"eval/{k}": v for k, v in eval_metrics.items()})
     if step < max_train_steps:
       batch = next(train_dataset)
 
