@@ -18,11 +18,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from tensor2robot_tpu.utils import config
+
 __all__ = ["PoseToyEnv", "RandomPolicy", "episode_to_transitions"]
 
 IMAGE_SIZE = 32
 
 
+@config.configurable
 class PoseToyEnv:
   """2D reach: observe a rendered target, output its position."""
 
@@ -66,6 +69,7 @@ class PoseToyEnv:
         "distance": distance, "target": self._target.copy()}
 
 
+@config.configurable
 class RandomPolicy:
   """Uniform random actions (reference random_policy)."""
 
