@@ -269,3 +269,46 @@ class TestExportCLI:
     assert os.path.isfile(os.path.join(path, "t2r_assets.json"))
     sig = json.load(open(os.path.join(path, "signature.json")))
     assert sig["global_step"] == 10
+
+
+class TestCheckpointAveraging:
+
+  def test_average_of_last_checkpoints(self, tmp_path):
+    import jax
+
+    from tensor2robot_tpu import checkpoints as checkpoints_lib
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=30,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=10)
+    ckpt_dir = os.path.join(model_dir, "checkpoints")
+    averaged = checkpoints_lib.average_checkpoints(ckpt_dir, last_n=3)
+    leaf = jax.tree_util.tree_leaves(averaged)[0]
+    assert leaf.dtype == np.float32
+    # averaging specific steps matches manual mean of two restores
+    only_first = checkpoints_lib.average_checkpoints(ckpt_dir, steps=[10])
+    only_last = checkpoints_lib.average_checkpoints(ckpt_dir, steps=[30])
+    both = checkpoints_lib.average_checkpoints(ckpt_dir, steps=[10, 30])
+    l_first = jax.tree_util.tree_leaves(only_first)[0]
+    l_last = jax.tree_util.tree_leaves(only_last)[0]
+    l_both = jax.tree_util.tree_leaves(both)[0]
+    np.testing.assert_allclose(l_both, (l_first + l_last) / 2.0,
+                               atol=1e-6)
+
+  def test_missing_step_raises(self, tmp_path):
+    from tensor2robot_tpu import checkpoints as checkpoints_lib
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=10,
+        checkpoint_every_n_steps=10, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=10)
+    with pytest.raises(ValueError, match="not found"):
+      checkpoints_lib.average_checkpoints(
+          os.path.join(model_dir, "checkpoints"), steps=[999])
