@@ -95,9 +95,11 @@ def main() -> None:
     }))
   else:
     # Honest labeling: the CPU smoke config (smaller image/batch) is not
-    # comparable to the V100-class anchor; anchor it to a CPU reference
-    # throughput of the same config instead.
-    cpu_anchor = 3000.0
+    # comparable to the V100-class anchor. The anchor is the throughput
+    # measured for this exact config on this host during round 1
+    # (3643 examples/sec), so vs_baseline ~= 1.0 means "no regression vs
+    # the recorded CPU baseline", nothing more.
+    cpu_anchor = 3643.0
     print(json.dumps({
         "metric": "qtopt_grasps_per_sec_cpu_smoke",
         "value": round(examples_per_sec, 2),

@@ -94,6 +94,7 @@ class RandomPolicy:
     return 0
 
 
+@config.configurable
 def episode_to_transitions(episode: List[Dict[str, Any]]
                            ) -> List[Dict[str, Any]]:
   """Flattens one episode into per-step training examples: image bytes +

@@ -26,6 +26,7 @@ class MultiHeadAttention(nn.Module):
   num_heads: int = 4
   head_dim: int = 32
   causal: bool = False
+  dropout_rate: float = 0.0
   backend: str = "reference"  # 'reference' | 'flash' | 'ring'
   mesh: Optional[Mesh] = None  # required for 'ring'
   sp_axis: str = "sp"
@@ -56,4 +57,7 @@ class MultiHeadAttention(nn.Module):
     else:
       out = attention_ops.attention(q, k, v, causal=self.causal)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, proj)
+    if self.dropout_rate:
+      out = nn.Dropout(self.dropout_rate, name="dropout")(
+          out, deterministic=not train)
     return nn.Dense(x.shape[-1], name="out_proj")(out)

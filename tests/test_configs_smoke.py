@@ -14,7 +14,15 @@ from tensor2robot_tpu.utils.test_fixture import assert_output_files
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CONFIG_GLOB = os.path.join(REPO_ROOT, "tensor2robot_tpu", "research", "*",
                            "configs", "*.gin")
-ALL_CONFIGS = sorted(glob.glob(CONFIG_GLOB))
+def _is_trainer_config(path: str) -> bool:
+  with open(path) as f:
+    return "train_eval_model" in f.read()
+
+
+ALL_CONFIGS = sorted(p for p in glob.glob(CONFIG_GLOB)
+                     if _is_trainer_config(p))
+ACTOR_CONFIGS = sorted(p for p in glob.glob(CONFIG_GLOB)
+                       if not _is_trainer_config(p))
 
 # Per-config shrink overrides so CI stays fast on CPU.
 _SHRINK = [
@@ -72,6 +80,25 @@ def test_config_smoke_trains(config_path, tmp_path):
   metrics = train_eval.train_eval_model()
   assert metrics, f"no metrics from {config_path}"
   assert_output_files(model_dir, expect_operative_config=False)
+
+
+def test_actor_configs_drive_collect_loop(tmp_path):
+  """Non-trainer (actor-side) configs run the collect/eval loop and
+  write replay records."""
+  from tensor2robot_tpu.data import tfrecord
+  from tensor2robot_tpu.envs import run_env
+
+  assert ACTOR_CONFIGS, "expected at least one actor config"
+  for config_path in ACTOR_CONFIGS:
+    config.clear_config()
+    root = str(tmp_path / os.path.basename(config_path))
+    config.parse_config_files_and_bindings(
+        [config_path], [f"collect_eval_loop.root_dir = {root!r}"])
+    stats = run_env.collect_eval_loop()
+    assert "collect/episode_reward_mean" in stats
+    replays = glob.glob(os.path.join(root, "policy_collect", "*.tfrecord"))
+    assert replays, f"{config_path} wrote no replay records"
+    assert tfrecord.count_records(replays[0]) > 0
 
 
 def test_config_runs_in_fresh_process(tmp_path):

@@ -32,9 +32,17 @@ _WORKER = textwrap.dedent("""
 """)
 
 
+def _free_port() -> int:
+  import socket
+
+  with socket.socket() as s:
+    s.bind(("127.0.0.1", 0))
+    return s.getsockname()[1]
+
+
 @pytest.mark.slow
 def test_two_process_mesh_and_collective(tmp_path):
-  port = 9917
+  port = _free_port()
   script = tmp_path / "worker.py"
   script.write_text(_WORKER % port)
   env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu",
