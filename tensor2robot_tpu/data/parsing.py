@@ -178,6 +178,16 @@ class ParseFn:
     for dkey in self._dataset_keys:
       subset = specs_lib.filter_by_dataset(merged, dkey)
       self._plans[dkey] = _plan_for(subset)
+      # Two specs mapping to one wire key would silently read the same
+      # feature; surface the collision at construction time.
+      names: Dict[str, str] = {}
+      for plan in self._plans[dkey]:
+        if plan.feature_name in names:
+          raise ValueError(
+              f"Specs {names[plan.feature_name]!r} and {plan.out_key!r} "
+              f"both map to wire feature {plan.feature_name!r} in dataset "
+              f"{dkey!r}; give them distinct names.")
+        names[plan.feature_name] = plan.out_key
       self._sequence_datasets[dkey] = any(
           spec.is_sequence for spec in subset.values())
       self._native_parsers[dkey] = self._maybe_native_parser(

@@ -406,3 +406,14 @@ class TestPrefetchLifecycle:
     time.sleep(0.5)  # workers notice the stop event
     after = threading.active_count()
     assert after - before <= 1, (before, after)
+
+
+class TestDuplicateWireNames:
+
+  def test_colliding_names_rejected_at_construction(self):
+    spec = SpecStruct({
+        "a": TensorSpec(shape=(1,), name="same"),
+        "b": TensorSpec(shape=(2,), name="same"),
+    })
+    with pytest.raises(ValueError, match="both map to wire feature"):
+      parsing.create_parse_fn(spec)
