@@ -173,3 +173,47 @@ class TestRunMetaEnv:
     # the oracle-derived adapted policy lands on the target: ~0 reward
     assert stats["meta_eval/reward_mean"] > -0.05
     assert "meta_eval/reward_trial_0" in stats
+
+
+class TestMetaServingEndToEnd:
+
+  def test_maml_train_serve_adapt_act(self, tmp_path):
+    """The full meta loop: train a MAML model, serve it through a
+    checkpoint predictor, adapt on demo data, select actions."""
+    import jax
+
+    from tensor2robot_tpu import train_eval
+    from tensor2robot_tpu.data import input_generators
+    from tensor2robot_tpu.predictors import predictors as predictors_lib
+    from tensor2robot_tpu.utils import mocks
+
+    def make_model():
+      return maml.MAMLModel(
+          base_model=mocks.MockT2RModel(device_type="cpu",
+                                        use_batch_norm=False),
+          num_inner_loop_steps=1, inner_learning_rate=0.5,
+          num_condition_samples_per_task=4,
+          num_inference_samples_per_task=2)
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=make_model(), model_dir=model_dir, mode="train",
+        max_train_steps=10, checkpoint_every_n_steps=10,
+        mesh_shape=(1, 1, 1),
+        input_generator_train=input_generators.DefaultRandomInputGenerator(
+            batch_size=4),
+        log_every_n_steps=10)
+
+    predictor = predictors_lib.CheckpointPredictor(
+        model=make_model(), model_dir=model_dir)
+    assert predictor.restore()
+    policy = meta_policies.MAMLRegressionPolicy(
+        predictor=predictor, action_key="prediction",
+        num_inference_samples=2)
+    rng = np.random.RandomState(0)
+    policy.adapt(
+        {"x": rng.randn(4, 3).astype(np.float32)},
+        {"y": (rng.rand(4, 1) > 0.5).astype(np.float32)})
+    action = policy.select_action({"x": np.zeros(3, np.float32)})
+    assert action.shape == (1,)
+    assert np.isfinite(action).all()
