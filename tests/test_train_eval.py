@@ -175,3 +175,42 @@ class TestTrainEval:
     with pytest.raises(ValueError, match="Unknown train_eval mode"):
       train_eval.train_eval_model(
           model=self._model(), model_dir=str(tmp_path), mode="banana")
+
+
+class TestPreemption:
+
+  def test_preemption_saves_and_exits(self, tmp_path, monkeypatch):
+    """A preemption signal mid-training must checkpoint and exit 42 so
+    the next incarnation resumes losslessly."""
+    from tensor2robot_tpu import checkpoints as checkpoints_lib
+
+    fired = {"at": 7}
+
+    def fake_reached(self, step):
+      return step == fired["at"]
+
+    monkeypatch.setattr(checkpoints_lib.CheckpointManager,
+                        "reached_preemption", fake_reached)
+    model_dir = str(tmp_path / "m")
+    with pytest.raises(SystemExit) as excinfo:
+      train_eval.train_eval_model(
+          model=mocks.MockT2RModel(device_type="cpu"),
+          model_dir=model_dir, mode="train", max_train_steps=100,
+          checkpoint_every_n_steps=100, mesh_shape=(1, 1, 1),
+          input_generator_train=mocks.MockInputGenerator(batch_size=4),
+          log_every_n_steps=50)
+    assert excinfo.value.code == 42
+    # the forced checkpoint landed at the preemption step
+    assert checkpoints_lib.latest_step(
+        os.path.join(model_dir, "checkpoints")) == fired["at"]
+    # and a fresh invocation resumes from it
+    monkeypatch.setattr(checkpoints_lib.CheckpointManager,
+                        "reached_preemption", lambda self, step: False)
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=20,
+        checkpoint_every_n_steps=20, mesh_shape=(1, 1, 1),
+        input_generator_train=mocks.MockInputGenerator(batch_size=4),
+        log_every_n_steps=20)
+    assert checkpoints_lib.latest_step(
+        os.path.join(model_dir, "checkpoints")) == 20
