@@ -113,6 +113,10 @@ class _BCZNetwork(nn.Module):
   network: str = "resnet_film"  # 'resnet_film' | 'spatial_softmax'
   resnet_size: int = 18
   condition_size: int = 0
+  num_users: int = 0
+  user_embedding_size: int = 8
+  past_frames_hidden: int = 32
+
   predict_stop: bool = True
 
   @nn.compact
@@ -121,9 +125,19 @@ class _BCZNetwork(nn.Module):
     image = features["image"]
     if jnp.issubdtype(image.dtype, jnp.integer):
       image = image.astype(jnp.float32) / 255.0
-    conditioning = None
+    # Conditioning vector: language embedding, operator (user) identity
+    # embedding (reference user-id conditioning, bcz/model.py:641-950).
+    conditioning_parts = []
     if self.condition_size:
-      conditioning = features["condition_embedding"]
+      conditioning_parts.append(features["condition_embedding"])
+    if self.num_users and "user_id" in features:
+      user_id = jnp.clip(features["user_id"].astype(jnp.int32), 0,
+                         self.num_users - 1)
+      user_emb = nn.Embed(self.num_users, self.user_embedding_size,
+                          name="user_embed")(user_id)
+      conditioning_parts.append(user_emb.reshape(image.shape[0], -1))
+    conditioning = (jnp.concatenate(conditioning_parts, axis=-1)
+                    if conditioning_parts else None)
     if self.network == "resnet_film":
       feats, _ = film_resnet.ResNet(
           resnet_size=self.resnet_size, name="resnet")(
@@ -131,6 +145,17 @@ class _BCZNetwork(nn.Module):
     else:
       feats = vision.BerkeleyNet(name="tower")(image, conditioning,
                                                train=train)
+    if "past_frames" in features:
+      # Past-frame conditioning (reference past-conditioning): a small
+      # ConvGRU over the history, final hidden state concatenated.
+      past = features["past_frames"]
+      if jnp.issubdtype(past.dtype, jnp.integer):
+        past = past.astype(jnp.float32) / 255.0
+      history = bcz_networks.ConvGRUEncoder(
+          hidden_size=self.past_frames_hidden, filters=(16,),
+          name="past_encoder")(past, train=train)
+      feats = jnp.concatenate(
+          [feats, history[:, -1].astype(feats.dtype)], axis=-1)
     if "present_pose" in features:
       feats = jnp.concatenate(
           [feats, features["present_pose"].astype(feats.dtype)], axis=-1)
@@ -162,6 +187,8 @@ class BCZModel(abstract_model.T2RModel):
                network: str = "resnet_film",
                resnet_size: int = 18,
                condition_size: int = 0,
+               num_users: int = 0,
+               num_past_frames: int = 0,
                predict_stop: bool = True,
                huber_delta: float = 1.0,
                stop_loss_weight: float = 0.1,
@@ -174,6 +201,8 @@ class BCZModel(abstract_model.T2RModel):
     self._network = network
     self._resnet_size = resnet_size
     self._condition_size = condition_size
+    self._num_users = num_users
+    self._num_past_frames = num_past_frames
     self._predict_stop = predict_stop
     self._huber_delta = huber_delta
     self._stop_loss_weight = stop_loss_weight
@@ -190,6 +219,14 @@ class BCZModel(abstract_model.T2RModel):
       out["condition_embedding"] = TensorSpec(
           shape=(self._condition_size,), dtype=np.float32,
           name="condition_embedding")
+    if self._num_users:
+      out["user_id"] = TensorSpec(shape=(), dtype=np.int64,
+                                  name="user_id")
+    if self._num_past_frames:
+      out["past_frames"] = TensorSpec(
+          shape=(self._num_past_frames, self._image_size,
+                 self._image_size, 3),
+          dtype=np.float32, name="past_frames", is_optional=True)
     return out
 
   def get_label_specification(self, mode):
@@ -207,6 +244,7 @@ class BCZModel(abstract_model.T2RModel):
         components=self._components, num_waypoints=self._num_waypoints,
         network=self._network, resnet_size=self._resnet_size,
         condition_size=self._condition_size,
+        num_users=self._num_users,
         predict_stop=self._predict_stop)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):

@@ -173,3 +173,32 @@ class TestVRGripper:
     spec = model.get_feature_specification(modes.TRAIN)
     assert "trial_frames" in spec
     assert spec["trial_rewards"].is_optional
+
+
+class TestBCZConditioning:
+
+  def test_user_id_and_past_frames(self):
+    model = bcz_models.BCZModel(
+        image_size=32, num_waypoints=3, network="spatial_softmax",
+        num_users=5, num_past_frames=2, device_type="cpu")
+    spec = model.get_feature_specification(modes.TRAIN)
+    assert "user_id" in spec
+    assert spec["past_frames"].shape == (2, 32, 32, 3)
+    features, labels = _random_batch(model, 2)
+    # add the optional past frames explicitly; keep user ids in range
+    features = specs_lib.flatten_spec_structure(features)
+    features["user_id"] = np.array([0, 3], np.int64)
+    features["past_frames"] = np.random.RandomState(0).rand(
+        2, 2, 32, 32, 3).astype(np.float32)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model)
+    _, metrics = step(state, features, labels)
+    assert np.isfinite(float(metrics["loss"]))
+    # different users produce different actions
+    predict = ts.make_predict_fn(model)
+    f2 = specs_lib.SpecStruct(features)
+    f2["user_id"] = (np.asarray(features["user_id"]) + 1) % 5
+    out1 = predict(state, features)
+    out2 = predict(state, f2)
+    assert not np.allclose(np.asarray(out1["xyz"]),
+                           np.asarray(out2["xyz"]))
