@@ -192,7 +192,7 @@ class TestBCZConditioning:
         2, 2, 32, 32, 3).astype(np.float32)
     state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
     step = ts.make_train_step(model)
-    _, metrics = step(state, features, labels)
+    state, metrics = step(state, features, labels)  # step donates old state
     assert np.isfinite(float(metrics["loss"]))
     # different users produce different actions
     predict = ts.make_predict_fn(model)
