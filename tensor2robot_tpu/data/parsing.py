@@ -178,16 +178,24 @@ class ParseFn:
     for dkey in self._dataset_keys:
       subset = specs_lib.filter_by_dataset(merged, dkey)
       self._plans[dkey] = _plan_for(subset)
-      # Two specs mapping to one wire key would silently read the same
-      # feature; surface the collision at construction time.
-      names: Dict[str, str] = {}
+      # Two *incompatible* specs mapping to one wire key would silently
+      # read the same feature; surface that at construction time.
+      # Compatible duplicates are legal and intentional — e.g. MAML's
+      # condition/ and inference/ subtrees both read the base feature.
+      names: Dict[str, _LeafPlan] = {}
       for plan in self._plans[dkey]:
-        if plan.feature_name in names:
-          raise ValueError(
-              f"Specs {names[plan.feature_name]!r} and {plan.out_key!r} "
-              f"both map to wire feature {plan.feature_name!r} in dataset "
-              f"{dkey!r}; give them distinct names.")
-        names[plan.feature_name] = plan.out_key
+        other = names.get(plan.feature_name)
+        if other is not None:
+          compatible = (other.spec.shape == plan.spec.shape
+                        and other.spec.dtype == plan.spec.dtype
+                        and other.spec.is_sequence == plan.spec.is_sequence)
+          if not compatible:
+            raise ValueError(
+                f"Specs {other.out_key!r} and {plan.out_key!r} both map to "
+                f"wire feature {plan.feature_name!r} in dataset {dkey!r} "
+                "with different shapes/dtypes; give them distinct names.")
+          continue
+        names[plan.feature_name] = plan
       self._sequence_datasets[dkey] = any(
           spec.is_sequence for spec in subset.values())
       self._native_parsers[dkey] = self._maybe_native_parser(
@@ -199,6 +207,10 @@ class ParseFn:
     fixed-shape float/int features and single-value bytes/images, no
     sequences/optionals/varlen (those take the Python path)."""
     if is_sequence:
+      return None
+    if len({p.feature_name for p in plans}) != len(plans):
+      # Duplicate wire names (e.g. MAML split subtrees): the native
+      # name index is one-to-one, so take the Python path.
       return None
     native_plan = []
     for plan in plans:

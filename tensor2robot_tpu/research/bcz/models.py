@@ -93,12 +93,22 @@ class BCZPreprocessor(preprocessors_lib.SpecTransformationPreprocessor):
       if self._binarize_gripper and "gripper" in labels:
         labels["gripper"] = (np.asarray(labels["gripper"]) > 0.5).astype(
             np.float32)
-      if is_training and self._mixup_alpha > 0.0:
+      has_discrete_conditioning = any(
+          np.issubdtype(np.asarray(features[k]).dtype, np.integer)
+          for k in features.keys() if k != "image")
+      if (is_training and self._mixup_alpha > 0.0
+          and not has_discrete_conditioning):
+        # Mixup blends every continuous feature with the same partner so
+        # conditioning stays consistent with the blended labels. It is
+        # disabled alongside discrete conditioning (e.g. user_id), which
+        # cannot be interpolated.
         lam = float(np.random.default_rng(self._seed + self._calls).beta(
             self._mixup_alpha, self._mixup_alpha))
         perm = np.roll(np.arange(features["image"].shape[0]), 1)
-        features["image"] = (lam * features["image"]
-                             + (1 - lam) * features["image"][perm])
+        for k in list(features.keys()):
+          arr = np.asarray(features[k])
+          if np.issubdtype(arr.dtype, np.floating):
+            features[k] = lam * arr + (1 - lam) * arr[perm]
         for k in list(labels.keys()):
           arr = np.asarray(labels[k], np.float32)
           labels[k] = lam * arr + (1 - lam) * arr[perm]
@@ -115,6 +125,7 @@ class _BCZNetwork(nn.Module):
   condition_size: int = 0
   num_users: int = 0
   user_embedding_size: int = 8
+  use_past_frames: bool = False
   past_frames_hidden: int = 32
 
   predict_stop: bool = True
@@ -145,9 +156,11 @@ class _BCZNetwork(nn.Module):
     else:
       feats = vision.BerkeleyNet(name="tower")(image, conditioning,
                                                train=train)
-    if "past_frames" in features:
+    if self.use_past_frames:
       # Past-frame conditioning (reference past-conditioning): a small
       # ConvGRU over the history, final hidden state concatenated.
+      # Gated on static config (not feature presence) so module
+      # structure cannot vary between batches.
       past = features["past_frames"]
       if jnp.issubdtype(past.dtype, jnp.integer):
         past = past.astype(jnp.float32) / 255.0
@@ -223,10 +236,12 @@ class BCZModel(abstract_model.T2RModel):
       out["user_id"] = TensorSpec(shape=(), dtype=np.int64,
                                   name="user_id")
     if self._num_past_frames:
+      # Required when configured: its presence gates network structure,
+      # so it must be there in every batch (train and serving alike).
       out["past_frames"] = TensorSpec(
           shape=(self._num_past_frames, self._image_size,
                  self._image_size, 3),
-          dtype=np.float32, name="past_frames", is_optional=True)
+          dtype=np.float32, name="past_frames")
     return out
 
   def get_label_specification(self, mode):
@@ -245,6 +260,7 @@ class BCZModel(abstract_model.T2RModel):
         network=self._network, resnet_size=self._resnet_size,
         condition_size=self._condition_size,
         num_users=self._num_users,
+        use_past_frames=bool(self._num_past_frames),
         predict_stop=self._predict_stop)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):

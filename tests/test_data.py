@@ -417,3 +417,22 @@ class TestDuplicateWireNames:
     })
     with pytest.raises(ValueError, match="both map to wire feature"):
       parsing.create_parse_fn(spec)
+
+
+class TestCompatibleDuplicateNames:
+
+  def test_maml_style_duplicates_parse_into_both_keys(self):
+    """condition/ and inference/ subtrees reading one wire feature is
+    legal when the specs agree (MAML record input path)."""
+    spec = SpecStruct({
+        "condition/features/x": TensorSpec(shape=(3,), name="x"),
+        "inference/features/x": TensorSpec(shape=(3,), name="x"),
+    })
+    parse_fn = parsing.create_parse_fn(spec)
+    record = codec.encode_example({"x": np.array([1., 2., 3.],
+                                                 np.float32)}, None)
+    out = parse_fn.parse_batch([record])
+    np.testing.assert_allclose(out["features/condition/features/x"][0],
+                               [1, 2, 3])
+    np.testing.assert_allclose(out["features/inference/features/x"][0],
+                               [1, 2, 3])
