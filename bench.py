@@ -24,7 +24,7 @@ import time
 import numpy as np
 
 
-def _probe_tpu(timeout: float = 300.0) -> bool:
+def _probe_tpu(timeout: float = 120.0) -> bool:
   """Checks TPU backend health in a subprocess: a wedged device tunnel
   hangs backend init forever, which must not hang the benchmark."""
   try:

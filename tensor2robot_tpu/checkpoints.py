@@ -249,11 +249,18 @@ def average_checkpoints(directory: str,
   with ocp.StandardCheckpointer() as checkpointer:
     for step in steps:
       step_dir = os.path.join(directory, str(step))
-      # CheckpointManager layout nests the state under an item dir.
-      item_dirs = [os.path.join(step_dir, d) for d in os.listdir(step_dir)
-                   if os.path.isdir(os.path.join(step_dir, d))]
-      restored = checkpointer.restore(item_dirs[0] if item_dirs
-                                      else step_dir)
+      # CheckpointManager layout nests the state under an item dir
+      # (named 'default' in current orbax); prefer it explicitly and
+      # fall back deterministically.
+      default_dir = os.path.join(step_dir, "default")
+      if os.path.isdir(default_dir):
+        target = default_dir
+      else:
+        item_dirs = sorted(
+            os.path.join(step_dir, d) for d in os.listdir(step_dir)
+            if os.path.isdir(os.path.join(step_dir, d)))
+        target = item_dirs[0] if item_dirs else step_dir
+      restored = checkpointer.restore(target)
       params = restored["params"] if "params" in restored else restored
       if total is None:
         total = jax.tree_util.tree_map(
