@@ -16,24 +16,11 @@ therefore reads as vs_baseline >= 4.
 from __future__ import annotations
 
 import json
-import os
-import subprocess
-import sys
 import time
 
 import numpy as np
 
-
-def _probe_tpu(timeout: float = 120.0) -> bool:
-  """Checks TPU backend health in a subprocess: a wedged device tunnel
-  hangs backend init forever, which must not hang the benchmark."""
-  try:
-    result = subprocess.run(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        timeout=timeout, capture_output=True)
-    return result.returncode == 0
-  except subprocess.TimeoutExpired:
-    return False
+from tensor2robot_tpu.utils import backend as backend_lib
 
 BASELINE_PER_CHIP = 400.0  # est. V100-class grasps/sec/device (see docstring)
 BATCH_SIZE = 256
@@ -43,14 +30,11 @@ MEASURE_STEPS = 20
 
 
 def main() -> None:
-  if not _probe_tpu():
+  if not backend_lib.accelerator_healthy():
     # Device backend unreachable: fall back to CPU rather than hang.
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-  else:
-    import jax
+    backend_lib.pin_cpu()
+    backend_lib.assert_cpu_backend()
+  import jax
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts

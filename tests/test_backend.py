@@ -1,0 +1,93 @@
+"""Tests for utils/backend.py: CPU pinning + accelerator health probing.
+
+These run inside the conftest-pinned CPU process, so pin_cpu/assert here are
+exercising idempotent paths; the env-merge logic is tested directly on
+os.environ copies via monkeypatching.
+"""
+
+import os
+import subprocess
+import sys
+
+from tensor2robot_tpu.utils import backend
+
+
+def test_pin_cpu_sets_env_and_config(monkeypatch):
+  monkeypatch.setenv("JAX_PLATFORMS", "axon")
+  monkeypatch.setenv("XLA_FLAGS", "")
+  backend.pin_cpu(n_devices=8)
+  assert os.environ["JAX_PLATFORMS"] == "cpu"
+  assert "--xla_force_host_platform_device_count=8" in os.environ["XLA_FLAGS"]
+
+
+def test_pin_cpu_replaces_existing_device_count(monkeypatch):
+  monkeypatch.setenv(
+      "XLA_FLAGS", "--foo=1 --xla_force_host_platform_device_count=2 --bar=2")
+  backend.pin_cpu(n_devices=8)
+  flags = os.environ["XLA_FLAGS"]
+  assert "--xla_force_host_platform_device_count=8" in flags
+  assert "device_count=2" not in flags
+  assert "--foo=1" in flags and "--bar=2" in flags
+
+
+def test_pin_cpu_preserves_other_flags(monkeypatch):
+  monkeypatch.setenv("XLA_FLAGS", "--some_flag=true")
+  backend.pin_cpu(n_devices=4)
+  assert "--some_flag=true" in os.environ["XLA_FLAGS"]
+  assert "--xla_force_host_platform_device_count=4" in os.environ["XLA_FLAGS"]
+
+
+def test_accelerator_healthy_false_when_pinned_cpu(monkeypatch):
+  monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+  # Must short-circuit without even spawning a probe subprocess.
+  def boom(*a, **k):
+    raise AssertionError("probe subprocess must not be spawned")
+  monkeypatch.setattr(subprocess, "Popen", boom)
+  assert backend.accelerator_healthy() is False
+
+
+def test_accelerator_healthy_probes_subprocess(monkeypatch):
+  monkeypatch.setenv("JAX_PLATFORMS", "axon")
+
+  class FakeProc:
+    def __init__(self, argv, **kwargs):
+      assert argv[0] == sys.executable
+      self.terminated = False
+
+    def wait(self, timeout=None):
+      return 1  # probe process failed -> unhealthy
+
+    def terminate(self):
+      self.terminated = True
+
+  monkeypatch.setattr(subprocess, "Popen", FakeProc)
+  assert backend.accelerator_healthy(timeout=1.0) is False
+
+
+def test_accelerator_healthy_timeout_never_sigkills(monkeypatch):
+  monkeypatch.setenv("JAX_PLATFORMS", "axon")
+  events = []
+
+  class HangingProc:
+    def __init__(self, argv, **kwargs):
+      pass
+
+    def wait(self, timeout=None):
+      events.append(("wait", timeout))
+      raise subprocess.TimeoutExpired(cmd="probe", timeout=timeout)
+
+    def terminate(self):
+      events.append(("terminate", None))
+
+    def kill(self):
+      raise AssertionError("SIGKILL is forbidden for mid-init TPU clients")
+
+  monkeypatch.setattr(subprocess, "Popen", HangingProc)
+  assert backend.accelerator_healthy(timeout=0.01) is False
+  kinds = [e[0] for e in events]
+  assert kinds == ["wait", "terminate", "wait"]
+
+
+def test_assert_cpu_backend_passes_here():
+  # conftest pinned this process to CPU, so the live backend is CPU.
+  backend.assert_cpu_backend()
