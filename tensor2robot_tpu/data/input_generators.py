@@ -267,10 +267,12 @@ class DefaultRandomInputGenerator(AbstractInputGenerator):
             self._feature_spec, batch_size=self._batch_size,
             sequence_length=self._sequence_length, seed=self._seed + step)
         if self._label_spec is not None and len(self._label_spec):
-          out["labels"] = specs_lib.make_random_numpy(
+          labels = specs_lib.make_random_numpy(
               self._label_spec, batch_size=self._batch_size,
               sequence_length=self._sequence_length,
               seed=self._seed + step + 10_000_019)
+          if len(labels):  # all-optional label specs generate nothing
+            out["labels"] = labels
         step += 1
         if self._preprocess_fn is not None:
           features, labels = self._preprocess_fn(

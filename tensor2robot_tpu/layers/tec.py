@@ -17,7 +17,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["reduce_temporal_embeddings", "EmbedEpisode",
-           "npairs_loss", "triplet_semihard_loss", "cosine_distance_matrix"]
+           "TemporalConvEmbedding", "npairs_loss", "triplet_semihard_loss",
+           "cosine_distance_matrix"]
 
 
 def reduce_temporal_embeddings(embeddings: jnp.ndarray,
@@ -49,6 +50,34 @@ class EmbedEpisode(nn.Module):
     if self.normalize:
       x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-7)
     return x
+
+
+class TemporalConvEmbedding(nn.Module):
+  """Learned temporal reduction: [B, T, D] -> [B, output_size].
+
+  Reference `reduce_temporal_embeddings` (/root/reference/layers/tec.py:
+  114-169): conv1d stack (kernel 10, relu, layer-norm) over time, a mean
+  over the time axis, then an MLP head. Deviation: SAME padding instead of
+  VALID so short episodes (T < 10) still produce a timestep to reduce —
+  the reference's 40-step episodes never hit that edge.
+  """
+
+  output_size: int
+  conv1d_layers: tuple = (64,)
+  fc_hidden_layers: tuple = (100,)
+  kernel_size: int = 10
+
+  @nn.compact
+  def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+    for i, filters in enumerate(self.conv1d_layers):
+      x = nn.Conv(filters, kernel_size=(self.kernel_size,), use_bias=False,
+                  padding="SAME", name=f"conv1d_{i}")(x)
+      x = nn.LayerNorm(name=f"conv_ln_{i}")(nn.relu(x))
+    x = x.mean(axis=-2)
+    for i, hidden in enumerate(self.fc_hidden_layers):
+      x = nn.LayerNorm(name=f"fc_ln_{i}")(
+          nn.relu(nn.Dense(hidden, name=f"fc_{i}")(x)))
+    return nn.Dense(self.output_size, name="out")(x)
 
 
 def cosine_distance_matrix(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

@@ -132,7 +132,7 @@ class MAMLModel(abstract_model.T2RModel):
   # -- the meta forward pass -----------------------------------------------
 
   def inference_network_fn(self, variables, features, mode,
-                           rng=None, train=False):
+                           rng=None, train=False, **module_kwargs):
     base = self._base_model
     params = variables["params"]
     mutable = {k: v for k, v in variables.items() if k != "params"}
@@ -141,16 +141,32 @@ class MAMLModel(abstract_model.T2RModel):
     cond_features = features["condition/features"]
     cond_labels = features["condition/labels"]
     inf_features = features["inference/features"]
+    # Base models can customize inner-loop behavior (the reference's
+    # params={'is_inner_loop': True} plumbing + learned inner losses,
+    # vrgripper_env_models.py:377,409-443):
+    # * `inner_loop_forward_kwargs`: extra static module kwargs for
+    #   condition-split forwards during adaptation;
+    # * `inner_loop_loss_fn(features, labels, outputs, mode)`: replaces
+    #   model_train_fn as the adaptation objective (e.g. a learned loss
+    #   that ignores labels).
+    inner_fwd_kwargs = dict(
+        getattr(base, "inner_loop_forward_kwargs", None) or {})
+    inner_fwd_kwargs.update(module_kwargs)
+    custom_inner_loss = getattr(base, "inner_loop_loss_fn", None)
 
-    def base_forward(p, task_features):
+    def base_forward(p, task_features, **extra):
       outputs, _ = base.inference_network_fn(
           {"params": p, **mutable}, task_features, mode, rng=rng,
-          train=False)  # inner loop keeps batch stats frozen (BN pain,
-      # reference maml_model.py:300-304)
+          train=False,  # inner loop keeps batch stats frozen (BN pain,
+          # reference maml_model.py:300-304)
+          **{**module_kwargs, **extra})
       return outputs
 
     def inner_loss(p, task_cond_features, task_cond_labels):
-      outputs = base_forward(p, task_cond_features)
+      outputs = base_forward(p, task_cond_features, **inner_fwd_kwargs)
+      if custom_inner_loss is not None:
+        return custom_inner_loss(
+            task_cond_features, task_cond_labels, outputs, mode)
       loss, _ = base.model_train_fn(
           task_cond_features, task_cond_labels, outputs, mode)
       return loss
@@ -190,6 +206,13 @@ class MAMLModel(abstract_model.T2RModel):
 
   # -- outer loss -----------------------------------------------------------
 
+  def _flatten_outputs(self, outputs):
+    """Merges [task, samples] dims; per-task scalars (e.g. learned-loss
+    values, rank < 2) pass through unflattened."""
+    return jax.tree_util.tree_map(
+        lambda x: batch_utils.flatten_batch_examples(x)
+        if jnp.ndim(x) >= 2 else x, outputs)
+
   def model_train_fn(self, features, labels, inference_outputs, mode):
     """Outer loss: base train fn on the flattened inference split
     (reference maml_model.py:415-496)."""
@@ -198,7 +221,7 @@ class MAMLModel(abstract_model.T2RModel):
     flat_features = batch_utils.flatten_batch_examples(
         features["inference/features"])
     flat_labels = batch_utils.flatten_batch_examples(labels)
-    flat_outputs = batch_utils.flatten_batch_examples(
+    flat_outputs = self._flatten_outputs(
         inference_outputs["conditioned_output"])
     loss, scalars = base.model_train_fn(
         flat_features, flat_labels, flat_outputs, mode)
@@ -214,9 +237,9 @@ class MAMLModel(abstract_model.T2RModel):
     flat_features = batch_utils.flatten_batch_examples(
         features["inference/features"])
     flat_labels = batch_utils.flatten_batch_examples(labels)
-    flat_cond = batch_utils.flatten_batch_examples(
+    flat_cond = self._flatten_outputs(
         inference_outputs["conditioned_output"])
-    flat_uncond = batch_utils.flatten_batch_examples(
+    flat_uncond = self._flatten_outputs(
         inference_outputs["unconditioned_output"])
     metrics = {f"conditioned/{k}": v for k, v in base.model_eval_fn(
         flat_features, flat_labels, flat_cond).items()}

@@ -191,12 +191,17 @@ class T2RModel(ModelInterface):
                            features,
                            mode: str,
                            rng: Optional[jax.Array] = None,
-                           train: bool = False) -> Tuple[Any, Any]:
+                           train: bool = False,
+                           **module_kwargs) -> Tuple[Any, Any]:
     """Pure forward pass; returns (outputs, updated_mutable_state).
 
     The reference's inference_network_fn
     (/root/reference/models/abstract_model.py:703) with flax mutable
-    collections (batch_stats) threaded explicitly.
+    collections (batch_stats) threaded explicitly. Extra `module_kwargs`
+    are forwarded to the module call — the analogue of the reference's
+    `params` plumbing (e.g. `params['is_inner_loop']`,
+    vrgripper_env_models.py:377) for modules whose behavior depends on
+    static flags.
     """
     rngs = {"dropout": rng} if rng is not None else {}
     mutable = ["batch_stats"] if train else False
@@ -212,7 +217,7 @@ class T2RModel(ModelInterface):
           if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
           variables["params"])
     out = self.module.apply(variables, features, mode=mode, train=train,
-                            rngs=rngs, mutable=mutable)
+                            rngs=rngs, mutable=mutable, **module_kwargs)
     if mutable:
       outputs, new_state = out
       return outputs, new_state

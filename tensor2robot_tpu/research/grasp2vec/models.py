@@ -23,8 +23,8 @@ import numpy as np
 
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
-from tensor2robot_tpu.layers import tec as tec_lib
 from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.research.grasp2vec import losses as g2v_losses
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
@@ -87,26 +87,46 @@ class _Grasp2VecNetwork(nn.Module):
     goal = GoalEmbedding(self.embedding_size, name="goal")
     pregrasp, pregrasp_spatial = scene(_norm(features["pregrasp_image"]),
                                        train=train)
-    postgrasp, _ = scene(_norm(features["postgrasp_image"]), train=train)
+    postgrasp, postgrasp_spatial = scene(_norm(features["postgrasp_image"]),
+                                         train=train)
     goal_emb = goal(_norm(features["goal_image"]), train=train)
     outputs = specs_lib.SpecStruct()
     outputs["pregrasp_embedding"] = pregrasp
     outputs["postgrasp_embedding"] = postgrasp
+    outputs["pregrasp_spatial"] = pregrasp_spatial
+    outputs["postgrasp_spatial"] = postgrasp_spatial
     outputs["goal_embedding"] = goal_emb
     outputs["arithmetic_embedding"] = pregrasp - postgrasp
     outputs["heatmap"] = keypoint_heatmap(pregrasp_spatial, goal_emb)
+    outputs["keypoints"] = g2v_losses.heatmap_keypoints(outputs["heatmap"])
     return outputs
 
 
 @config.configurable
 class Grasp2VecModel(abstract_model.T2RModel):
-  """phi(pre) - phi(post) ~= psi(goal) with an n-pairs objective."""
+  """phi(pre) - phi(post) ~= psi(goal) with a config-selectable objective
+  (reference embedding_loss_fn injection, grasp2vec_model.py:139-142 +
+  losses.py)."""
+
+  LOSS_TYPES = ("npairs", "npairs_multilabel", "triplet", "l2_arithmetic",
+                "cosine_arithmetic")
 
   def __init__(self, image_size: int = 48, embedding_size: int = 64,
+               loss_type: str = "npairs",
+               non_negativity_constraint: bool = False,
+               triplet_margin: float = 3.0,
+               ty_loss_weight: float = 0.0,
                **kwargs):
     super().__init__(**kwargs)
+    if loss_type not in self.LOSS_TYPES:
+      raise ValueError(f"loss_type must be one of {self.LOSS_TYPES}, "
+                       f"got {loss_type!r}")
     self._image_size = image_size
     self._embedding_size = embedding_size
+    self._loss_type = loss_type
+    self._non_negativity_constraint = non_negativity_constraint
+    self._triplet_margin = triplet_margin
+    self._ty_loss_weight = ty_loss_weight
 
   def get_feature_specification(self, mode):
     image = lambda name: TensorSpec(
@@ -119,20 +139,57 @@ class Grasp2VecModel(abstract_model.T2RModel):
     })
 
   def get_label_specification(self, mode):
-    # Self-supervised: no labels beyond the images themselves.
-    return SpecStruct()
+    # Self-supervised at the core; grasp_success masks/relabels the
+    # arithmetic + multilabel objectives (reference losses.py mask args),
+    # keypoint_quadrant scores localization on Shapes-style data
+    # (reference KeypointAccuracy :110-135).
+    return SpecStruct({
+        "grasp_success": TensorSpec(shape=(1,), dtype=np.float32,
+                                    name="grasp_success",
+                                    is_optional=True),
+        "keypoint_quadrant": TensorSpec(shape=(), dtype=np.int64,
+                                        name="keypoint_quadrant",
+                                        is_optional=True),
+    })
 
   def create_module(self):
     return _Grasp2VecNetwork(embedding_size=self._embedding_size)
 
+  def _grasp_success(self, labels):
+    if labels is not None and "grasp_success" in labels \
+        and labels["grasp_success"] is not None:
+      return labels["grasp_success"]
+    return None
+
   def model_train_fn(self, features, labels, inference_outputs, mode):
-    arithmetic = inference_outputs["arithmetic_embedding"]
+    pre = inference_outputs["pregrasp_embedding"]
+    post = inference_outputs["postgrasp_embedding"]
     goal = inference_outputs["goal_embedding"]
-    npairs = tec_lib.npairs_loss(arithmetic, goal)
-    # Symmetric direction (reference uses both anchor orders).
-    npairs_reverse = tec_lib.npairs_loss(goal, arithmetic)
-    loss = 0.5 * (npairs + npairs_reverse)
-    return loss, {"npairs": npairs, "npairs_reverse": npairs_reverse}
+    success = self._grasp_success(labels)
+    scalars = {}
+    if self._loss_type == "npairs":
+      loss = g2v_losses.npairs_loss_bidirectional(
+          pre, goal, post,
+          non_negativity_constraint=self._non_negativity_constraint)
+    elif self._loss_type == "npairs_multilabel":
+      if success is None:
+        success = jnp.ones((pre.shape[0], 1), jnp.float32)
+      loss = g2v_losses.npairs_loss_multilabel(pre, goal, post, success)
+    elif self._loss_type == "triplet":
+      loss, _, _ = g2v_losses.triplet_loss(
+          pre, goal, post, margin=self._triplet_margin)
+    elif self._loss_type == "l2_arithmetic":
+      loss = g2v_losses.l2_arithmetic_loss(pre, goal, post, mask=success)
+    else:  # cosine_arithmetic
+      loss = g2v_losses.cosine_arithmetic_loss(
+          pre, goal, post, mask=success)
+    scalars["embed_loss"] = loss
+    if self._ty_loss_weight:
+      ty = g2v_losses.ty_loss(inference_outputs["pregrasp_spatial"],
+                              inference_outputs["postgrasp_spatial"], goal)
+      scalars["ty_loss"] = ty
+      loss = loss + self._ty_loss_weight * ty
+    return loss, scalars
 
   def model_eval_fn(self, features, labels, inference_outputs):
     loss, scalars = self.model_train_fn(
@@ -140,7 +197,15 @@ class Grasp2VecModel(abstract_model.T2RModel):
     arithmetic = inference_outputs["arithmetic_embedding"]
     goal = inference_outputs["goal_embedding"]
     # Retrieval accuracy: does each arithmetic embedding rank its own
-    # goal first (reference keypoint/retrieval accuracy)?
+    # goal first (reference retrieval evaluation)?
     sims = arithmetic @ goal.T
     correct = jnp.argmax(sims, axis=-1) == jnp.arange(sims.shape[0])
-    return {"loss": loss, "retrieval_accuracy": correct.mean(), **scalars}
+    metrics = {"loss": loss, "retrieval_accuracy": correct.mean(),
+               **scalars}
+    if labels is not None and "keypoint_quadrant" in labels \
+        and labels["keypoint_quadrant"] is not None:
+      accuracy, keypoint_ce = g2v_losses.keypoint_accuracy(
+          inference_outputs["keypoints"], labels["keypoint_quadrant"])
+      metrics["keypoint_accuracy"] = accuracy
+      metrics["keypoint_ce"] = keypoint_ce
+    return metrics

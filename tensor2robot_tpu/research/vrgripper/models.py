@@ -36,8 +36,11 @@ from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
 __all__ = ["VRGripperPreprocessor", "VRGripperRegressionModel",
-           "VRGripperTECModel", "WTLTrialModel", "discretize_actions",
-           "undiscretize_actions", "episode_to_transitions"]
+           "VRGripperDomainAdaptiveModel", "VRGripperTECModel",
+           "WTLTrialModel", "WTLStateTrialModel", "WTLVisionTrialModel",
+           "pack_wtl_meta_features", "make_fixed_length",
+           "discretize_actions", "undiscretize_actions",
+           "episode_to_transitions"]
 
 
 @config.configurable
@@ -265,6 +268,584 @@ class WTLTrialModel(VRGripperRegressionModel):
         shape=(self._trial_length, 1), dtype=np.float32,
         name="trial_rewards", is_optional=True)
     return out
+
+
+class _DANetwork(nn.Module):
+  """Domain-adaptive imitation net with a learned inner-loop loss.
+
+  Reference `VRGripperDomainAdaptiveModel`
+  (/root/reference/research/vrgripper/vrgripper_env_models.py:326-443):
+  the inner (adaptation) forward conditions on video only — the gripper
+  pose input is zeroed or predicted from image features — while the outer
+  forward sees the real pose; the inner objective is a learned loss (conv1d
+  stack over the episode on [ll_action, feature_points, action]) whose
+  parameters are meta-trained by the outer behavioral-cloning loss.
+
+  `inner` is a static Python flag (two jit traces), the JAX analogue of
+  the reference's `params['is_inner_loop']`.
+  """
+
+  action_size: int = 7
+  num_feature_points: int = 32
+  predict_con_gripper_pose: bool = False
+  learned_loss_conv1d_layers: Optional[Tuple[int, ...]] = (10, 10, 6)
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False, inner: bool = False):
+    image = features["image"]  # [B, T, H, W, C]
+    if jnp.issubdtype(image.dtype, jnp.integer):
+      image = image.astype(jnp.float32) / 255.0
+    pose = features["gripper_pose"]
+
+    def per_frame(flat_image):
+      return vision.BerkeleyNet(
+          filters=(self.num_feature_points,),
+          kernel_sizes=(5,), strides=(2,), name="torso")(
+              flat_image, train=train)
+
+    feature_points = batch_utils.multi_batch_apply(per_frame, 2, image)
+
+    # Condition-pose head: params are created unconditionally so init sees
+    # them regardless of the `inner` trace (reference
+    # _predict_gripper_pose, :351-357).
+    pred = nn.Dense(40, use_bias=False, name="pose_fc")(feature_points)
+    pred = nn.LayerNorm(name="pose_ln")(nn.relu(pred))
+    predicted_pose = nn.Dense(pose.shape[-1], name="pose_out")(pred)
+
+    if inner:
+      used_pose = (predicted_pose if self.predict_con_gripper_pose
+                   else jnp.zeros_like(pose))
+    else:
+      used_pose = pose
+
+    x = jnp.concatenate([feature_points, used_pose.astype(
+        feature_points.dtype)], axis=-1)
+
+    def action_head(flat_x):
+      h = nn.relu(nn.Dense(128, name="fc")(flat_x))
+      return nn.Dense(self.action_size, name="action")(h)
+
+    action = batch_utils.multi_batch_apply(action_head, 2, x)
+
+    # Learned loss (reference model_train_fn inner branch, :421-443):
+    # a separate action predictor from feature points plus a conv1d stack
+    # over the episode; scalar = mean over batch of sum over (time, chan)
+    # of squared activations.
+    def ll_action_head(flat_fp):
+      h = nn.relu(nn.Dense(128, name="ll_fc")(flat_fp))
+      return nn.Dense(self.action_size, name="ll_action")(h)
+
+    ll_action = batch_utils.multi_batch_apply(ll_action_head, 2,
+                                              feature_points)
+    if self.learned_loss_conv1d_layers is None:
+      learned_loss = jnp.mean((ll_action - action) ** 2)
+    else:
+      net = jnp.concatenate([ll_action, feature_points, action], axis=-1)
+      for i, filters in enumerate(self.learned_loss_conv1d_layers[:-1]):
+        net = nn.Conv(filters, kernel_size=(10,), use_bias=False,
+                      padding="SAME", name=f"ll_conv_{i}")(net)
+        net = nn.LayerNorm(name=f"ll_ln_{i}")(nn.relu(net))
+      net = nn.Conv(self.learned_loss_conv1d_layers[-1], kernel_size=(1,),
+                    name="ll_conv_out")(net)
+      learned_loss = jnp.mean(jnp.sum(jnp.square(net), axis=(-2, -1)))
+
+    return specs_lib.SpecStruct({
+        "action": action,
+        "inference_output": action,
+        "feature_points": feature_points,
+        "predicted_pose": predicted_pose,
+        "learned_loss": learned_loss,
+    })
+
+
+@config.configurable
+class VRGripperDomainAdaptiveModel(VRGripperRegressionModel):
+  """Learned-loss domain-adaptive imitation (reference
+  vrgripper_env_models.py:326-443).
+
+  Designed to sit under `MAMLModel`: the MAML inner loop calls the
+  forward with `inner=True` (video-only conditioning) and adapts against
+  `inner_loop_loss_fn` (the learned loss, no labels needed); the outer
+  loop uses the real gripper pose and the standard BC loss, which is what
+  meta-trains the learned-loss parameters.
+  """
+
+  def __init__(self, predict_con_gripper_pose: bool = False,
+               learned_loss_conv1d_layers: Optional[Tuple[int, ...]]
+               = (10, 10, 6),
+               outer_loss_multiplier: float = 1.0, **kwargs):
+    kwargs.setdefault("num_mixture_components", 0)
+    super().__init__(**kwargs)
+    self._predict_con_gripper_pose = predict_con_gripper_pose
+    self._learned_loss_conv1d_layers = learned_loss_conv1d_layers
+    self._outer_loss_multiplier = outer_loss_multiplier
+
+  def get_feature_specification(self, mode):
+    out = super().get_feature_specification(mode)
+    # The condition-pose path needs the pose feature present (zeroed in
+    # the inner loop), so it is required here.
+    out["gripper_pose"] = out["gripper_pose"].replace(is_optional=False)
+    return out
+
+  def create_module(self):
+    return _DANetwork(
+        action_size=self._action_size,
+        predict_con_gripper_pose=self._predict_con_gripper_pose,
+        learned_loss_conv1d_layers=self._learned_loss_conv1d_layers)
+
+  # -- MAML integration hooks (see meta_learning/maml.py) -------------------
+
+  @property
+  def inner_loop_forward_kwargs(self):
+    return {"inner": True}
+
+  def inner_loop_loss_fn(self, features, labels, inference_outputs, mode):
+    del features, labels, mode
+    return inference_outputs["learned_loss"]
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    loss = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
+    loss = self._outer_loss_multiplier * loss
+    return loss, {"bc_mse": loss}
+
+
+# -- Watch-Try-Learn (reference vrgripper_env_wtl_models.py) -----------------
+
+
+class _WTLStateTrialNetwork(nn.Module):
+  """Low-dim WTL trial/retrial policy net (reference
+  VRGripperEnvSimpleTrialModel.inference_network_fn, wtl_models.py:212-284).
+
+  Features follow the meta layout: condition/{features,labels} with a
+  per-task episode dim E (E=1 trial, E=2 retrial: demo + prior trial) and
+  inference/features with episode dim I. The demo episode is embedded with
+  a learned temporal reduction ('temporal') or its final frame ('final');
+  the retrial path embeds the prior trial episode together with its
+  success labels and the demo embedding, and additionally feeds the trial
+  success sequence to the policy head.
+  """
+
+  action_size: int = 7
+  fc_embed_size: int = 32
+  num_mixture_components: int = 1
+  retrial: bool = False
+  ignore_embedding: bool = False
+  # 'temporal' | 'final' ('mean' accepted as the reference's name for the
+  # final-frame demo + per-frame-then-time-mean trial branch, :226-245).
+  embed_type: str = "temporal"
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    con_state = features["condition/features/full_state_pose"]  # [B,E,T,D]
+    con_success = 2.0 * features["condition/labels/success"] - 1.0
+    inf_state = features["inference/features/full_state_pose"]  # [B,I,T,D]
+    b, num_inference, t = inf_state.shape[:3]
+    if self.retrial and con_state.shape[1] != 2:
+      raise ValueError(
+          f"retrial expects 2 condition episodes, got {con_state.shape[1]}")
+
+    embed_type = ("final" if self.embed_type == "mean"
+                  else self.embed_type)
+    demo = con_state[:, 0]  # [B, T, D]
+    if embed_type == "temporal":
+      demo_emb = tec_lib.TemporalConvEmbedding(
+          self.fc_embed_size, name="demo_embedding")(demo)
+    elif embed_type == "final":
+      demo_emb = demo[:, -1]
+    else:
+      raise ValueError(f"Invalid embed_type: {self.embed_type!r}")
+
+    fc_embedding = demo_emb
+    if self.retrial:
+      trial = con_state[:, 1]          # [B, T, D]
+      trial_success = con_success[:, 1]  # [B, T, 1]
+      demo_tiled = jnp.broadcast_to(
+          demo_emb[:, None, :], (b, t, demo_emb.shape[-1]))
+      con_input = jnp.concatenate(
+          [trial, trial_success, demo_tiled], axis=-1)
+      if embed_type == "final":
+        # Per-frame embed then mean over time (reference 'mean' branch).
+        h = nn.relu(nn.Dense(self.fc_embed_size,
+                             name="trial_embedding_fc")(con_input))
+        trial_emb = h.mean(axis=-2)
+      else:
+        trial_emb = tec_lib.TemporalConvEmbedding(
+            self.fc_embed_size, name="trial_embedding")(con_input)
+      fc_embedding = jnp.concatenate([demo_emb, trial_emb], axis=-1)
+
+    emb_tiled = jnp.broadcast_to(
+        fc_embedding[:, None, None, :],
+        (b, num_inference, t, fc_embedding.shape[-1]))
+    if self.ignore_embedding:
+      fc_inputs = inf_state
+    else:
+      parts = [inf_state, emb_tiled]
+      if self.retrial:
+        parts.append(jnp.broadcast_to(
+            con_success[:, 1][:, None], (b, num_inference, t, 1)))
+      fc_inputs = jnp.concatenate(parts, axis=-1)
+
+    outputs = specs_lib.SpecStruct()
+
+    def head(flat_x):
+      h = nn.relu(nn.Dense(100, name="fc1")(flat_x))
+      h = nn.LayerNorm(name="ln1")(h)
+      if self.num_mixture_components > 1:
+        return mdn_lib.MDNHead(self.num_mixture_components,
+                               self.action_size, name="mdn")(h)
+      return nn.Dense(self.action_size, name="action")(h)
+
+    out = batch_utils.multi_batch_apply(head, 3, fc_inputs)
+    if self.num_mixture_components > 1:
+      outputs["mdn_params"] = out
+      outputs["action"] = mdn_lib.mdn_approximate_mode(out)
+    else:
+      outputs["action"] = out
+    outputs["inference_output"] = outputs["action"]
+    return outputs
+
+
+class _WTLVisionTrialNetwork(nn.Module):
+  """Vision WTL trial/retrial policy net (reference
+  VRGripperEnvVisionTrialModel, wtl_models.py:354-570): per-frame conv
+  embeddings of condition images + gripper pose reduced to a task
+  embedding; with 2+ condition episodes the prior trial (with success and
+  the demo embedding) contributes a second embedding (TEC-style)."""
+
+  action_size: int = 7
+  fc_embed_size: int = 32
+  num_feature_points: int = 32
+  num_mixture_components: int = 1
+  num_condition_episodes: int = 1
+  ignore_embedding: bool = False
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    torso = vision.BerkeleyNet(
+        filters=(self.num_feature_points,), kernel_sizes=(5,),
+        strides=(2,), name="image_embedding")
+
+    def _frames_to_features(images):
+      """[..., T, H, W, C] -> [..., T, F] shared per-frame conv torso."""
+      return batch_utils.multi_batch_apply(
+          lambda flat: torso(flat, train=train), images.ndim - 3, images)
+
+    con_images = features["condition/features/image"]  # [B,E,T,H,W,C]
+    con_pose = features["condition/features/gripper_pose"]  # [B,E,T,P]
+    con_success = 2.0 * features["condition/labels/success"] - 1.0
+    inf_images = features["inference/features/image"]  # [B,I,T,H,W,C]
+    inf_pose = features["inference/features/gripper_pose"]
+    if jnp.issubdtype(con_images.dtype, jnp.integer):
+      con_images = con_images.astype(jnp.float32) / 255.0
+    if jnp.issubdtype(inf_images.dtype, jnp.integer):
+      inf_images = inf_images.astype(jnp.float32) / 255.0
+    b, num_inference, t = inf_images.shape[:3]
+
+    demo_fp = _frames_to_features(con_images[:, 0])  # [B,T,F]
+    demo_in = jnp.concatenate(
+        [demo_fp, con_pose[:, 0].astype(demo_fp.dtype)], axis=-1)
+    embedding = tec_lib.TemporalConvEmbedding(
+        self.fc_embed_size, name="fc_demo_reduce")(demo_in)
+
+    if self.num_condition_episodes > 1:
+      trial_fp = _frames_to_features(con_images[:, 1])
+      demo_tiled = jnp.broadcast_to(
+          embedding[:, None, :], (b, t, embedding.shape[-1]))
+      trial_in = jnp.concatenate([
+          trial_fp, con_pose[:, 1].astype(trial_fp.dtype),
+          con_success[:, 1].astype(trial_fp.dtype), demo_tiled], axis=-1)
+      trial_embedding = tec_lib.TemporalConvEmbedding(
+          self.fc_embed_size, name="fc_trial_reduce")(trial_in)
+      embedding = jnp.concatenate([embedding, trial_embedding], axis=-1)
+
+    state_features = _frames_to_features(inf_images)  # [B, I, T, F]
+    emb_tiled = jnp.broadcast_to(
+        embedding[:, None, None, :],
+        (b, num_inference, t, embedding.shape[-1]))
+    if self.ignore_embedding:
+      fc_inputs = jnp.concatenate(
+          [state_features, inf_pose.astype(state_features.dtype)], axis=-1)
+    else:
+      fc_inputs = jnp.concatenate(
+          [state_features, inf_pose.astype(state_features.dtype),
+           emb_tiled.astype(state_features.dtype)], axis=-1)
+
+    outputs = specs_lib.SpecStruct()
+
+    def head(flat_x):
+      h = nn.relu(nn.Dense(100, name="fc1")(flat_x))
+      h = nn.LayerNorm(name="ln1")(h)
+      if self.num_mixture_components > 1:
+        return mdn_lib.MDNHead(self.num_mixture_components,
+                               self.action_size, name="mdn")(h)
+      return nn.Dense(self.action_size, name="action")(h)
+
+    out = batch_utils.multi_batch_apply(head, 3, fc_inputs)
+    if self.num_mixture_components > 1:
+      outputs["mdn_params"] = out
+      outputs["action"] = mdn_lib.mdn_approximate_mode(out)
+    else:
+      outputs["action"] = out
+    outputs["inference_output"] = outputs["action"]
+    return outputs
+
+
+class _WTLModelBase(abstract_model.T2RModel):
+  """Shared spec/loss scaffolding for WTL trial and retrial models.
+
+  Specs follow the reference contract: model inputs are the meta layout
+  (`create_maml_feature_spec` over episode specs, wtl_models.py:199-210)
+  and the wire format is `<prefix>_ep<i>/` columns handled by
+  `FixedLenMetaExamplePreprocessor` (:188-197).
+  """
+
+  def __init__(self, action_size: int = 7, episode_length: int = 8,
+               fc_embed_size: int = 32, num_mixture_components: int = 1,
+               num_condition_episodes: int = 1, ignore_embedding: bool = False,
+               **kwargs):
+    kwargs.setdefault("preprocessor_cls", None)
+    super().__init__(**kwargs)
+    self._action_size = action_size
+    self._episode_length = episode_length
+    self._fc_embed_size = fc_embed_size
+    self._num_mixture_components = num_mixture_components
+    self._num_condition_episodes = num_condition_episodes
+    self._ignore_embedding = ignore_embedding
+
+  # episode-level specs, overridden per concrete model ----------------------
+
+  def _episode_feature_specification(self, mode) -> SpecStruct:
+    raise NotImplementedError
+
+  def _episode_label_specification(self, mode) -> SpecStruct:
+    return SpecStruct({
+        "action": TensorSpec(
+            shape=(self._episode_length, self._action_size),
+            dtype=np.float32, name="action"),
+        "success": TensorSpec(
+            shape=(self._episode_length, 1), dtype=np.float32,
+            name="success"),
+    })
+
+  @property
+  def num_condition_episodes(self) -> int:
+    return self._num_condition_episodes
+
+  @property
+  def preprocessor(self):
+    """ep-column wire format -> meta layout (reference wtl preprocessor
+    property, :188-197)."""
+    from tensor2robot_tpu.meta_learning import preprocessors as meta_pre
+    if self._preprocessor is None:
+      base = preprocessors_lib.NoOpPreprocessor(
+          model_feature_specification_fn=self._episode_feature_specification,
+          model_label_specification_fn=self._episode_label_specification)
+      preprocessor = meta_pre.FixedLenMetaExamplePreprocessor(
+          base_preprocessor=base,
+          num_condition_episodes=self._num_condition_episodes)
+      if self._use_bfloat16:
+        preprocessor = preprocessors_lib.Bfloat16DevicePolicy(preprocessor)
+      self._preprocessor = preprocessor
+    return self._preprocessor
+
+  def get_feature_specification(self, mode):
+    from tensor2robot_tpu.meta_learning import maml
+    return maml.create_maml_feature_spec(
+        self._episode_feature_specification(mode),
+        self._episode_label_specification(mode),
+        num_condition_samples=self._num_condition_episodes,
+        num_inference_samples=1)
+
+  def get_label_specification(self, mode):
+    from tensor2robot_tpu.meta_learning import maml
+    return maml.create_maml_label_spec(
+        self._episode_label_specification(mode), num_inference_samples=1)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    target = labels["action"]
+    if self._num_mixture_components > 1:
+      params = inference_outputs["mdn_params"]
+      bc_loss = -mdn_lib.mdn_log_prob(params, target).mean()
+      return bc_loss, {"bc_nll": bc_loss}
+    bc_loss = jnp.mean((inference_outputs["action"] - target) ** 2)
+    return bc_loss, {"bc_mse": bc_loss}
+
+  def model_eval_fn(self, features, labels, inference_outputs):
+    loss, scalars = self.model_train_fn(
+        features, labels, inference_outputs, modes_lib.EVAL)
+    mae = jnp.abs(inference_outputs["action"] - labels["action"]).mean()
+    return {"loss": loss, "mae": mae, **scalars}
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    raise NotImplementedError
+
+
+@config.configurable
+class WTLStateTrialModel(_WTLModelBase):
+  """WTL low-dim trial (retrial=False) / retrial (retrial=True) model
+  (reference VRGripperEnvSimpleTrialModel, wtl_models.py:135-351)."""
+
+  def __init__(self, obs_size: int = 32, retrial: bool = False,
+               embed_type: str = "temporal", **kwargs):
+    if retrial:
+      kwargs["num_condition_episodes"] = 2
+    super().__init__(**kwargs)
+    self._obs_size = obs_size
+    self._retrial = retrial
+    self._embed_type = embed_type
+
+  def _episode_feature_specification(self, mode):
+    del mode
+    return SpecStruct({
+        "full_state_pose": TensorSpec(
+            shape=(self._episode_length, self._obs_size),
+            dtype=np.float32, name="full_state_pose"),
+    })
+
+  def create_module(self):
+    return _WTLStateTrialNetwork(
+        action_size=self._action_size,
+        fc_embed_size=self._fc_embed_size,
+        num_mixture_components=self._num_mixture_components,
+        retrial=self._retrial,
+        ignore_embedding=self._ignore_embedding,
+        embed_type=self._embed_type)
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    return pack_wtl_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition_episodes, vision=False)
+
+
+@config.configurable
+class WTLVisionTrialModel(_WTLModelBase):
+  """WTL vision trial/retrial model (reference
+  VRGripperEnvVisionTrialModel, wtl_models.py:354-570); retrial behavior
+  turns on with num_condition_episodes > 1, matching the reference."""
+
+  def __init__(self, image_size: int = 48, pose_size: int = 7, **kwargs):
+    super().__init__(**kwargs)
+    self._image_size = image_size
+    self._pose_size = pose_size
+
+  def _episode_feature_specification(self, mode):
+    del mode
+    return SpecStruct({
+        "image": TensorSpec(
+            shape=(self._episode_length, self._image_size,
+                   self._image_size, 3),
+            dtype=np.float32, name="image", data_format="jpeg"),
+        "gripper_pose": TensorSpec(
+            shape=(self._episode_length, self._pose_size),
+            dtype=np.float32, name="gripper_pose"),
+    })
+
+  def create_module(self):
+    return _WTLVisionTrialNetwork(
+        action_size=self._action_size,
+        fc_embed_size=self._fc_embed_size,
+        num_mixture_components=self._num_mixture_components,
+        num_condition_episodes=self._num_condition_episodes,
+        ignore_embedding=self._ignore_embedding)
+
+  def pack_features(self, state, prev_episode_data, timestep):
+    return pack_wtl_meta_features(
+        state, prev_episode_data, timestep, self._episode_length,
+        self._num_condition_episodes, vision=True)
+
+
+def make_fixed_length(episode_data, fixed_length: int,
+                      randomized: bool = False, rng=None):
+  """Subsamples/pads a list of per-step transition tuples to fixed_length
+  (reference episode_to_transitions.make_fixed_length)."""
+  n = len(episode_data)
+  if n == 0:
+    raise ValueError("episode_data is empty")
+  if n == fixed_length:
+    return list(episode_data)
+  if randomized:
+    rng = rng or np.random
+    if n > fixed_length:
+      idx = np.sort(rng.choice(n, size=fixed_length, replace=False))
+    else:
+      idx = np.sort(rng.choice(n, size=fixed_length, replace=True))
+  else:
+    idx = np.linspace(0, n - 1, fixed_length).round().astype(int)
+  return [episode_data[i] for i in idx]
+
+
+def pack_wtl_meta_features(state, prev_episode_data, timestep,
+                           fixed_length: int,
+                           num_condition_episodes: int,
+                           vision: bool = False,
+                           deterministic_condition: bool = True
+                           ) -> SpecStruct:
+  """Packs the current observation + prior episodes into the meta layout
+  (reference pack_wtl_meta_features, wtl_models.py:41-132).
+
+  `state` carries `.image`/`.pose` (vision) or `.full_state_pose`;
+  `prev_episode_data` is a list of episodes, each a list of
+  (obs, action, reward, ...) transition tuples — episode 0 the demo,
+  episode 1 the first trial, etc. Output leaves all have leading
+  [1 (task), E or I, fixed_length, ...] dims matching the models' input
+  specs (the post-preprocessor layout, which is what predictors feed).
+  """
+  del timestep
+  if len(prev_episode_data) < 1:
+    raise ValueError(
+        "prev_episode_data should at least contain one (demo) episode.")
+  out = specs_lib.SpecStruct()
+
+  def _as_image(x):
+    """uint8 camera frames -> the [0, 1] float32 range the models train
+    on (spec dtype float32; the normalization guard in the networks only
+    fires for integer dtypes)."""
+    x = np.asarray(x)
+    if np.issubdtype(x.dtype, np.integer):
+      return x.astype(np.float32) / 255.0
+    return x.astype(np.float32)
+
+  def _tile_inference(x):
+    return np.tile(np.asarray(x), [fixed_length] + [1] * np.ndim(x))
+
+  if vision:
+    out["inference/features/image"] = _as_image(
+        _tile_inference(state.image))[None, None]
+    out["inference/features/gripper_pose"] = _tile_inference(
+        state.pose)[None, None].astype(np.float32)
+  else:
+    out["inference/features/full_state_pose"] = _tile_inference(
+        state.full_state_pose)[None, None].astype(np.float32)
+
+  con_obs, con_pose, con_actions, con_success = [], [], [], []
+  for i in range(num_condition_episodes):
+    episode = prev_episode_data[i % len(prev_episode_data)]
+    episode = make_fixed_length(
+        episode, fixed_length, randomized=not deterministic_condition)
+    if vision:
+      con_obs.append(np.stack([t[0].image for t in episode]))
+      con_pose.append(np.stack([t[0].pose for t in episode]))
+    else:
+      con_obs.append(np.stack([t[0].full_state_pose for t in episode]))
+    con_actions.append(np.stack([np.asarray(t[1], np.float32)
+                                 for t in episode]))
+    cumulative_return = float(np.sum([t[2] for t in episode]))
+    con_success.append(
+        float(cumulative_return > 0) * np.ones((fixed_length, 1),
+                                               np.float32))
+  if vision:
+    out["condition/features/image"] = _as_image(np.stack(con_obs))[None]
+    out["condition/features/gripper_pose"] = np.stack(con_pose)[None].astype(
+        np.float32)
+  else:
+    out["condition/features/full_state_pose"] = np.stack(
+        con_obs)[None].astype(np.float32)
+  out["condition/labels/action"] = np.stack(con_actions)[None]
+  out["condition/labels/success"] = np.stack(con_success)[None]
+  return out
 
 
 # -- discrete action binning (reference discrete.py:30-140) -----------------

@@ -51,6 +51,11 @@ _EXTRA = {
     "train_vrgripper_mdn.gin": ["VRGripperRegressionModel.episode_length = 2",
                                 "VRGripperRegressionModel.image_size = 32",
                                 "VRGripperRegressionModel.device_type = 'cpu'"],
+    "train_wtl_retrial.gin": ["WTLStateTrialModel.episode_length = 4",
+                              "WTLStateTrialModel.obs_size = 8"],
+    "train_vrgripper_da_maml.gin": [
+        "VRGripperDomainAdaptiveModel.episode_length = 2",
+        "VRGripperDomainAdaptiveModel.image_size = 16"],
 }
 
 
@@ -65,7 +70,8 @@ def test_all_config_families_present():
   names = {os.path.basename(p) for p in ALL_CONFIGS}
   assert {"train_pose_regression.gin", "train_qtopt.gin", "train_bcz.gin",
           "train_grasp2vec.gin", "train_vrgripper_mdn.gin",
-          "train_wtl_maml.gin"} <= names
+          "train_wtl_maml.gin", "train_wtl_retrial.gin",
+          "train_vrgripper_da_maml.gin"} <= names
 
 
 @pytest.mark.parametrize(

@@ -1,0 +1,208 @@
+"""Semantic tests for the Grasp2Vec loss family
+(reference /root/reference/research/grasp2vec/losses.py:29-304)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.parallel import train_step as ts
+from tensor2robot_tpu.research.grasp2vec import losses as g2v
+from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+
+
+def _embeddings(seed=0, n=6, d=8):
+  rng = np.random.RandomState(seed)
+  goal = rng.randn(n, d).astype(np.float32)
+  post = rng.randn(n, d).astype(np.float32)
+  pre = goal + post  # satisfies pre - goal - post = 0 exactly
+  return jnp.asarray(pre), jnp.asarray(goal), jnp.asarray(post)
+
+
+class TestArithmeticLosses:
+
+  def test_l2_zero_when_arithmetic_holds(self):
+    pre, goal, post = _embeddings()
+    assert float(g2v.l2_arithmetic_loss(pre, goal, post)) == pytest.approx(
+        0.0, abs=1e-10)
+    # Perturbing pre raises the loss by ||delta||^2 per example.
+    loss = g2v.l2_arithmetic_loss(pre + 2.0, goal, post)
+    assert float(loss) == pytest.approx(4.0 * pre.shape[1], rel=1e-5)
+
+  def test_l2_mask_selects_examples(self):
+    pre, goal, post = _embeddings()
+    pre = pre.at[0].add(10.0)  # corrupt example 0
+    mask_without = jnp.array([0, 1, 1, 1, 1, 1])
+    mask_with = jnp.ones(6)
+    assert float(g2v.l2_arithmetic_loss(
+        pre, goal, post, mask_without)) == pytest.approx(0.0, abs=1e-8)
+    assert float(g2v.l2_arithmetic_loss(pre, goal, post, mask_with)) > 10.0
+    # All-zero mask -> exactly 0 (reference tf.cond branch).
+    assert float(g2v.l2_arithmetic_loss(
+        pre, goal, post, jnp.zeros(6))) == 0.0
+
+  def test_cosine_zero_when_directions_match(self):
+    rng = np.random.RandomState(0)
+    post = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    goal = jnp.asarray(rng.randn(4, 8).astype(np.float32))
+    pre = post + 3.0 * goal  # pre - post parallel to goal
+    assert float(g2v.cosine_arithmetic_loss(pre, goal, post)
+                 ) == pytest.approx(0.0, abs=1e-6)
+    anti = post - 3.0 * goal  # anti-parallel -> distance 2
+    assert float(g2v.cosine_arithmetic_loss(anti, goal, post)
+                 ) == pytest.approx(2.0, abs=1e-5)
+
+
+class TestContrastiveLosses:
+
+  def test_triplet_prefers_matched_pairs(self):
+    pre, goal, post = _embeddings(n=8)
+    loss_matched, pairs, labels = g2v.triplet_loss(pre, goal, post)
+    # Shuffle goals so arithmetic embeddings point at wrong goals.
+    perm = jnp.asarray(np.roll(np.arange(8), 1))
+    loss_mismatched, _, _ = g2v.triplet_loss(pre, goal[perm], post)
+    assert pairs.shape == (16, 8) and labels.shape == (16,)
+    assert float(loss_matched) < float(loss_mismatched)
+
+  def test_npairs_bidirectional_prefers_matched(self):
+    pre, goal, post = _embeddings(n=8)
+    matched = g2v.npairs_loss_bidirectional(5.0 * pre, 5.0 * goal,
+                                            5.0 * post)
+    perm = jnp.asarray(np.roll(np.arange(8), 1))
+    mismatched = g2v.npairs_loss_bidirectional(5.0 * pre, 5.0 * goal[perm],
+                                               5.0 * post)
+    assert float(matched) < float(mismatched)
+
+  def test_npairs_non_negativity_constraint(self):
+    pre, goal, post = _embeddings()
+    a = g2v.npairs_loss_bidirectional(pre, goal, post,
+                                      non_negativity_constraint=True)
+    b = g2v.npairs_loss_bidirectional(pre, goal, post)
+    # relu changes pair_a wherever pre - post < 0
+    assert float(a) != float(b)
+
+  def test_npairs_multilabel_groups_failures(self):
+    pre, goal, post = _embeddings(n=6)
+    all_success = jnp.ones((6, 1))
+    # With all grasps successful, multilabel reduces to (almost) the
+    # standard diagonal-target npairs: labels are [0*1, 1, 2, ...] --
+    # example 0 keeps label 0 either way.
+    base = g2v.npairs_loss_multilabel(pre, goal, post, all_success)
+    some_failed = jnp.asarray([[1], [0], [0], [1], [1], [1]],
+                              dtype=jnp.float32)
+    grouped = g2v.npairs_loss_multilabel(pre, goal, post, some_failed)
+    assert np.isfinite(float(base)) and np.isfinite(float(grouped))
+    assert float(base) != float(grouped)
+
+
+class TestKeypointAndSpatial:
+
+  def test_keypoint_accuracy_perfect_and_wrong(self):
+    # Quadrant centers: 0:(x>0,y<0) 1:(x<0,y<0) 2:(x>0,y>0) 3:(x<0,y>0)
+    keypoints = jnp.array([[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5],
+                           [-0.5, 0.5]])
+    labels = jnp.array([0, 1, 2, 3])
+    accuracy, ce = g2v.keypoint_accuracy(keypoints, labels)
+    assert float(accuracy) == 1.0
+    wrong = jnp.array([3, 2, 1, 0])
+    accuracy_wrong, ce_wrong = g2v.keypoint_accuracy(keypoints, wrong)
+    assert float(accuracy_wrong) == 0.0
+    assert float(ce_wrong) > float(ce)
+
+  def test_heatmap_keypoints_localize_peak(self):
+    heat = np.full((1, 9, 9), -10.0, np.float32)
+    heat[0, 1, 7] = 10.0  # top area (low y index) and right (high x)
+    kp = np.asarray(g2v.heatmap_keypoints(jnp.asarray(heat)))[0]
+    assert kp[0] > 0.5   # x right
+    assert kp[1] < -0.5  # y toward index 0
+  def test_get_softmax_response_detects_presence(self):
+    rng = np.random.RandomState(0)
+    goal = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+    scene = jnp.asarray(rng.randn(2, 5, 5, 4).astype(np.float32) * 0.01)
+    # Plant goal 0's embedding into scene 0 only.
+    scene = scene.at[0, 2, 3].set(goal[0])
+    max_heat, max_soft = g2v.get_softmax_response(goal, scene)
+    assert float(max_heat[0]) > float(max_heat[1])
+    assert 0.0 <= float(max_soft[1]) <= 1.0
+
+  def test_ty_loss_sign(self):
+    rng = np.random.RandomState(0)
+    goal = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+    weak = jnp.asarray(rng.randn(2, 5, 5, 4).astype(np.float32) * 0.01)
+    strong = weak.at[:, 1, 1].set(goal * 10.0)
+    # Object in pregrasp, gone in postgrasp -> negative loss (good).
+    assert float(g2v.ty_loss(strong, weak, goal)) < 0.0
+    # Object appears only in postgrasp -> positive loss (penalized).
+    assert float(g2v.ty_loss(weak, strong, goal)) > 0.0
+
+  def test_norm_regularizers(self):
+    anchors = jnp.ones((3, 4)) * 2.0
+    paired = jnp.ones((3, 4))
+    loss = g2v.match_norms_loss(anchors, paired)
+    assert float(loss) == pytest.approx(0.5 * (4.0 - 2.0) ** 2, rel=1e-5)
+    grad = jax.grad(
+        lambda p: g2v.match_norms_loss(anchors, p))(paired)
+    assert np.abs(np.asarray(grad)).max() > 0
+    # No gradient flows into the anchor.
+    grad_anchor = jax.grad(
+        lambda a: g2v.match_norms_loss(a, paired))(anchors)
+    assert np.abs(np.asarray(grad_anchor)).max() == 0
+    zero_loss = g2v.send_to_zero_loss(paired, jnp.array([1, 1, 0]))
+    assert float(zero_loss) == pytest.approx(2.0, rel=1e-5)
+
+
+class TestModelIntegration:
+
+  def _batch(self, model, batch=8, seed=0):
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification(modes.TRAIN), batch_size=batch,
+        seed=seed)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification(modes.TRAIN), batch_size=batch,
+        seed=seed + 1)
+    labels["grasp_success"] = np.ones((batch, 1), np.float32)
+    labels["keypoint_quadrant"] = np.zeros((batch,), np.int64)
+    return features, labels
+
+  @pytest.mark.parametrize("loss_type", g2v_models.Grasp2VecModel.LOSS_TYPES)
+  def test_every_loss_type_trains(self, loss_type):
+    model = g2v_models.Grasp2VecModel(
+        image_size=16, embedding_size=8, loss_type=loss_type,
+        device_type="cpu", optimizer_fn=lambda: optax.adam(1e-3))
+    features, labels = self._batch(model)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model, donate=False)
+    state, metrics = step(state, features, labels)
+    assert np.isfinite(float(metrics["loss"])), loss_type
+    assert "embed_loss" in metrics
+
+  def test_eval_reports_keypoint_accuracy(self):
+    model = g2v_models.Grasp2VecModel(image_size=16, embedding_size=8,
+                                      device_type="cpu")
+    features, labels = self._batch(model, batch=4)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    eval_step = ts.make_eval_step(model)
+    metrics = eval_step(state, features, labels)
+    assert "keypoint_accuracy" in metrics
+    assert "retrieval_accuracy" in metrics
+    assert 0.0 <= float(metrics["keypoint_accuracy"]) <= 1.0
+
+  def test_ty_loss_weight_included(self):
+    model = g2v_models.Grasp2VecModel(
+        image_size=16, embedding_size=8, ty_loss_weight=0.5,
+        device_type="cpu")
+    features, labels = self._batch(model, batch=4)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    outputs, _ = model.inference_network_fn(variables, features, modes.TRAIN)
+    loss, scalars = model.model_train_fn(
+        features, labels, outputs, modes.TRAIN)
+    assert "ty_loss" in scalars
+    assert float(loss) == pytest.approx(
+        float(scalars["embed_loss"]) + 0.5 * float(scalars["ty_loss"]),
+        rel=1e-5)
+
+  def test_invalid_loss_type_raises(self):
+    with pytest.raises(ValueError):
+      g2v_models.Grasp2VecModel(loss_type="nope", device_type="cpu")

@@ -104,7 +104,7 @@ class TestGrasp2Vec:
     step = ts.make_train_step(model)
     state, metrics = step(state, features, specs_lib.SpecStruct())
     assert np.isfinite(float(metrics["loss"]))
-    assert "npairs" in metrics
+    assert "embed_loss" in metrics
 
   def test_outputs_and_heatmap_shapes(self):
     model = g2v_models.Grasp2VecModel(image_size=32, device_type="cpu")
