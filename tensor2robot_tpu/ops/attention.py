@@ -79,10 +79,35 @@ def _finalize(o, l):
 
 
 # -- Pallas flash attention --------------------------------------------------
+#
+# Forward: FlashAttention online softmax; also emits the per-row
+# logsumexp needed by the backward. Backward: FlashAttention-2 style
+# recompute kernels (one producing dQ over the q-block grid, one
+# producing dK/dV over the k-block grid) — the [T, T] score matrix never
+# materializes in HBM in either direction. Sequences that don't tile are
+# PADDED to the block size and masked (never a silent O(T^2) fallback).
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
-                  causal: bool, q_block: int):
+def _valid_mask(q_start, k_start, q_block, k_block, causal: bool,
+                valid_len: int, padded_len: int):
+  """Score-entry validity: causal triangle + key/query padding."""
+  if not causal and valid_len == padded_len:
+    return None
+  q_pos = q_start + jax.lax.broadcasted_iota(
+      jnp.int32, (q_block, k_block), 0)
+  k_pos = k_start + jax.lax.broadcasted_iota(
+      jnp.int32, (q_block, k_block), 1)
+  mask = jnp.ones((q_block, k_block), bool)
+  if causal:
+    mask &= q_pos >= k_pos
+  if valid_len != padded_len:
+    mask &= (k_pos < valid_len) & (q_pos < valid_len)
+  return mask
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                      block_k: int, causal: bool, q_block: int,
+                      valid_len: int):
   """One (batch*head, q_block) program: stream K/V blocks through VMEM."""
   q = q_ref[:]  # [block_q, D]
   tq_idx = pl.program_id(1)
@@ -98,13 +123,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     m, l, o = carry
     k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
     v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-    mask = None
-    if causal:
-      q_pos = tq_idx * q_block + jax.lax.broadcasted_iota(
-          jnp.int32, (q_block, block_k), 0)
-      k_pos = kb * block_k + jax.lax.broadcasted_iota(
-          jnp.int32, (q_block, block_k), 1)
-      mask = q_pos >= k_pos
+    mask = _valid_mask(tq_idx * q_block, kb * block_k, q_block, block_k,
+                       causal, valid_len, seq_len)
     return _online_block_update(q, k_blk, v_blk, m, l, o, mask)
 
   m0 = jnp.full((q_block,), -jnp.inf, jnp.float32)
@@ -112,43 +132,250 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
   o0 = jnp.zeros((q_block, q.shape[-1]), jnp.float32)
   m, l, o = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, o0))
   o_ref[:] = _finalize(o, l).astype(o_ref.dtype)
+  # logsumexp per query row. Fully-masked (padded) rows would otherwise
+  # carry lse = mask_value + log(block) ~ -1e38, making the backward
+  # recompute exp(s - lse) overflow before its own mask zeroes it; pin
+  # those rows to 0 (their p is masked to 0 in the backward anyway).
+  # Validity is positional: a row is real iff its query index < valid_len
+  # (for causal rows the diagonal entry is always unmasked, so l > 0).
+  q_pos = tq_idx * q_block + jax.lax.iota(jnp.int32, q_block)
+  row_valid = q_pos < valid_len
+  lse_ref[:] = jnp.where(row_valid,
+                         m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool,
+                         q_block: int, valid_len: int):
+  """dQ for one q block: dS = P * (dO.V^T - delta); dQ = scale * dS.K."""
+  scale = 1.0 / math.sqrt(q_ref.shape[-1])
+  q = q_ref[:]
+  do = do_ref[:].astype(jnp.float32)
+  lse = lse_ref[:]
+  delta = delta_ref[:]
+  tq_idx = pl.program_id(1)
+  seq_len = k_ref.shape[0]
+  num_k_blocks = seq_len // block_k
+  if causal:
+    num_k_blocks = jnp.minimum(
+        num_k_blocks,
+        ((tq_idx + 1) * q_block + block_k - 1) // block_k)
+
+  def body(kb, dq):
+    k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
+    v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
+    s = (q @ k_blk.T).astype(jnp.float32) * scale
+    p = jnp.exp(s - lse[:, None])
+    mask = _valid_mask(tq_idx * q_block, kb * block_k, q_block, block_k,
+                       causal, valid_len, seq_len)
+    if mask is not None:
+      p = jnp.where(mask, p, 0.0)
+    dp = do @ v_blk.T.astype(jnp.float32)
+    ds = p * (dp - delta[:, None]) * scale
+    return dq + ds @ k_blk.astype(jnp.float32)
+
+  dq0 = jnp.zeros((q_block, q.shape[-1]), jnp.float32)
+  dq_ref[:] = jax.lax.fori_loop(0, num_k_blocks, body, dq0).astype(
+      dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          k_block: int, valid_len: int):
+  """dK/dV for one k block: dV = P^T.dO; dK = scale * dS^T.Q."""
+  scale = 1.0 / math.sqrt(q_ref.shape[-1])
+  k_blk = k_ref[:]
+  v_blk = v_ref[:]
+  tk_idx = pl.program_id(1)
+  seq_len = q_ref.shape[0]
+  num_q_blocks = seq_len // block_q
+  start_q = 0
+  if causal:
+    # Blocks strictly above the diagonal see no unmasked entries.
+    start_q = (tk_idx * k_block) // block_q
+
+  def body(qb, carry):
+    dk, dv = carry
+    q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
+    do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+    lse_blk = lse_ref[pl.ds(qb * block_q, block_q)]
+    delta_blk = delta_ref[pl.ds(qb * block_q, block_q)]
+    s = (q_blk @ k_blk.T).astype(jnp.float32) * scale
+    p = jnp.exp(s - lse_blk[:, None])
+    mask = _valid_mask(qb * block_q, tk_idx * k_block, block_q, k_block,
+                       causal, valid_len, seq_len)
+    if mask is not None:
+      p = jnp.where(mask, p, 0.0)
+    dv = dv + p.T @ do_blk
+    dp = do_blk @ v_blk.T.astype(jnp.float32)
+    ds = p * (dp - delta_blk[:, None]) * scale
+    dk = dk + ds.T @ q_blk.astype(jnp.float32)
+    return dk, dv
+
+  dk0 = jnp.zeros((k_block, k_blk.shape[-1]), jnp.float32)
+  dv0 = jnp.zeros((k_block, v_blk.shape[-1]), jnp.float32)
+  dk, dv = jax.lax.fori_loop(start_q, num_q_blocks, body, (dk0, dv0))
+  dk_ref[:] = dk.astype(dk_ref.dtype)
+  dv_ref[:] = dv.astype(dv_ref.dtype)
 
 
 try:  # Pallas import kept soft so CPU-only deployments still import us.
   from jax.experimental import pallas as pl
-  from jax.experimental.pallas import tpu as pltpu
+  from jax.experimental.pallas import tpu as pltpu  # noqa: F401
 
   _HAS_PALLAS = True
 except Exception:  # pragma: no cover
   _HAS_PALLAS = False
 
 
+def _flash_forward(q3, k3, v3, causal, block_q, block_k, valid_len,
+                   interpret):
+  bh, t, d = q3.shape
+  kernel = functools.partial(
+      _flash_fwd_kernel, block_k=block_k, causal=causal, q_block=block_q,
+      valid_len=valid_len)
+  out, lse = pl.pallas_call(
+      kernel,
+      grid=(bh, t // block_q),
+      in_specs=[
+          pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
+          pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
+          pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
+      ],
+      out_specs=[
+          pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
+          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+          jax.ShapeDtypeStruct((bh, t), jnp.float32),
+      ],
+      interpret=interpret,
+  )(q3, k3, v3)
+  return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _flash(causal: bool, block_q: int, block_k: int, valid_len: int,
+           interpret: bool, q3, k3, v3):
+  out, _ = _flash_forward(q3, k3, v3, causal, block_q, block_k,
+                          valid_len, interpret)
+  return out
+
+
+def _flash_fwd(causal, block_q, block_k, valid_len, interpret, q3, k3, v3):
+  out, lse = _flash_forward(q3, k3, v3, causal, block_q, block_k,
+                            valid_len, interpret)
+  return out, (q3, k3, v3, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, valid_len, interpret, residuals,
+               g):
+  q3, k3, v3, out, lse = residuals
+  bh, t, d = q3.shape
+  # delta_i = sum_d dO_id * O_id (FlashAttention-2 backward precompute).
+  delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+  dq_kernel = functools.partial(
+      _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
+      q_block=block_q, valid_len=valid_len)
+  dq = pl.pallas_call(
+      dq_kernel,
+      grid=(bh, t // block_q),
+      in_specs=[
+          pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
+          pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
+          pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
+          pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
+          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
+          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
+      ],
+      out_specs=pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
+      out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+      interpret=interpret,
+  )(q3, k3, v3, g, lse, delta)
+  dkv_kernel = functools.partial(
+      _flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
+      k_block=block_k, valid_len=valid_len)
+  dk, dv = pl.pallas_call(
+      dkv_kernel,
+      grid=(bh, t // block_k),
+      in_specs=[
+          pl.BlockSpec((None, t, d), lambda b, kb: (b, 0, 0)),
+          pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
+          pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
+          pl.BlockSpec((None, t, d), lambda b, kb: (b, 0, 0)),
+          pl.BlockSpec((None, t), lambda b, kb: (b, 0)),
+          pl.BlockSpec((None, t), lambda b, kb: (b, 0)),
+      ],
+      out_specs=[
+          pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
+          pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
+      ],
+      out_shape=[
+          jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+          jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+      ],
+      interpret=interpret,
+  )(q3, k3, v3, g, lse, delta)
+  return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _next_pow2(n: int) -> int:
+  return 1 << (n - 1).bit_length()
+
+
+def _pow2_floor(n: int) -> int:
+  return 1 << (n.bit_length() - 1)
+
+
+# Minimum block edge: Mosaic tiles f32 at (8, 128); sub-8 q/k blocks can
+# fail to compile on real TPU hardware (CPU tests run the interpreter and
+# would not catch it).
+_MIN_BLOCK = 8
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False,
                     block_q: int = 128, block_k: int = 128,
-                    interpret: bool = False) -> jnp.ndarray:
-  """Pallas flash attention, [B, H, T, D]; falls back to `attention`
-  when the sequence doesn't tile or Pallas is unavailable."""
+                    interpret: Optional[bool] = None) -> jnp.ndarray:
+  """Pallas flash attention, [B, H, T, D]. Fully differentiable
+  (custom FlashAttention-2 backward kernels).
+
+  Sequences that don't tile the block size are padded to the next block
+  multiple and masked — never a silent O(T^2) fallback. `interpret=None`
+  auto-selects: real kernels on TPU, interpreter elsewhere (CPU tests).
+  Cross-attention (Tq != Tk) falls back to the reference implementation
+  (the kernels assume self-attention layout).
+  """
   b, h, t, d = q.shape
-  if (not _HAS_PALLAS) or t % block_q or t % block_k:
+  if not _HAS_PALLAS:
     return attention(q, k, v, causal=causal)
+  if k.shape[2] != t:
+    return attention(q, k, v, causal=causal)
+  if interpret is None:
+    interpret = jax.default_backend() != "tpu"
+  # Normalize blocks to powers of two in [_MIN_BLOCK, next_pow2(T)]: the
+  # padding arithmetic below relies on lcm(bq, bk) == max(bq, bk), which
+  # only holds for powers of two.
+  eff_bq = max(_MIN_BLOCK, min(_pow2_floor(block_q), _next_pow2(t)))
+  eff_bk = max(_MIN_BLOCK, min(_pow2_floor(block_k), _next_pow2(t)))
+  tile = max(eff_bq, eff_bk)
+  t_pad = ((t + tile - 1) // tile) * tile
+  assert t_pad % eff_bq == 0 and t_pad % eff_bk == 0
   q3 = q.reshape(b * h, t, d)
   k3 = k.reshape(b * h, t, d)
   v3 = v.reshape(b * h, t, d)
-  kernel = functools.partial(_flash_kernel, block_k=block_k,
-                             causal=causal, q_block=block_q)
-  out = pl.pallas_call(
-      kernel,
-      grid=(b * h, t // block_q),
-      in_specs=[
-          pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-          pl.BlockSpec((None, t, d), lambda bh, qb: (bh, 0, 0)),
-          pl.BlockSpec((None, t, d), lambda bh, qb: (bh, 0, 0)),
-      ],
-      out_specs=pl.BlockSpec((None, block_q, d), lambda bh, qb: (bh, qb, 0)),
-      out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-      interpret=interpret,
-  )(q3, k3, v3)
+  if t_pad != t:
+    pad = ((0, 0), (0, t_pad - t), (0, 0))
+    q3 = jnp.pad(q3, pad)
+    k3 = jnp.pad(k3, pad)
+    v3 = jnp.pad(v3, pad)
+  out = _flash(causal, eff_bq, eff_bk, t, interpret, q3, k3, v3)
+  if t_pad != t:
+    out = out[:, :t]
   return out.reshape(b, h, t, d)
 
 
