@@ -3,18 +3,26 @@
 Beyond the reference (SURVEY.md §2.5: EP absent there): a top-k routed
 MoE whose expert parameters carry a leading expert dim sharded over a
 mesh axis — expert parallelism falls out of the sharding annotation, with
-XLA inserting the dispatch/combine collectives.
+XLA inserting the dispatch/combine collectives (all_to_all-class traffic
+over ICI when experts and tokens live on different axes).
 
 Design notes for TPU:
-* dense dispatch (one-hot combine einsums) — static shapes, MXU-friendly,
-  exact; capacity-factor token dropping is unnecessary at robot-model
-  scales;
+* two dispatch modes, both static-shaped and MXU-friendly:
+  - `dense`: every expert computes every token, the gate zeroes the rest.
+    Exact, collective-free, right for few-expert robot-scale models.
+  - `sparse`: GShard/Switch-style capacity routing. Tokens are packed into
+    per-expert [capacity] slots via one-hot dispatch/combine einsums;
+    expert FLOPs are O(E * capacity) = O(N * capacity_factor) instead of
+    O(E * N), and over-capacity tokens are dropped (their gate mass
+    renormalizes away). With `experts_*` sharded over a mesh axis the
+    ecf/eco einsums become the all_to_all dispatch/combine.
 * router in float32 for numerics, experts in the compute dtype;
 * auxiliary load-balancing loss (Switch-style) returned alongside.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import flax.linen as nn
@@ -37,11 +45,15 @@ class MixtureOfExperts(nn.Module):
   output_size: int = 64
   top_k: int = 1
   router_noise: float = 0.0
+  dispatch: str = "dense"  # 'dense' | 'sparse'
+  capacity_factor: float = 1.25  # sparse only
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, train: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (output, aux_load_balancing_loss)."""
+    if self.dispatch not in ("dense", "sparse"):
+      raise ValueError(f"Unknown dispatch mode {self.dispatch!r}")
     leading = x.shape[:-1]
     features = x.shape[-1]
     tokens = x.reshape(-1, features)
@@ -53,13 +65,7 @@ class MixtureOfExperts(nn.Module):
       router_logits = router_logits + self.router_noise * jax.random.normal(
           noise_key, router_logits.shape)
     probs = jax.nn.softmax(router_logits, axis=-1)  # [N, E]
-
-    # top-k gate: renormalized over the selected experts.
     top_probs, top_idx = jax.lax.top_k(probs, self.top_k)
-    gates = jnp.zeros_like(probs)
-    gates = jax.vmap(lambda g, i, p: g.at[i].set(p))(gates, top_idx,
-                                                     top_probs)
-    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
 
     # Expert-major params: [E, in, hidden], [E, hidden, out] — the leading
     # expert dim is what EP shards.
@@ -72,19 +78,64 @@ class MixtureOfExperts(nn.Module):
     b2 = self.param("experts_b2", nn.initializers.zeros,
                     (self.num_experts, 1, self.output_size))
 
-    # Dense dispatch: every expert sees every token, the gate zeroes the
-    # rest. [E, N, F] x [E, F, H] batched matmuls ride the MXU; with w1/w2
-    # sharded over experts XLA turns the combine into a reduce over the
-    # expert axis.
-    hidden = jnp.einsum("nf,efh->enh", tokens.astype(w1.dtype), w1) + b1
-    hidden = nn.relu(hidden)
-    expert_out = jnp.einsum("enh,eho->eno", hidden, w2) + b2  # [E, N, O]
-    combined = jnp.einsum("eno,ne->no", expert_out,
-                          gates.astype(expert_out.dtype))
+    if self.dispatch == "dense":
+      gates = jnp.zeros_like(probs)
+      gates = jax.vmap(lambda g, i, p: g.at[i].set(p))(gates, top_idx,
+                                                       top_probs)
+      gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+      hidden = jnp.einsum("nf,efh->enh", tokens.astype(w1.dtype), w1) + b1
+      hidden = nn.relu(hidden)
+      expert_out = jnp.einsum("enh,eho->eno", hidden, w2) + b2  # [E, N, O]
+      combined = jnp.einsum("eno,ne->no", expert_out,
+                            gates.astype(expert_out.dtype))
+      load = gates.astype(jnp.float32).mean(0)
+    else:
+      combined, load = self._sparse_dispatch(
+          tokens, top_probs, top_idx, w1, b1, w2, b2)
 
     # Switch-transformer load-balancing auxiliary.
-    importance = probs.mean(0)                      # mean router prob per e
-    load = gates.astype(jnp.float32).mean(0)        # mean routed mass per e
+    importance = probs.mean(0)  # mean router prob per expert
     aux_loss = self.num_experts * (importance * load).sum()
 
     return combined.reshape(leading + (self.output_size,)), aux_loss
+
+  def _sparse_dispatch(self, tokens, top_probs, top_idx, w1, b1, w2, b2):
+    """Capacity-bounded routing via one-hot dispatch/combine einsums."""
+    n = tokens.shape[0]
+    e = self.num_experts
+    capacity = max(1, int(math.ceil(
+        self.top_k * n / e * self.capacity_factor)))
+
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    counts = jnp.zeros((e,), jnp.float32)  # slots already claimed per e
+    kept_gate_sum = jnp.zeros((n,), jnp.float32)
+    for slot in range(self.top_k):
+      expert = top_idx[:, slot]                      # [N]
+      oh = jax.nn.one_hot(expert, e)                 # [N, E]
+      # Position of each token within its expert's buffer: tokens earlier
+      # in the batch (and earlier slots) claim lower positions.
+      pos_within = jnp.cumsum(oh, axis=0) - oh       # [N, E]
+      pos = ((pos_within + counts[None, :]) * oh).sum(-1)  # [N]
+      keep = (pos < capacity).astype(jnp.float32)
+      gate = top_probs[:, slot] * keep
+      combine = combine + (
+          gate[:, None, None] * oh[:, :, None]
+          * jax.nn.one_hot(pos.astype(jnp.int32), capacity)[:, None, :])
+      counts = counts + (oh * keep[:, None]).sum(0)
+      kept_gate_sum = kept_gate_sum + gate
+    # Renormalize over the kept choices (matches dense top-k renorm;
+    # fully-dropped tokens produce zero output).
+    combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    dispatch = (combine > 0).astype(tokens.dtype)    # [N, E, C]
+
+    expert_inputs = jnp.einsum("nec,nf->ecf", dispatch,
+                               tokens.astype(w1.dtype))
+    hidden = nn.relu(jnp.einsum("ecf,efh->ech", expert_inputs, w1) + b1)
+    expert_out = jnp.einsum("ech,eho->eco", hidden, w2) + b2
+    combined = jnp.einsum("nec,eco->no",
+                          combine.astype(expert_out.dtype), expert_out)
+    # Renormalized kept gate mass per expert — the same statistic the
+    # dense branch feeds the aux loss, so dispatch mode doesn't change
+    # the meaning of moe_aux_loss.
+    load = combine.sum(-1).mean(0)
+    return combined, load

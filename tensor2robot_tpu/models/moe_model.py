@@ -1,0 +1,102 @@
+"""A T2RModel whose trunk is a mixture-of-experts MLP — the training-path
+carrier for expert parallelism.
+
+Beyond the reference (SURVEY.md §2.5: EP absent there). This model makes
+EP a *training capability* rather than a standalone layer demo: training
+it through `train_eval_model` (or the generic step factory) with
+`expert_parallel_rules()` shards the expert dim of every `experts_*`
+param over the mesh's `model` axis, and the MoE dispatch/combine einsums
+become the cross-expert collectives.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import moe as moe_lib
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["MoERegressionModel", "expert_parallel_rules"]
+
+
+@config.configurable
+def expert_parallel_rules(extra_rules=()):
+  """Partition rules activating EP for `experts_*` params (gin-friendly)."""
+  return (moe_lib.EXPERT_AXIS_PARAM_RULE,) + tuple(extra_rules)
+
+
+class _MoENetwork(nn.Module):
+  action_size: int = 7
+  num_experts: int = 4
+  hidden_size: int = 64
+  top_k: int = 1
+  dispatch: str = "sparse"
+  capacity_factor: float = 1.25
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    x = features["observation"]
+    x = nn.relu(nn.Dense(self.hidden_size, name="embed")(x))
+    x, aux = moe_lib.MixtureOfExperts(
+        num_experts=self.num_experts, hidden_size=self.hidden_size,
+        output_size=self.hidden_size, top_k=self.top_k,
+        dispatch=self.dispatch, capacity_factor=self.capacity_factor,
+        name="moe")(x, train=train)
+    x = nn.relu(x)
+    action = nn.Dense(self.action_size, name="action")(x)
+    return specs_lib.SpecStruct({
+        "action": action,
+        "inference_output": action,
+        "moe_aux_loss": aux,
+    })
+
+
+@config.configurable
+class MoERegressionModel(abstract_model.T2RModel):
+  """observation -> action regression through a routed-expert trunk."""
+
+  def __init__(self, obs_size: int = 16, action_size: int = 7,
+               num_experts: int = 4, hidden_size: int = 64,
+               top_k: int = 1, dispatch: str = "sparse",
+               capacity_factor: float = 1.25,
+               aux_loss_weight: float = 0.01, **kwargs):
+    super().__init__(**kwargs)
+    self._obs_size = obs_size
+    self._action_size = action_size
+    self._num_experts = num_experts
+    self._hidden_size = hidden_size
+    self._top_k = top_k
+    self._dispatch = dispatch
+    self._capacity_factor = capacity_factor
+    self._aux_loss_weight = aux_loss_weight
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "observation": TensorSpec(shape=(self._obs_size,),
+                                  dtype=np.float32, name="observation"),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(shape=(self._action_size,),
+                             dtype=np.float32, name="action"),
+    })
+
+  def create_module(self):
+    return _MoENetwork(
+        action_size=self._action_size, num_experts=self._num_experts,
+        hidden_size=self._hidden_size, top_k=self._top_k,
+        dispatch=self._dispatch, capacity_factor=self._capacity_factor)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    mse = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
+    aux = inference_outputs["moe_aux_loss"]
+    loss = mse + self._aux_loss_weight * aux
+    return loss, {"mse": mse, "moe_aux_loss": aux}

@@ -17,9 +17,11 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-__all__ = ["pipelined_apply", "stack_stage_params"]
+__all__ = ["pipelined_apply", "stack_stage_params",
+           "shard_pipeline_tree", "make_pipelined_train_step"]
 
 
 def stack_stage_params(params_list):
@@ -81,3 +83,64 @@ def pipelined_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
       in_specs=(params_spec, replicated),
       out_specs=replicated,
       check_vma=False)(stage_params, microbatches)
+
+
+def make_pipelined_train_step(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    loss_fn: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = "pp") -> Callable:
+  """Builds a jitted *training* step over the GPipe pipeline.
+
+  The forward runs microbatches through `pipelined_apply`; the backward
+  is the autodiff transpose of the same scan+ppermute schedule (reverse
+  activation hops over the ICI ring — GPipe's synchronous backward), and
+  microbatch gradients accumulate into one optimizer update, i.e.
+  microbatch gradient accumulation is the sum inside the mean loss.
+
+  Args:
+    stage_fn: (stage params, activation [mb, ...]) -> same-shape
+      activation (homogeneous stages; see module docstring for scope).
+    loss_fn: (outputs [M, mb, ...], targets [M, mb, ...]) -> scalar mean
+      loss over all microbatches.
+    optimizer: optax transformation over the stacked stage params.
+    mesh: mesh containing `axis_name`.
+
+  Returns:
+    jitted (stage_params, opt_state, microbatches, targets) ->
+    (stage_params, opt_state, loss). Place stage params / optimizer
+    state with `shard_pipeline_tree` first; jit follows the committed
+    input shardings, so params and moments stay pp-sharded throughout.
+  """
+
+  def step(stage_params, opt_state, microbatches, targets):
+    def total_loss(p):
+      outputs = pipelined_apply(stage_fn, p, microbatches, mesh,
+                                axis_name=axis_name)
+      return loss_fn(outputs, targets)
+
+    loss, grads = jax.value_and_grad(total_loss)(stage_params)
+    updates, new_opt_state = optimizer.update(grads, opt_state,
+                                              stage_params)
+    new_params = optax.apply_updates(stage_params, updates)
+    return new_params, new_opt_state, loss
+
+  return jax.jit(step)
+
+
+def shard_pipeline_tree(tree: Any, mesh: Mesh,
+                        axis_name: str = "pp") -> Any:
+  """Places a pytree for pipeline training: leaves with a leading
+  [num_stages] dim are sharded over `axis_name`, everything else
+  (optimizer scalars like adam's count) is replicated."""
+  num_stages = mesh.shape[axis_name]
+  staged = NamedSharding(mesh, PartitionSpec(axis_name))
+  replicated = NamedSharding(mesh, PartitionSpec())
+
+  def _place(x):
+    if getattr(x, "ndim", 0) >= 1 and x.shape[0] == num_stages:
+      return jax.device_put(x, staged)
+    return jax.device_put(x, replicated)
+
+  return jax.tree_util.tree_map(_place, tree)

@@ -88,6 +88,22 @@ def test_config_smoke_trains(config_path, tmp_path):
   assert_output_files(model_dir, expect_operative_config=False)
 
 
+def test_moe_ep_config_trains_on_mesh(tmp_path):
+  """EP through the full training path: the train_moe_ep.gin config
+  trains a sparse-dispatch MoE model through train_eval_model on a
+  (2, 1, 2) mesh with the expert dim sharded over 'model'."""
+  config_path = os.path.join(REPO_ROOT, "tensor2robot_tpu", "configs",
+                             "train_moe_ep.gin")
+  model_dir = str(tmp_path / "moe_ep")
+  bindings = [b for b in _SHRINK if "mesh_shape" not in b]
+  bindings.append(f"train_eval_model.model_dir = {model_dir!r}")
+  bindings.append("DefaultRandomInputGenerator.batch_size = 8")
+  config.parse_config_files_and_bindings([config_path], bindings)
+  metrics = train_eval.train_eval_model()
+  assert metrics
+  assert_output_files(model_dir, expect_operative_config=False)
+
+
 def test_actor_configs_drive_collect_loop(tmp_path):
   """Non-trainer (actor-side) configs run the collect/eval loop and
   write replay records."""
