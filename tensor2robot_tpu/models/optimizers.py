@@ -23,6 +23,7 @@ __all__ = [
     "create_piecewise_linear_learning_rate",
     "create_adam_optimizer", "create_sgd_optimizer",
     "create_momentum_optimizer", "create_rms_prop_optimizer",
+    "DEFAULT_QTOPT_HPARAMS", "create_optimizer_from_hparams",
 ]
 
 
@@ -129,6 +130,61 @@ def create_rms_prop_optimizer(learning_rate: Any = 1e-4,
   return _finish(optax.rmsprop(_resolve_lr(learning_rate), decay=decay,
                                momentum=momentum, eps=eps),
                  gradient_clip_norm)
+
+
+# -- QT-Opt HParams surface --------------------------------------------------
+
+# The reference's published QT-Opt training hyperparameters
+# (/root/reference/research/qtopt/t2r_models.py:78-91 defaults consumed by
+# optimizer_builder.BuildOpt).
+DEFAULT_QTOPT_HPARAMS = {
+    "batch_size": 32,
+    "examples_per_epoch": 3_000_000,
+    "learning_rate": 1e-4,
+    "learning_rate_decay_factor": 0.999,
+    "model_weights_averaging": 0.9999,
+    "momentum": 0.9,
+    "num_epochs_per_decay": 2.0,
+    "optimizer": "momentum",  # 'momentum' | 'rmsprop' | 'adam'
+    "rmsprop_decay": 0.9,
+    "rmsprop_epsilon": 1.0,
+    "adam_beta2": 0.999,
+    "adam_epsilon": 1e-8,
+    "use_avg_model_params": True,
+}
+
+
+@config.configurable
+def create_optimizer_from_hparams(hparams: Optional[dict] = None,
+                                  **overrides
+                                  ) -> optax.GradientTransformation:
+  """The reference `BuildOpt` HParams surface
+  (/root/reference/research/qtopt/optimizer_builder.py:25-96) as an optax
+  factory: exponential-decay LR from epochs-per-decay, then momentum /
+  rmsprop / adam. `use_avg_model_params` (MovingAverageOptimizer) maps to
+  the model's EMA shadow params (`model_weights_averaging` -> the model's
+  `ema_decay`), not to this transformation — see the EMA note below.
+  """
+  h = dict(DEFAULT_QTOPT_HPARAMS)
+  h.update(hparams or {})
+  h.update(overrides)
+  decay_steps = max(1, int(h["examples_per_epoch"] / h["batch_size"]
+                           * h["num_epochs_per_decay"]))
+  learning_rate = optax.exponential_decay(
+      init_value=h["learning_rate"],
+      transition_steps=decay_steps,
+      decay_rate=h["learning_rate_decay_factor"],
+      staircase=True)
+  if h["optimizer"] == "momentum":
+    return optax.sgd(learning_rate, momentum=h["momentum"])
+  if h["optimizer"] == "rmsprop":
+    return optax.rmsprop(learning_rate, decay=h["rmsprop_decay"],
+                         momentum=h["momentum"],
+                         eps=h["rmsprop_epsilon"])
+  if h["optimizer"] == "adam":
+    return optax.adam(learning_rate, b1=h["momentum"],
+                      b2=h["adam_beta2"], eps=h["adam_epsilon"])
+  raise ValueError(f"Unknown optimizer {h['optimizer']!r}")
 
 
 # EMA note: the reference's MovingAverageOptimizer + swapping saver

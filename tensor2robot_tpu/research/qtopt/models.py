@@ -19,11 +19,13 @@ all-reduce are both subsumed by the data-parallel mesh.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
@@ -32,7 +34,7 @@ from tensor2robot_tpu.models import optimizers as optimizers_lib
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
-__all__ = ["GraspingCNN", "QTOptModel"]
+__all__ = ["GraspingCNN", "Grasping44", "QTOptModel"]
 
 
 class GraspingCNN(nn.Module):
@@ -83,6 +85,160 @@ class GraspingCNN(nn.Module):
     return specs_lib.SpecStruct({"q_predicted": q})
 
 
+class Grasping44(nn.Module):
+  """The reference-scale grasping Q-network
+  (/root/reference/research/qtopt/networks.py:299-615,
+  `Grasping44FlexibleGraspParams`), re-designed for TPU.
+
+  Structure mirrors the reference: a 6x6/2 stem conv + 3x3/3 max-pool,
+  `num_convs[0]` 5x5 convs + 3x3/3 pool, named grasp-param blocks each
+  through a Dense(256) then summed -> BN -> Dense(64) context that is
+  broadcast-added onto the (optionally action-batch-tiled) image
+  embedding, `num_convs[1]` 3x3 convs + 2x2/2 pool, `num_convs[2]` VALID
+  3x3 convs, flatten (+ optional goal spatial/vector merges), `hid_layers`
+  Dense(64) and a sigmoid/softmax head. BatchNorm uses the reference's
+  decay 0.9997 / eps 1e-3. The minimum spatial input for the default
+  (6, 6, 3) tower is ~252px (the reference trains at 472).
+
+  CEM action batches: grasp params of shape [B, A, P] tile the *image
+  embedding* (not the raw image) A times mid-tower — the reference's
+  action-megabatch trick (:512-521) — and return predictions [B, A].
+  """
+
+  num_convs: Tuple[int, int, int] = (6, 6, 3)
+  filters: int = 64
+  grasp_context_size: int = 64
+  fc_hidden_size: int = 64
+  hid_layers: int = 2
+  num_classes: int = 1
+  softmax: bool = False
+  batch_norm_decay: float = 0.9997
+  batch_norm_epsilon: float = 0.001
+  # name -> (offset, size) sub-blocks of the grasp-param vector, each
+  # embedded by its own Dense (reference grasp_param_names).
+  grasp_param_names: Optional[Dict[str, Tuple[int, int]]] = None
+
+  def _bn(self, name):
+    return nn.BatchNorm(momentum=self.batch_norm_decay,
+                        epsilon=self.batch_norm_epsilon, name=name)
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False,
+               goal_spatial: Optional[jnp.ndarray] = None,
+               goal_vector: Optional[jnp.ndarray] = None):
+    image = features["state/image"]
+    if jnp.issubdtype(image.dtype, jnp.integer):
+      image = image.astype(jnp.float32) / 255.0
+    use_ra = not train
+
+    # Stem (reference conv1_1 + pool1).
+    net = nn.Conv(self.filters, (6, 6), strides=(2, 2), use_bias=False,
+                  name="conv1_1")(image)
+    net = nn.relu(self._bn("conv1_bn")(net, use_running_average=use_ra))
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
+
+    conv_id = 2
+    for _ in range(self.num_convs[0]):
+      net = nn.Conv(self.filters, (5, 5), use_bias=False,
+                    name=f"conv{conv_id}")(net)
+      net = nn.relu(self._bn(f"conv{conv_id}_bn")(
+          net, use_running_average=use_ra))
+      conv_id += 1
+    net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
+
+    # Grasp params: action + any flat state vectors, in named blocks.
+    vectors = [features["action/action"]]
+    for key in sorted(features.keys()):
+      if key.startswith("state/") and features[key].ndim in (2, 3) \
+          and key != "state/image":
+        vectors.append(features[key])
+    action_batch = None
+    if any(v.ndim == 3 for v in vectors):  # [B, A, P] CEM megabatch
+      action_batch = next(v.shape[1] for v in vectors if v.ndim == 3)
+      # Rank-2 state vectors ride along replicated over the action batch
+      # (the reference's tile_batch applies to the whole params nest).
+      vectors = [
+          v if v.ndim == 3 else jnp.broadcast_to(
+              v[:, None, :], (v.shape[0], action_batch, v.shape[-1]))
+          for v in vectors]
+    grasp_params = jnp.concatenate(
+        [v.astype(net.dtype) for v in vectors], axis=-1)
+    if action_batch is not None:
+      grasp_params = grasp_params.reshape(-1, grasp_params.shape[-1])
+
+    if self.grasp_param_names:
+      blocks = [
+          (name, jax.lax.slice_in_dim(grasp_params, offset, offset + size,
+                                      axis=-1))
+          for name, (offset, size) in sorted(
+              self.grasp_param_names.items())]
+    else:
+      blocks = [("fcgrasp", grasp_params)]
+    fcgrasp = sum(
+        nn.Dense(256, name=name)(block) for name, block in blocks)
+    fcgrasp = nn.relu(self._bn("fcgrasp_bn")(
+        fcgrasp, use_running_average=use_ra))
+    fcgrasp = nn.Dense(self.grasp_context_size, use_bias=False,
+                       name="fcgrasp2")(fcgrasp)
+    fcgrasp = nn.relu(self._bn("fcgrasp2_bn")(
+        fcgrasp, use_running_average=use_ra))
+    if fcgrasp.shape[-1] != net.shape[-1]:
+      fcgrasp = nn.Dense(net.shape[-1], name="fcgrasp_proj")(fcgrasp)
+    context = fcgrasp[:, None, None, :]
+
+    if action_batch is not None:
+      # Tile the image EMBEDDING over the action batch (cheaper than
+      # tiling raw pixels, reference tile_batch :512-521).
+      net = jnp.repeat(net, action_batch, axis=0)
+    net = net + context
+
+    for _ in range(self.num_convs[1]):
+      net = nn.Conv(self.filters, (3, 3), use_bias=False,
+                    name=f"conv{conv_id}")(net)
+      net = nn.relu(self._bn(f"conv{conv_id}_bn")(
+          net, use_running_average=use_ra))
+      conv_id += 1
+    net = nn.max_pool(net, (2, 2), strides=(2, 2), padding="SAME")
+
+    for _ in range(self.num_convs[2]):
+      net = nn.Conv(self.filters, (3, 3), padding="VALID", use_bias=False,
+                    name=f"conv{conv_id}")(net)
+      net = nn.relu(self._bn(f"conv{conv_id}_bn")(
+          net, use_running_average=use_ra))
+      conv_id += 1
+
+    batch = net.shape[0]
+    if goal_spatial is not None:
+      goal_spatial = jnp.tile(goal_spatial,
+                              (batch // goal_spatial.shape[0], 1, 1, 1))
+      net = jnp.concatenate([net, goal_spatial.astype(net.dtype)], axis=3)
+    net = net.reshape(batch, -1)
+    if goal_vector is not None:
+      goal_vector = jnp.tile(goal_vector,
+                             (batch // goal_vector.shape[0], 1))
+      net = jnp.concatenate([net, goal_vector.astype(net.dtype)], axis=1)
+
+    for i in range(self.hid_layers):
+      net = nn.Dense(self.fc_hidden_size, use_bias=False,
+                     name=f"fc{i}")(net)
+      net = nn.relu(self._bn(f"fc{i}_bn")(net, use_running_average=use_ra))
+    logits = nn.Dense(self.num_classes, name="logit")(net)
+    if self.softmax:
+      predictions = jax.nn.softmax(logits)
+    else:
+      predictions = jax.nn.sigmoid(logits)
+    if action_batch is not None:
+      predictions = predictions.reshape(-1, action_batch, self.num_classes)
+      if self.num_classes == 1:
+        predictions = predictions[..., 0]
+      logits = logits.reshape(-1, action_batch, self.num_classes)
+    outputs = specs_lib.SpecStruct()
+    outputs["q_predicted"] = predictions
+    outputs["logits"] = logits
+    return outputs
+
+
 @config.configurable
 class QTOptModel(heads.CriticModel):
   """The grasping critic with the reference's training recipe."""
@@ -97,10 +253,27 @@ class QTOptModel(heads.CriticModel):
                lr_decay_steps: int = 10000,
                lr_decay_rate: float = 0.999,
                use_pcgrad: bool = False,
+               network: str = "small",  # 'small' | 'grasping44'
+               num_convs: Tuple[int, int, int] = (6, 6, 3),
+               grasp_param_names: Optional[Dict[str, Tuple[int, int]]]
+               = None,
+               l2_regularization: float = 7e-5,
+               optimizer_hparams: Optional[Dict] = None,
                **kwargs):
+    # The BuildOpt hparams surface also governs EMA (use_avg_model_params
+    # -> MovingAverageOptimizer, model_weights_averaging -> its decay,
+    # reference t2r_models.py:167-177); map them onto the model's EMA.
+    if optimizer_hparams is not None:
+      kwargs.setdefault("use_ema",
+                        optimizer_hparams.get("use_avg_model_params", True))
+      kwargs.setdefault("ema_decay",
+                        optimizer_hparams.get("model_weights_averaging",
+                                              0.9999))
     kwargs.setdefault("use_ema", True)
     kwargs.setdefault("ema_decay", 0.9999)
     super().__init__(**kwargs)
+    if network not in ("small", "grasping44"):
+      raise ValueError(f"Unknown network {network!r}")
     self._image_size = image_size
     self._image_channels = image_channels
     self._action_size = action_size
@@ -110,6 +283,11 @@ class QTOptModel(heads.CriticModel):
     self._lr_decay_steps = lr_decay_steps
     self._lr_decay_rate = lr_decay_rate
     self.use_pcgrad = use_pcgrad
+    self._network = network
+    self._num_convs = tuple(num_convs)
+    self._grasp_param_names = grasp_param_names
+    self._l2_regularization = l2_regularization
+    self._optimizer_hparams = optimizer_hparams
 
   def get_state_specification(self, mode):
     out = SpecStruct({
@@ -131,17 +309,34 @@ class QTOptModel(heads.CriticModel):
     })
 
   def create_module(self):
+    if self._network == "grasping44":
+      return Grasping44(num_convs=self._num_convs,
+                        grasp_param_names=self._grasp_param_names)
     return GraspingCNN()
 
   def create_optimizer(self):
     if self._optimizer_fn is not None:
       return super().create_optimizer()
-    schedule = optimizers_lib.create_exponential_decay_learning_rate(
-        initial_learning_rate=self._learning_rate,
-        decay_steps=self._lr_decay_steps,
-        decay_rate=self._lr_decay_rate)
-    return optimizers_lib.create_momentum_optimizer(
-        learning_rate=schedule, momentum=self._momentum)
+    if self._optimizer_hparams is not None:
+      base = optimizers_lib.create_optimizer_from_hparams(
+          self._optimizer_hparams)
+    else:
+      schedule = optimizers_lib.create_exponential_decay_learning_rate(
+          initial_learning_rate=self._learning_rate,
+          decay_steps=self._lr_decay_steps,
+          decay_rate=self._lr_decay_rate)
+      base = optimizers_lib.create_momentum_optimizer(
+          learning_rate=schedule, momentum=self._momentum)
+    if self._network == "grasping44" and self._l2_regularization:
+      # slim l2_regularizer on conv/fc kernels (reference networks.py:433)
+      # == decoupled weight decay added to the gradient before momentum.
+      return optax.chain(
+          optax.add_decayed_weights(
+              self._l2_regularization,
+              mask=lambda params: jax.tree_util.tree_map(
+                  lambda x: x.ndim > 1, params)),
+          base)
+    return base
 
   def model_task_losses_fn(self, features, labels, inference_outputs,
                            mode):

@@ -35,7 +35,8 @@ _SHRINK = [
     "train_eval_model.mesh_shape = (1, 1, 1)",
 ]
 _EXTRA = {
-    "train_qtopt.gin": ["QTOptModel.image_size = 32",
+    "train_qtopt.gin": ["QTOptModel.image_size = 108",
+                        "QTOptModel.num_convs = (2, 2, 1)",
                         "QTOptModel.device_type = 'cpu'",
                         "QTOptModel.use_bfloat16 = False"],
     "train_bcz.gin": ["BCZModel.image_size = 32",
