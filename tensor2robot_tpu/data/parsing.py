@@ -199,15 +199,15 @@ class ParseFn:
       self._sequence_datasets[dkey] = any(
           spec.is_sequence for spec in subset.values())
       self._native_parsers[dkey] = self._maybe_native_parser(
-          self._plans[dkey], self._sequence_datasets[dkey])
+          self._plans[dkey])
 
-  def _maybe_native_parser(self, plans: List[_LeafPlan],
-                           is_sequence: bool):
+  def _maybe_native_parser(self, plans: List[_LeafPlan]):
     """Builds the C++ columnar parser when every leaf fits its profile:
-    fixed-shape float/int features and single-value bytes/images, no
-    sequences/optionals/varlen (those take the Python path)."""
-    if is_sequence:
-      return None
+    fixed-shape float/int features (context or fixed-T sequence),
+    bytes/image features with a static value capacity (single images,
+    multi-image lists, fixed-T image sequences). Optionals, varlen,
+    dynamic time dims, raw-bytes planes and string dtypes take the
+    Python path."""
     if len({p.feature_name for p in plans}) != len(plans):
       # Duplicate wire names (e.g. MAML split subtrees): the native
       # name index is one-to-one, so take the Python path.
@@ -219,16 +219,32 @@ class ParseFn:
         return None
       if spec.is_extracted:
         return None  # raw-bytes tensor planes: python path
-      if spec.is_image:
-        native_plan.append((plan.feature_name, 2, 0, False))  # KIND_BYTES
-        continue
       if any(d is None for d in spec.shape):
-        return None
-      size = int(np.prod(spec.shape, dtype=np.int64)) if spec.shape else 1
+        return None  # dynamic dims (incl. dynamic time): python path
+      seq_len = int(spec.shape[0]) if spec.is_sequence else 0
+      step_shape = spec.shape[1:] if spec.is_sequence else spec.shape
+      if spec.is_image:
+        if spec.is_sequence:
+          cap = seq_len  # one image per step
+        elif len(spec.shape) >= 4:
+          cap = int(spec.shape[0])  # multi-image list, e.g. [N, H, W, C]
+        else:
+          cap = 1
+        # Context images zero-fill when absent (the reference's
+        # empty-string -> zeros fallback, honored by the Python path);
+        # missing sequence features are an error on both paths.
+        missing_ok = not spec.is_sequence
+        native_plan.append(
+            (plan.feature_name, 2, 0, missing_ok, seq_len, cap))
+        continue
+      size = (int(np.prod(step_shape, dtype=np.int64))
+              if step_shape else 1)
       if plan.parse_dtype == np.float32:
-        native_plan.append((plan.feature_name, 0, size, False))
+        native_plan.append(
+            (plan.feature_name, 0, size, False, seq_len, 0))
       elif np.issubdtype(plan.parse_dtype, np.integer):
-        native_plan.append((plan.feature_name, 1, size, False))
+        native_plan.append(
+            (plan.feature_name, 1, size, False, seq_len, 0))
       else:
         return None
     try:
@@ -246,21 +262,47 @@ class ParseFn:
     """Fast path: columnar native parse producing full batch arrays."""
     parser = self._native_parsers[dkey]
     plans = self._plans[dkey]
-    float_buffers, int_buffers, bytes_lists = parser.parse(
-        list(serialized_list))
+    parsed = parser.parse(list(serialized_list))
+    batch = len(serialized_list)
     out: Dict[str, np.ndarray] = {}
     for i, plan in enumerate(plans):
       spec = plan.spec
       if spec.is_image and not spec.is_extracted:
-        out[plan.out_key] = np.stack(
-            [_decode_image_feature([data], plan)
-             for data in bytes_lists[i]])
+        if spec.is_sequence:
+          step_plan = _LeafPlan(plan.out_key, plan.feature_name,
+                                spec.replace(shape=spec.shape[1:]),
+                                plan.parse_dtype)
+          out[plan.out_key] = np.stack([
+              np.stack([_decode_image_feature([v], step_plan)
+                        for v in values])
+              for values in parsed["bytes"][i]])
+          # Python-path parity: lengths report the full step count, even
+          # when the stored data is clipped to the spec's time dim.
+          out[plan.out_key + "_length"] = parsed["step_counts"][i]
+        elif len(spec.shape) >= 4:
+          # The native parser stores at most `cap` values; more values on
+          # the wire than the spec's leading dim is a loud error (the
+          # Python path would stack them all and fail shape validation).
+          counts = parsed["bytes_counts"][i]
+          if int(counts.max(initial=0)) > spec.shape[0]:
+            raise ValueError(
+                f"Feature {plan.feature_name!r} has {int(counts.max())} "
+                f"bytes values but spec {plan.out_key!r} expects at most "
+                f"{spec.shape[0]}.")
+          out[plan.out_key] = np.stack(
+              [_decode_image_feature(values, plan)
+               for values in parsed["bytes"][i]])
+        else:
+          out[plan.out_key] = np.stack(
+              [_decode_image_feature(values[:1] or [b""], plan)
+               for values in parsed["bytes"][i]])
         continue
-      buf = float_buffers.get(i)
+      buf = parsed["float"].get(i)
       if buf is None:
-        buf = int_buffers[i]
-      out[plan.out_key] = buf.reshape(
-          (len(serialized_list),) + spec.shape)
+        buf = parsed["int"][i]
+      out[plan.out_key] = buf.reshape((batch,) + spec.shape)
+      if spec.is_sequence:
+        out[plan.out_key + "_length"] = parsed["step_counts"][i]
     return out
 
   @property
@@ -344,7 +386,10 @@ class ParseFn:
                        self._feature_spec.items()},
                     **{f"labels/{k}": v for k, v in self._label_spec.items()}}
     for out_key, array in batched.items():
-      out[out_key] = self._maybe_cast(array, merged_specs[out_key])
+      if out_key.endswith("_length") and out_key not in merged_specs:
+        out[out_key] = array  # sequence length side outputs
+      else:
+        out[out_key] = self._maybe_cast(array, merged_specs[out_key])
     for out_key, values in columns.items():
       spec = merged_specs[out_key]
       if all(v is None for v in values):

@@ -72,6 +72,7 @@ def load() -> Optional[ctypes.CDLL]:
     lib.t2r_parser_create.restype = ctypes.c_void_p
     lib.t2r_parser_create.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
     lib.t2r_parser_destroy.argtypes = [ctypes.c_void_p]
     lib.t2r_parser_error.restype = ctypes.c_char_p
@@ -80,6 +81,10 @@ def load() -> Optional[ctypes.CDLL]:
     lib.t2r_parser_bytes_ptrs.argtypes = [ctypes.c_void_p]
     lib.t2r_parser_bytes_lens.restype = ctypes.POINTER(ctypes.c_int64)
     lib.t2r_parser_bytes_lens.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_bytes_counts.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_parser_bytes_counts.argtypes = [ctypes.c_void_p]
+    lib.t2r_parser_step_counts.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_parser_step_counts.argtypes = [ctypes.c_void_p]
     lib.t2r_parser_parse_batch.restype = ctypes.c_int
     lib.t2r_parser_parse_batch.argtypes = [
         ctypes.c_void_p,
@@ -133,12 +138,18 @@ KIND_FLOAT, KIND_INT64, KIND_BYTES = 0, 1, 2
 
 
 class BatchExampleParser:
-  """Columnar batched Example parsing through the native library.
+  """Columnar batched Example/SequenceExample parsing (native library).
 
-  Plan: a list of (name, kind, size, missing_ok) tuples. `parse` returns
-  (float_buffers, int_buffers, bytes_lists): dense numpy arrays of shape
-  [batch, size] for float/int features and python lists of bytes (or
-  None) for bytes features, in plan order.
+  Plan: a list of (name, kind, size, missing_ok, seq_len, cap) tuples —
+  `seq_len` 0 for context features or the fixed time dim for
+  SequenceExample feature lists (short sequences zero-pad, long ones
+  clip); `cap` is the stored value capacity for bytes features (1 for a
+  single image, N for multi-image lists, == seq_len for image sequences).
+
+  `parse` returns a dict:
+    float/int: {plan index: np array [batch, size] or [batch, T, size]},
+    bytes:     {plan index: per-record lists of bytes values},
+    bytes_counts / step_counts: {plan index: np.int64 [batch]}.
   """
 
   def __init__(self, plan):
@@ -151,15 +162,37 @@ class BatchExampleParser:
     # The C++ Plan handle stores per-call results (bytes ptr/len
     # vectors), so concurrent parse() calls on one parser must serialize.
     self._parse_lock = threading.Lock()
-    self._plan = list(plan)
+    def _norm(entry):
+      entry = tuple(entry)
+      if len(entry) == 4:  # legacy (name, kind, size, missing_ok)
+        entry += (0, 1)
+      elif len(entry) == 5:
+        entry += (1,)
+      return entry
+
+    self._plan = [_norm(entry) for entry in plan]
     n = len(self._plan)
     names = (ctypes.c_char_p * n)(
-        *[name.encode() for name, _, _, _ in self._plan])
-    kinds = (ctypes.c_int * n)(*[k for _, k, _, _ in self._plan])
-    sizes = (ctypes.c_int64 * n)(*[s for _, _, s, _ in self._plan])
+        *[e[0].encode() for e in self._plan])
+    kinds = (ctypes.c_int * n)(*[e[1] for e in self._plan])
+    sizes = (ctypes.c_int64 * n)(*[e[2] for e in self._plan])
+    seq_lens = (ctypes.c_int64 * n)(*[e[4] for e in self._plan])
+    caps = (ctypes.c_int64 * n)(
+        *[max(1, e[5]) if e[1] == KIND_BYTES else 0 for e in self._plan])
     self._missing_ok = (ctypes.c_uint8 * n)(
-        *[1 if m else 0 for _, _, _, m in self._plan])
-    self._handle = lib.t2r_parser_create(names, kinds, sizes, n)
+        *[1 if e[3] else 0 for e in self._plan])
+    self._caps = [max(1, e[5]) if e[1] == KIND_BYTES else 0
+                  for e in self._plan]
+    self._caps_offset = []
+    total = 0
+    for c in self._caps:
+      self._caps_offset.append(total if c else -1)
+      total += c
+    self._total_caps = total
+    self._num_bytes = sum(1 for c in self._caps if c)
+    self._num_seq = sum(1 for e in self._plan if e[4] > 0)
+    self._handle = lib.t2r_parser_create(names, kinds, sizes, seq_lens,
+                                         caps, n)
     self._np = np
 
   def __del__(self):
@@ -179,15 +212,17 @@ class BatchExampleParser:
     len_array = (ctypes.c_int64 * batch)(*[len(r) for r in records])
     float_outs = (ctypes.c_void_p * n)()
     int_outs = (ctypes.c_void_p * n)()
-    float_buffers, int_buffers = {}, {}
-    for i, (name, kind, size, _) in enumerate(self._plan):
+    out = {"float": {}, "int": {}, "bytes": {}, "bytes_counts": {},
+           "step_counts": {}}
+    for i, (name, kind, size, _, seq_len, _) in enumerate(self._plan):
+      shape = (batch, seq_len, size) if seq_len > 0 else (batch, size)
       if kind == KIND_FLOAT:
-        buf = np.zeros((batch, size), np.float32)
-        float_buffers[i] = buf
+        buf = np.zeros(shape, np.float32)
+        out["float"][i] = buf
         float_outs[i] = buf.ctypes.data_as(ctypes.c_void_p)
       elif kind == KIND_INT64:
-        buf = np.zeros((batch, size), np.int64)
-        int_buffers[i] = buf
+        buf = np.zeros(shape, np.int64)
+        out["int"][i] = buf
         int_outs[i] = buf.ctypes.data_as(ctypes.c_void_p)
     status = self._lib.t2r_parser_parse_batch(
         self._handle, rec_array, len_array, batch, float_outs, int_outs,
@@ -196,20 +231,41 @@ class BatchExampleParser:
       raise ValueError(
           "native example parse failed: "
           + self._lib.t2r_parser_error(self._handle).decode())
-    num_bytes = sum(1 for _, k, _, _ in self._plan if k == KIND_BYTES)
-    bytes_lists = {}
-    if num_bytes:
+    if self._num_bytes:
       ptrs = self._lib.t2r_parser_bytes_ptrs(self._handle)
       lens = self._lib.t2r_parser_bytes_lens(self._handle)
+      counts = self._lib.t2r_parser_bytes_counts(self._handle)
       slot = 0
-      for i, (name, kind, _, _) in enumerate(self._plan):
+      for i, (name, kind, _, _, seq_len, _) in enumerate(self._plan):
         if kind != KIND_BYTES:
           continue
-        values = []
+        cap, offset = self._caps[i], self._caps_offset[i]
+        per_record = []
+        count_arr = np.zeros((batch,), np.int64)
         for r in range(batch):
-          ptr = ptrs[r * num_bytes + slot]
-          length = lens[r * num_bytes + slot]
-          values.append(ctypes.string_at(ptr, length) if ptr else b"")
-        bytes_lists[i] = values
+          count = counts[r * self._num_bytes + slot]
+          count_arr[r] = count
+          # Sequence bytes expose all `cap` step slots (missing steps as
+          # b"" -> zero images downstream); context bytes expose the
+          # actual values present.
+          num_values = cap if seq_len > 0 else min(count, cap)
+          values = []
+          for c in range(num_values):
+            ptr = ptrs[r * self._total_caps + offset + c]
+            length = lens[r * self._total_caps + offset + c]
+            values.append(ctypes.string_at(ptr, length) if ptr else b"")
+          per_record.append(values)
+        out["bytes"][i] = per_record
+        out["bytes_counts"][i] = count_arr
         slot += 1
-    return float_buffers, int_buffers, bytes_lists
+    if self._num_seq:
+      steps = self._lib.t2r_parser_step_counts(self._handle)
+      seq_slot = 0
+      for i, entry in enumerate(self._plan):
+        if entry[4] <= 0:
+          continue
+        out["step_counts"][i] = np.asarray(
+            [steps[r * self._num_seq + seq_slot] for r in range(batch)],
+            np.int64)
+        seq_slot += 1
+    return out
