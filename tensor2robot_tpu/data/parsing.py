@@ -219,17 +219,20 @@ class ParseFn:
         return None
       if spec.is_extracted:
         return None  # raw-bytes tensor planes: python path
-      if any(d is None for d in spec.shape):
-        return None  # dynamic dims (incl. dynamic time): python path
-      seq_len = int(spec.shape[0]) if spec.is_sequence else 0
-      step_shape = spec.shape[1:] if spec.is_sequence else spec.shape
       if spec.is_image:
+        # Only the dims that size native buffers must be concrete: the
+        # time dim for sequences and the leading N of multi-image lists.
+        # H/W/C may stay dynamic (decode discovers them).
         if spec.is_sequence:
-          cap = seq_len  # one image per step
+          if spec.shape[0] is None:
+            return None  # dynamic time dim: python path
+          cap = seq_len = int(spec.shape[0])
         elif len(spec.shape) >= 4:
-          cap = int(spec.shape[0])  # multi-image list, e.g. [N, H, W, C]
+          if spec.shape[0] is None:
+            return None
+          seq_len, cap = 0, int(spec.shape[0])  # [N, H, W, C] list
         else:
-          cap = 1
+          seq_len, cap = 0, 1
         # Context images zero-fill when absent (the reference's
         # empty-string -> zeros fallback, honored by the Python path);
         # missing sequence features are an error on both paths.
@@ -237,6 +240,10 @@ class ParseFn:
         native_plan.append(
             (plan.feature_name, 2, 0, missing_ok, seq_len, cap))
         continue
+      if any(d is None for d in spec.shape):
+        return None  # dynamic dims (incl. dynamic time): python path
+      seq_len = int(spec.shape[0]) if spec.is_sequence else 0
+      step_shape = spec.shape[1:] if spec.is_sequence else spec.shape
       size = (int(np.prod(step_shape, dtype=np.int64))
               if step_shape else 1)
       if plan.parse_dtype == np.float32:
@@ -293,6 +300,12 @@ class ParseFn:
               [_decode_image_feature(values, plan)
                for values in parsed["bytes"][i]])
         else:
+          counts = parsed["bytes_counts"][i]
+          if int(counts.max(initial=0)) > 1:
+            raise ValueError(
+                f"Feature {plan.feature_name!r} has {int(counts.max())} "
+                f"bytes values but spec {plan.out_key!r} is a single "
+                "image.")
           out[plan.out_key] = np.stack(
               [_decode_image_feature(values[:1] or [b""], plan)
                for values in parsed["bytes"][i]])
@@ -394,6 +407,13 @@ class ParseFn:
       spec = merged_specs[out_key]
       if all(v is None for v in values):
         continue  # optional, absent everywhere
+      if any(v is None for v in values):
+        present = sum(1 for v in values if v is not None)
+        raise ValueError(
+            f"Optional feature {spec.name or out_key!r} ({out_key!r}) is "
+            f"present in only {present}/{len(values)} records of the "
+            "batch; optional features must be present batch-wide or "
+            "absent batch-wide.")
       if spec.is_sequence:
         time_dim = spec.shape[0] if spec.shape and spec.shape[0] is not None \
             else None

@@ -131,6 +131,19 @@ def parallel_map_ordered(fn: Callable[[Any], Any],
       yield futures.popleft().result()
 
 
+def _batched(stream: Iterator[Any], batch_size: int,
+             drop_remainder: bool) -> Iterator[List[Any]]:
+  """Groups a stream into lists of batch_size (tf.data batch semantics)."""
+  batch: List[Any] = []
+  for item in stream:
+    batch.append(item)
+    if len(batch) == batch_size:
+      yield batch
+      batch = []
+  if batch and not drop_remainder:
+    yield batch
+
+
 def prefetch(stream: Iterator[Any], size: int = 2) -> Iterator[Any]:
   """Background-thread prefetch (tf.data prefetch(AUTOTUNE) equivalent).
 
@@ -266,27 +279,32 @@ class RecordBatchPipeline:
       stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
       if self._shuffle_buffer_size:
         stream = _shuffled(stream, self._shuffle_buffer_size, epoch_seed)
-      batch: List[Dict[str, bytes]] = []
-      for item in stream:
-        batch.append(item)
-        if len(batch) == self._batch_size:
-          yield batch
-          batch = []
-      if batch and not self._drop_remainder:
-        yield batch
+      yield from _batched(stream, self._batch_size, self._drop_remainder)
       if not self._repeat:
         return
       epoch += 1
 
-  def _batches(self) -> Iterator[specs_lib.SpecStruct]:
-    raw = self._raw_batches()
+  def _assemble(self, raw: Iterator[List[Dict[str, bytes]]],
+                prefetch_size: Optional[int] = None
+                ) -> Iterator[specs_lib.SpecStruct]:
+    """raw record-tuple batches -> parsed+preprocessed (+prefetched)
+    batches. Parsing runs in parallel; preprocessing stays serial in
+    consumption order so stateful/seeded preprocessors keep
+    deterministic behavior. Shared with WeightedRecordPipeline."""
     if self._num_parallel_parses > 1:
-      # Parse in parallel; preprocess serially in consumption order so
-      # stateful/seeded preprocessors keep deterministic behavior.
       parsed = parallel_map_ordered(self._parse_only, raw,
                                     num_workers=self._num_parallel_parses)
-      return map(self._apply_preprocess, parsed)
-    return map(self._finalize, raw)
+      stream: Iterator[specs_lib.SpecStruct] = map(
+          self._apply_preprocess, parsed)
+    else:
+      stream = map(self._finalize, raw)
+    size = self._prefetch_size if prefetch_size is None else prefetch_size
+    if size:
+      stream = prefetch(stream, size)
+    return stream
+
+  def _batches(self) -> Iterator[specs_lib.SpecStruct]:
+    return self._assemble(self._raw_batches(), prefetch_size=0)
 
   def _parse_only(self, batch: List[Dict[str, bytes]]
                   ) -> specs_lib.SpecStruct:
@@ -320,45 +338,93 @@ class RecordBatchPipeline:
 
 class WeightedRecordPipeline:
   """Samples each record from one of several pipelines by weight
-  (reference WeightedRecordInputGenerator semantics)."""
+  (reference WeightedRecordInputGenerator semantics,
+  /root/reference/input_generators/default_input_generator.py:228-314).
+
+  Training mode shuffles each source through its own buffer and refills
+  exhausted sources forever. Non-train modes are deterministic and
+  terminating: no shuffling, a seeded sampling sequence, and each source
+  contributes exactly one pass — when a source exhausts, sampling
+  renormalizes over the remainder, and iteration ends once every source
+  has been consumed. Batches flow through the same parallel-parse and
+  prefetch stages as RecordBatchPipeline.
+  """
 
   def __init__(self,
                file_pattern_groups: Sequence[Union[str, Sequence[str]]],
                weights: Sequence[float],
                parse_fn: parsing.ParseFn,
                batch_size: int,
+               mode: str = "train",
+               shuffle_buffer_size: int = 512,
+               drop_remainder: bool = True,
+               repeat: bool = True,
                seed: Optional[int] = None,
+               prefetch_size: int = 2,
+               num_parallel_parses: int = 2,
                **kwargs):
     if len(file_pattern_groups) != len(weights):
       raise ValueError("One weight per file-pattern group required.")
+    if any(w < 0 for w in weights) or sum(weights) <= 0:
+      raise ValueError(f"Weights must be non-negative with a positive "
+                       f"sum, got {list(weights)}.")
     total = float(sum(weights))
-    self._weights = [w / total for w in weights]
+    self._weights = np.asarray([w / total for w in weights], np.float64)
     self._batch_size = batch_size
+    self._mode = mode
+    self._train = mode == "train"
+    self._shuffle_buffer_size = shuffle_buffer_size if self._train else 0
+    self._drop_remainder = drop_remainder
+    self._repeat = repeat and self._train
     self._seed = seed
+    self._prefetch_size = prefetch_size
+    self._num_parallel_parses = num_parallel_parses
     self._sources = [
         RecordBatchPipeline(patterns, parse_fn, batch_size=1,
-                            drop_remainder=False, seed=seed, **kwargs)
+                            mode=mode, drop_remainder=False, seed=seed,
+                            **kwargs)
         for patterns in file_pattern_groups]
     self._parse_fn = parse_fn
-    self._kwargs = kwargs
 
-  def __iter__(self) -> Iterator[specs_lib.SpecStruct]:
+  def _source_iter(self, idx: int, epoch: int) -> Iterator[Dict[str, bytes]]:
+    seed = (None if self._seed is None
+            else self._seed + 7919 * idx + 104_729 * epoch)
+    stream = self._sources[idx]._record_tuples(seed)
+    if self._shuffle_buffer_size:
+      stream = _shuffled(stream, self._shuffle_buffer_size, seed)
+    return iter(stream)
+
+  def _record_stream(self) -> Iterator[Dict[str, bytes]]:
     rng = np.random.RandomState(self._seed)
-    iterators = [iter(src._record_tuples(self._seed)) for src in self._sources]
-
-    def _stream():
+    n = len(self._sources)
+    iterators = [self._source_iter(i, 0) for i in range(n)]
+    epochs = [0] * n
+    # Zero-weight sources are never sampled (reference semantics), so
+    # they start dead — otherwise non-train termination would divide by
+    # a zero probability mass once the weighted sources exhaust.
+    alive = self._weights > 0
+    while alive.any():
+      p = self._weights * alive
+      idx = int(rng.choice(n, p=p / p.sum()))
+      refilled = False
       while True:
-        idx = rng.choice(len(iterators), p=self._weights)
         try:
           yield next(iterators[idx])
+          break
         except StopIteration:
-          iterators[idx] = iter(self._sources[idx]._record_tuples(None))
-          yield next(iterators[idx])
+          if not self._repeat or refilled:  # one pass, or empty source
+            alive[idx] = False
+            break
+          epochs[idx] += 1
+          iterators[idx] = self._source_iter(idx, epochs[idx])
+          refilled = True
 
-    batch: List[Dict[str, bytes]] = []
+  def _raw_batches(self) -> Iterator[List[Dict[str, bytes]]]:
+    return _batched(self._record_stream(), self._batch_size,
+                    self._drop_remainder)
+
+  def __iter__(self) -> Iterator[specs_lib.SpecStruct]:
     template = self._sources[0]
-    for item in _stream():
-      batch.append(item)
-      if len(batch) == self._batch_size:
-        yield template._finalize(batch)
-        batch = []
+    template._num_parallel_parses = self._num_parallel_parses
+    return template._assemble(self._raw_batches(),
+                              prefetch_size=self._prefetch_size)

