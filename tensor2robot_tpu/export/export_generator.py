@@ -33,6 +33,7 @@ import orbax.checkpoint as ocp
 
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.preprocessors import base as preprocessors_lib
 from tensor2robot_tpu.utils import config
 
 __all__ = ["AbstractExportGenerator", "DefaultExportGenerator",
@@ -41,6 +42,20 @@ __all__ = ["AbstractExportGenerator", "DefaultExportGenerator",
 SIGNATURE_FILENAME = "signature.json"
 PARAMS_DIRNAME = "params"
 SAVED_MODEL_DIRNAME = "saved_model"
+
+
+def _unwrap_preprocessor(preprocessor):
+  """Strips the bfloat16 device policy (its infeed cast is re-applied by
+  the predict fn itself, parallel/train_step.py cast_features_for_compute)."""
+  if isinstance(preprocessor, preprocessors_lib.Bfloat16DevicePolicy):
+    return preprocessor.inner
+  return preprocessor
+
+
+def _is_identity_preprocessor(preprocessor) -> bool:
+  """True iff serving features pass through unchanged."""
+  return isinstance(_unwrap_preprocessor(preprocessor),
+                    preprocessors_lib.NoOpPreprocessor)
 
 
 class AbstractExportGenerator:
@@ -119,9 +134,36 @@ class DefaultExportGenerator(AbstractExportGenerator):
       f.write(config.operative_config_str())
 
     if self._write_saved_model:
+      # Defense in depth: set_specification_from_model already failed
+      # fast at job start; re-check in case the model was swapped.
+      self._check_saved_model_compat(model)
       self._export_saved_model(model, state, feature_spec,
                                os.path.join(path, SAVED_MODEL_DIRNAME))
     return path
+
+  def set_specification_from_model(self, model) -> None:
+    """Fails FAST (at hook/job setup, before any training or filesystem
+    writes) when a SavedModel export could never be valid."""
+    super().set_specification_from_model(model)
+    if self._write_saved_model:
+      self._check_saved_model_compat(model)
+
+  def _check_saved_model_compat(self, model) -> None:
+    """The SavedModel wraps the jitted predict fn WITHOUT the host-side
+    preprocessor (numpy/stateful transforms are not jax2tf-traceable).
+    With a non-identity preprocessor and in-spec receivers it would
+    trace fine (size-agnostic convs) yet serve silently wrong,
+    distribution-shifted outputs (ADVICE r1) — refuse loudly instead."""
+    if self._export_raw_receivers or _is_identity_preprocessor(
+        model.preprocessor):
+      return
+    inner = _unwrap_preprocessor(model.preprocessor)
+    raise ValueError(
+        f"write_saved_model=True with the non-identity preprocessor "
+        f"{type(inner).__name__} requires export_raw_receivers=True "
+        "(clients feed model-layout, already-preprocessed features); "
+        "the pure-JAX bundle applies the preprocessor and serves "
+        "wire-layout features.")
 
   def _predict_with_preprocess(self, model):
     from tensor2robot_tpu.parallel import train_step as ts

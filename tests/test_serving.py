@@ -35,6 +35,73 @@ def _train(tmp_path, steps=40, export=False):
   return model_dir
 
 
+class TestSavedModelPreprocessorGuard:
+  """ADVICE r1 (medium): a jax2tf SavedModel cannot embed the host-side
+  preprocessor, so exporting one with in-spec receivers and a
+  non-identity preprocessor must refuse loudly instead of serving
+  silently wrong outputs."""
+
+  def _state_and_model(self, preprocessor_cls):
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    model = mocks.MockT2RModel(device_type="cpu",
+                               preprocessor_cls=preprocessor_cls)
+    features, _ = mocks.make_separable_data(8)
+    batch = {"x": features}
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), batch)
+    return model, state
+
+  def _noisy_preprocessor(self):
+    from tensor2robot_tpu.preprocessors import base as pre_lib
+
+    class ShiftPreprocessor(pre_lib.SpecTransformationPreprocessor):
+      def _preprocess_fn(self, features, labels, mode):
+        features = dict(features.items())
+        features["x"] = np.asarray(features["x"]) * 2.0 - 1.0
+        return features, labels
+
+    return ShiftPreprocessor
+
+  def test_non_identity_preprocessor_refuses_saved_model(self, tmp_path):
+    model, state = self._state_and_model(self._noisy_preprocessor())
+    gen = export_lib.DefaultExportGenerator(write_saved_model=True)
+    # Fails FAST at hook/job setup, naming the offending preprocessor,
+    # before any training or filesystem writes.
+    with pytest.raises(ValueError, match="ShiftPreprocessor"):
+      gen.set_specification_from_model(model)
+    # And defense-in-depth at export time too.
+    gen2 = export_lib.DefaultExportGenerator(write_saved_model=True)
+    export_lib.AbstractExportGenerator.set_specification_from_model(
+        gen2, model)
+    with pytest.raises(ValueError, match="export_raw_receivers"):
+      gen2.export(state, str(tmp_path / "exports"))
+
+  def test_bf16_wrapped_error_names_inner_preprocessor(self):
+    from tensor2robot_tpu.preprocessors import base as pre_lib
+
+    model = mocks.MockT2RModel(device_type="cpu", use_bfloat16=True,
+                               preprocessor_cls=self._noisy_preprocessor())
+    assert isinstance(model.preprocessor, pre_lib.Bfloat16DevicePolicy)
+    gen = export_lib.DefaultExportGenerator(write_saved_model=True)
+    with pytest.raises(ValueError, match="ShiftPreprocessor"):
+      gen.set_specification_from_model(model)
+
+  def test_raw_receivers_allow_saved_model(self, tmp_path):
+    model, state = self._state_and_model(self._noisy_preprocessor())
+    gen = export_lib.DefaultExportGenerator(write_saved_model=True,
+                                            export_raw_receivers=True)
+    gen.set_specification_from_model(model)
+    path = gen.export(state, str(tmp_path / "exports"))
+    assert os.path.isdir(os.path.join(path, "saved_model"))
+
+  def test_identity_preprocessor_allows_saved_model(self, tmp_path):
+    model, state = self._state_and_model(None)  # NoOp default
+    gen = export_lib.DefaultExportGenerator(write_saved_model=True)
+    gen.set_specification_from_model(model)
+    path = gen.export(state, str(tmp_path / "exports"))
+    assert os.path.isdir(os.path.join(path, "saved_model"))
+
+
 class TestCheckpointPredictor:
 
   def test_restore_and_predict(self, tmp_path):
