@@ -37,7 +37,8 @@ def export_checkpoint(model=config.REQUIRED,
                       model_dir: str = config.REQUIRED,
                       export_dir: Optional[str] = None,
                       checkpoint_step: Optional[int] = None,
-                      write_saved_model: bool = False) -> str:
+                      write_saved_model: bool = False,
+                      export_raw_receivers: bool = False) -> str:
   """Restores a checkpoint and writes one export bundle; returns path."""
   export_dir = export_dir or os.path.join(model_dir, "export")
   feature_spec = model.preprocessor.get_out_feature_specification(
@@ -51,7 +52,8 @@ def export_checkpoint(model=config.REQUIRED,
   state = manager.restore(checkpoint_step, abstract_state=abstract)
   manager.close()
   generator = export_lib.DefaultExportGenerator(
-      write_saved_model=write_saved_model)
+      write_saved_model=write_saved_model,
+      export_raw_receivers=export_raw_receivers)
   generator.set_specification_from_model(model)
   path = generator.export(state, export_dir, global_step=int(state.step))
   logging.info("Exported %s (step %d)", path, int(state.step))

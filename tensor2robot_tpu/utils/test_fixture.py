@@ -81,9 +81,25 @@ class T2RModelFixture:
       self, model, golden_path: str,
       max_train_steps: int = 3,
       atol: float = 1e-5,
-      update: Optional[bool] = None) -> None:
+      update: Optional[bool] = None,
+      require: bool = False) -> None:
     """Trains deterministically, then compares fixed-batch predictions to
-    a golden file; writes the golden when absent (or update=True)."""
+    a golden file (reference t2r_test_fixture.py:143-196 semantics with
+    1e-5 default tolerance).
+
+    Golden management: writes the golden when absent (or update=True /
+    env T2R_UPDATE_GOLDENS=1). With require=True a missing golden is an
+    ERROR instead — the mode for checked-in goldens, so CI compares
+    against the committed file and cross-commit numeric drift fails
+    rather than silently re-baselining.
+    """
+    if update is None and os.environ.get("T2R_UPDATE_GOLDENS") == "1":
+      update = True
+    if not update and not os.path.isfile(golden_path) and require:
+      raise FileNotFoundError(  # fail in ms, before the training run
+          f"Golden file {golden_path!r} is missing. Committed goldens "
+          "must not be silently re-baselined; regenerate deliberately "
+          "with T2R_UPDATE_GOLDENS=1.")
     self.random_train(model, max_train_steps=max_train_steps)
     outputs = train_eval.predict_from_model(
         model=model, model_dir=self._model_dir,
