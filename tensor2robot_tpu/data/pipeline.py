@@ -32,7 +32,7 @@ from tensor2robot_tpu.data import parsing, tfrecord
 from tensor2robot_tpu.utils import config
 
 __all__ = ["resolve_file_patterns", "RecordBatchPipeline", "prefetch",
-           "interleave_records"]
+           "interleave_records", "shuffled"]
 
 PreprocessFn = Callable[[specs_lib.SpecStruct, specs_lib.SpecStruct, str],
                         Tuple[specs_lib.SpecStruct, specs_lib.SpecStruct]]
@@ -91,8 +91,8 @@ def interleave_records(files: Sequence[str],
     active = next_active
 
 
-def _shuffled(stream: Iterator[Any], buffer_size: int,
-              seed: Optional[int] = None) -> Iterator[Any]:
+def shuffled(stream: Iterator[Any], buffer_size: int,
+             seed: Optional[int] = None) -> Iterator[Any]:
   """Reservoir-style shuffle buffer (tf.data.Dataset.shuffle semantics)."""
   rng = random.Random(seed)
   buffer: List[Any] = []
@@ -278,7 +278,7 @@ class RecordBatchPipeline:
       epoch_seed = None if self._seed is None else self._seed + epoch
       stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
       if self._shuffle_buffer_size:
-        stream = _shuffled(stream, self._shuffle_buffer_size, epoch_seed)
+        stream = shuffled(stream, self._shuffle_buffer_size, epoch_seed)
       yield from _batched(stream, self._batch_size, self._drop_remainder)
       if not self._repeat:
         return
@@ -391,7 +391,7 @@ class WeightedRecordPipeline:
             else self._seed + 7919 * idx + 104_729 * epoch)
     stream = self._sources[idx]._record_tuples(seed)
     if self._shuffle_buffer_size:
-      stream = _shuffled(stream, self._shuffle_buffer_size, seed)
+      stream = shuffled(stream, self._shuffle_buffer_size, seed)
     return iter(stream)
 
   def _record_stream(self) -> Iterator[Dict[str, bytes]]:

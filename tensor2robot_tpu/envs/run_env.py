@@ -26,7 +26,8 @@ from tensor2robot_tpu.data import replay_writer as writer_lib
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import summaries as summaries_lib
 
-__all__ = ["run_env", "collect_eval_loop"]
+__all__ = ["run_env", "run_tfagents_env", "TFAgentsEnvAdapter",
+           "collect_eval_loop"]
 
 EpisodeToTransitionsFn = Callable[[List[Dict[str, Any]]], List[Any]]
 
@@ -85,6 +86,52 @@ def run_env(env=config.REQUIRED,
     writer.close()
   logging.info("run_env[%s] @%d: %s", tag, global_step, stats)
   return stats
+
+
+class TFAgentsEnvAdapter:
+  """Adapts a TF-Agents `py_environment`-style env (reset/step returning
+  TimeStep namedtuples with .observation/.reward/.last()) onto the
+  gymnasium 5-tuple API `run_env` consumes.
+
+  Reference `run_tfagents_env`
+  (/root/reference/research/dql_grasping_lib/run_env.py:105-129). The
+  tf_agents package is NOT in this image, so the adapter duck-types the
+  TimeStep protocol instead of importing it — any object exposing
+  `reset()`/`step(action)` that return objects with `.observation`,
+  `.reward` and `.last()` (or `.step_type`) works, including real
+  tf_agents PyEnvironments when the package is present.
+  """
+
+  def __init__(self, tfagents_env):
+    self._env = tfagents_env
+
+  @staticmethod
+  def _is_last(timestep) -> bool:
+    if hasattr(timestep, "last"):
+      return bool(timestep.last())
+    # StepType.LAST == 2 in tf_agents.trajectories.time_step.
+    return int(getattr(timestep, "step_type")) == 2
+
+  def reset(self):
+    timestep = self._env.reset()
+    return timestep.observation, {}
+
+  def step(self, action):
+    timestep = self._env.step(action)
+    reward = float(np.asarray(timestep.reward))
+    done = self._is_last(timestep)
+    return timestep.observation, reward, done, False, {}
+
+  def __getattr__(self, name):
+    return getattr(self._env, name)
+
+
+@config.configurable
+def run_tfagents_env(env=config.REQUIRED, **kwargs) -> Dict[str, float]:
+  """`run_env` over a TF-Agents py_environment (reference
+  run_tfagents_env): wraps the env in `TFAgentsEnvAdapter` and reuses the
+  generic loop (unpack_action semantics are handled by the policies)."""
+  return run_env(env=TFAgentsEnvAdapter(env), **kwargs)
 
 
 @config.configurable

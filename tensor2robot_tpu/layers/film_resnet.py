@@ -24,6 +24,7 @@ RESNET_BLOCK_SIZES: Dict[int, Sequence[int]] = {
     50: (3, 4, 6, 3),
     101: (3, 4, 23, 3),
     152: (3, 8, 36, 3),
+    200: (3, 24, 36, 3),  # reference resnet.py:53
 }
 _BOTTLENECK_FROM = 50
 

@@ -23,7 +23,8 @@ from tensor2robot_tpu.policies import policies as policies_lib
 from tensor2robot_tpu.utils import config
 
 __all__ = ["MetaLearningPolicy", "MAMLRegressionPolicy", "MAMLCEMPolicy",
-           "FixedLengthSequentialRegressionPolicy"]
+           "FixedLengthSequentialRegressionPolicy",
+           "ScheduledExplorationMAMLRegressionPolicy"]
 
 
 class MetaLearningPolicy(policies_lib.Policy):
@@ -112,6 +113,55 @@ class MAMLCEMPolicy(MetaLearningPolicy):
         objective, mean=np.zeros(self._action_size),
         stddev=np.ones(self._action_size))
     return best
+
+
+@config.configurable
+class ScheduledExplorationMAMLRegressionPolicy(MAMLRegressionPolicy):
+  """MAML regression with step-scheduled OU exploration noise
+  (reference ScheduledExplorationMAMLRegressionPolicy,
+  /root/reference/meta_learning/meta_policies.py:166-201): the adapted
+  action gets Ornstein-Uhlenbeck noise whose magnitude follows a
+  global-step boundary schedule; `sample_action` reports is_demo=False
+  so replay writers form MetaExamples correctly."""
+
+  def __init__(self, theta: float = 0.15, sigma: float = 0.2,
+               action_size: int = None,
+               schedule_boundaries=(0,), schedule_values=(1.0,),
+               seed: Optional[int] = None, **kwargs):
+    super().__init__(**kwargs)
+    if action_size is None:
+      raise ValueError("action_size is required.")
+    if len(schedule_boundaries) != len(schedule_values):
+      raise ValueError("boundaries and values must align.")
+    self._ou = policies_lib.OUNoiseProcess(
+        action_size, theta=theta, sigma=sigma, seed=seed)
+    self._boundaries = list(schedule_boundaries)
+    self._values = list(schedule_values)
+
+  def reset(self) -> None:
+    """Per-episode reset: zeroes the noise only — the adapted condition
+    data survives across episodes (the reference's MetaLearningPolicy
+    keeps it until reset_task)."""
+    self._ou.reset()
+
+  def reset_task(self) -> None:
+    """Drops the adapted condition data (reference reset_task)."""
+    self._condition_features = None
+    self._condition_labels = None
+
+  def get_noise(self) -> np.ndarray:
+    scale = policies_lib.boundary_schedule_value(
+        self._boundaries, self._values, self.global_step)
+    return scale * self._ou.sample()
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    del explore_prob  # the schedule owns the magnitude (reference :178)
+    action = super().select_action(obs)
+    return action + self.get_noise()
+
+  def sample_action(self, obs, explore_prob: float = 0.0):
+    action = self.select_action(obs, explore_prob)
+    return action, {"is_demo": False}
 
 
 @config.configurable

@@ -22,7 +22,8 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["Policy", "CEMPolicy", "LSTMCEMPolicy", "RegressionPolicy",
            "SequentialRegressionPolicy", "OUExploreRegressionPolicy",
-           "ScheduledExplorationRegressionPolicy", "PerEpisodeSwitchPolicy"]
+           "ScheduledExplorationRegressionPolicy", "PerEpisodeSwitchPolicy",
+           "OUNoiseProcess", "boundary_schedule_value"]
 
 
 class Policy(abc.ABC):
@@ -195,6 +196,38 @@ class SequentialRegressionPolicy(RegressionPolicy):
 
 
 @config.configurable
+class OUNoiseProcess:
+  """Ornstein-Uhlenbeck noise state machine, shared by the exploration
+  policies here and in meta_learning.meta_policies."""
+
+  def __init__(self, action_size: int, theta: float = 0.15,
+               sigma: float = 0.2, seed: Optional[int] = None):
+    self._theta = theta
+    self._sigma = sigma
+    self._action_size = action_size
+    self._rng = np.random.RandomState(seed)
+    self._noise = np.zeros(action_size, np.float32)
+
+  def reset(self) -> None:
+    self._noise = np.zeros(self._action_size, np.float32)
+
+  def sample(self) -> np.ndarray:
+    self._noise += (-self._theta * self._noise
+                    + self._sigma * self._rng.randn(self._action_size))
+    return self._noise
+
+
+def boundary_schedule_value(boundaries: Sequence[int],
+                            values: Sequence[float], step: int) -> float:
+  """Step-boundary schedule lookup (last boundary <= step wins)."""
+  step = max(step, 0)
+  value = values[0]
+  for boundary, v in zip(boundaries, values):
+    if step >= boundary:
+      value = v
+  return value
+
+
 class OUExploreRegressionPolicy(RegressionPolicy):
   """Ornstein-Uhlenbeck exploration noise on top of regression actions
   (reference :258-291)."""
@@ -205,20 +238,15 @@ class OUExploreRegressionPolicy(RegressionPolicy):
     super().__init__(**kwargs)
     if action_size is None:
       raise ValueError("action_size is required.")
-    self._theta = theta
-    self._sigma = sigma
-    self._action_size = action_size
-    self._rng = np.random.RandomState(seed)
-    self._noise = np.zeros(action_size, np.float32)
+    self._ou = OUNoiseProcess(action_size, theta=theta, sigma=sigma,
+                              seed=seed)
 
   def reset(self) -> None:
-    self._noise = np.zeros(self._action_size, np.float32)
+    self._ou.reset()
 
   def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
     action = super().select_action(obs)
-    self._noise += (-self._theta * self._noise
-                    + self._sigma * self._rng.randn(self._action_size))
-    return action + explore_prob * self._noise
+    return action + explore_prob * self._ou.sample()
 
 
 @config.configurable
@@ -235,12 +263,8 @@ class ScheduledExplorationRegressionPolicy(OUExploreRegressionPolicy):
     self._values = list(schedule_values)
 
   def _scheduled_value(self) -> float:
-    step = max(self.global_step, 0)
-    value = self._values[0]
-    for boundary, v in zip(self._boundaries, self._values):
-      if step >= boundary:
-        value = v
-    return value
+    return boundary_schedule_value(self._boundaries, self._values,
+                                   self.global_step)
 
   def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
     return super().select_action(obs,

@@ -1,0 +1,275 @@
+"""Tests for the round-2 small parity rows: meta parallel_read,
+ScheduledExplorationMAMLRegressionPolicy, the TF-Agents env adapter seam
+and ResNet-200."""
+
+import collections
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.data import codec, tfrecord
+from tensor2robot_tpu.meta_learning import maml as maml_lib
+from tensor2robot_tpu.meta_learning import meta_policies, task_data
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+
+def _write_task_files(tmp_path, num_tasks=3, per_task=10, obs=4):
+  """One file per task; records carry the task id so routing is checkable."""
+  spec = SpecStruct({
+      "x": TensorSpec(shape=(obs,), dtype=np.float32, name="x"),
+      "y": TensorSpec(shape=(1,), dtype=np.float32, name="y"),
+  })
+  paths = []
+  for t in range(num_tasks):
+    path = str(tmp_path / f"task{t}.tfrecord")
+    with tfrecord.RecordWriter(path) as w:
+      for i in range(per_task):
+        w.write(codec.encode_example(
+            {"x": np.full(obs, t, np.float32),
+             "y": np.array([t * 100 + i], np.float32)}, spec))
+    paths.append(path)
+  return spec, paths
+
+
+class TestParallelRead:
+
+  def test_groups_come_from_single_tasks(self, tmp_path):
+    """Each yielded group holds num_train+num_val examples of ONE task
+    (reference meta_tfdata.parallel_read contract)."""
+    spec, paths = _write_task_files(tmp_path)
+    parse = lambda records: [np.frombuffer(r, np.uint8) for r in records]
+    from tensor2robot_tpu.data import parsing
+    parse_fn = parsing.create_parse_fn(
+        SpecStruct({"x": spec["x"]}), SpecStruct({"y": spec["y"]}))
+    groups = list(task_data.parallel_read(
+        ",".join(paths), parse_fn=parse_fn.parse_batch,
+        num_train_samples_per_task=2, num_val_samples_per_task=2,
+        mode="eval"))
+    assert groups  # eval mode terminates
+    seen_tasks = collections.Counter()
+    for group in groups:
+      x = np.asarray(group["features/x"])
+      assert x.shape == (4, 4)  # 2 train + 2 val samples
+      task_ids = set(x[:, 0].tolist())
+      assert len(task_ids) == 1, "group mixes tasks"
+      seen_tasks[task_ids.pop()] += 1
+    # every task contributed floor(10/4)=2 full groups exactly once over
+    assert seen_tasks == {0.0: 2, 1.0: 2, 2.0: 2}
+
+  def test_train_mode_repeats_and_shuffles(self, tmp_path):
+    spec, paths = _write_task_files(tmp_path)
+    from tensor2robot_tpu.data import parsing
+    parse_fn = parsing.create_parse_fn(
+        SpecStruct({"x": spec["x"]}), SpecStruct({"y": spec["y"]}))
+    stream = task_data.parallel_read(
+        ",".join(paths), parse_fn=parse_fn.parse_batch,
+        num_train_samples_per_task=2, num_val_samples_per_task=2,
+        mode="train", seed=0)
+    import itertools
+    groups = list(itertools.islice(stream, 20))  # > one epoch of 6
+    assert len(groups) == 20
+    ys = np.concatenate(
+        [np.asarray(g["labels/y"]).ravel() for g in groups])
+    # shuffled: within-task sample order differs from file order
+    task0 = [y for y in ys if y < 100]
+    assert task0[:4] != sorted(task0[:4]) or task0 != sorted(task0)
+
+  def test_small_task_file_carries_groups_across_epochs(self, tmp_path):
+    """A task file with fewer records than num_train+num_val must still
+    produce groups in train mode (records carry over epochs, reference
+    shuffle->repeat->batch order) instead of hanging (review r2)."""
+    import itertools
+
+    spec, _ = _write_task_files(tmp_path, num_tasks=0)
+    path = str(tmp_path / "tiny.tfrecord")
+    with tfrecord.RecordWriter(path) as w:
+      for i in range(3):  # 3 records < 2 train + 2 val
+        w.write(codec.encode_example(
+            {"x": np.full(4, 7.0, np.float32),
+             "y": np.array([float(i)], np.float32)}, spec))
+    from tensor2robot_tpu.data import parsing
+    parse_fn = parsing.create_parse_fn(
+        SpecStruct({"x": spec["x"]}), SpecStruct({"y": spec["y"]}))
+    stream = task_data.parallel_read(
+        path, parse_fn=parse_fn.parse_batch,
+        num_train_samples_per_task=2, num_val_samples_per_task=2,
+        mode="train", seed=0)
+    groups = list(itertools.islice(stream, 3))
+    assert len(groups) == 3
+    assert np.asarray(groups[0]["features/x"]).shape == (4, 4)
+    # empty task files raise instead of spinning
+    empty = str(tmp_path / "empty.tfrecord")
+    with tfrecord.RecordWriter(empty) as w:
+      pass
+    with pytest.raises(ValueError, match="no records"):
+      next(task_data.parallel_read(
+          empty, parse_fn=parse_fn.parse_batch, mode="train"))
+
+  def test_generator_builds_maml_layout_and_trains(self, tmp_path):
+    """End to end: task files -> meta batches -> a MAML train step."""
+    import optax
+
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.utils import mocks
+
+    # Task files in the mock model's wire layout (spec names).
+    base = mocks.MockT2RModel(device_type="cpu")
+    wire = SpecStruct({
+        "x": TensorSpec(shape=(3,), dtype=np.float32,
+                        name="measured_position"),
+        "y": TensorSpec(shape=(1,), dtype=np.float32,
+                        name="valid_position"),
+    })
+    paths = []
+    for t in range(4):
+      path = str(tmp_path / f"mtask{t}.tfrecord")
+      with tfrecord.RecordWriter(path) as w:
+        for i in range(12):
+          w.write(codec.encode_example(
+              {"x": np.full(3, t, np.float32),
+               "y": np.array([float(t)], np.float32)}, wire))
+      paths.append(path)
+    model = maml_lib.MAMLModel(
+        base_model=base, num_inner_loop_steps=1, inner_learning_rate=0.05,
+        num_condition_samples_per_task=2, num_inference_samples_per_task=2)
+    gen = task_data.MetaTaskRecordInputGenerator(
+        file_patterns=",".join(paths), batch_size=2,
+        num_train_samples_per_task=2, num_val_samples_per_task=2, seed=0)
+    gen.set_specification_from_model(model, modes.TRAIN)
+    batch = next(gen("train"))
+    features = batch["features"]
+    assert features["condition/features/x"].shape == (2, 2, 3)
+    assert features["inference/features/x"].shape == (2, 2, 3)
+    assert features["condition/labels/y"].shape == (2, 2, 1)
+    assert batch["labels"]["y"].shape == (2, 2, 1)
+    # condition and inference splits come from the same task
+    cond_task = np.asarray(features["condition/features/x"])[:, :, 0]
+    inf_task = np.asarray(features["inference/features/x"])[:, :, 0]
+    np.testing.assert_array_equal(cond_task[:, 0], inf_task[:, 0])
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                     features)
+    step = ts.make_train_step(model, donate=False)
+    _, metrics = step(state, features, batch["labels"])
+    assert np.isfinite(float(metrics["loss"]))
+
+
+class TestScheduledExplorationMAMLPolicy:
+
+  class _FakePredictor:
+    global_step = 500
+
+    def predict(self, features):
+      n = np.asarray(features["inference/features/obs"]).shape[1]
+      return {"conditioned_output/inference_output":
+              np.zeros((1, n, 2), np.float32)}
+
+    def restore(self):
+      return True
+
+  def test_noise_schedule_and_adapt(self):
+    policy = meta_policies.ScheduledExplorationMAMLRegressionPolicy(
+        predictor=self._FakePredictor(), action_size=2,
+        schedule_boundaries=(0, 1000), schedule_values=(1.0, 0.0),
+        sigma=0.5, seed=0)
+    policy.adapt({"obs": np.zeros((2, 3), np.float32)},
+                 {"action": np.zeros((2, 2), np.float32)})
+    action, debug = policy.sample_action({"obs": np.zeros(3, np.float32)})
+    assert debug == {"is_demo": False}
+    # base action is 0; at step 500 the schedule value is 1.0 -> noisy
+    assert np.abs(action).max() > 0.0
+    # past the 1000 boundary the schedule zeroes exploration
+    self._FakePredictor.global_step = 2000
+    policy2 = meta_policies.ScheduledExplorationMAMLRegressionPolicy(
+        predictor=self._FakePredictor(), action_size=2,
+        schedule_boundaries=(0, 1000), schedule_values=(1.0, 0.0),
+        sigma=0.5, seed=0)
+    policy2.adapt({"obs": np.zeros((2, 3), np.float32)},
+                  {"action": np.zeros((2, 2), np.float32)})
+    action2, _ = policy2.sample_action({"obs": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(action2, np.zeros(2), atol=1e-12)
+    self._FakePredictor.global_step = 500
+    # per-episode reset() keeps the adapted demo (run_env calls reset()
+    # every episode; only reset_task() drops the condition data)
+    policy.reset()
+    action3, _ = policy.sample_action({"obs": np.zeros(3, np.float32)})
+    assert np.isfinite(action3).all()
+    policy.reset_task()
+    with pytest.raises(ValueError, match="adapt"):
+      policy.select_action({"obs": np.zeros(3, np.float32)})
+
+
+class TestTFAgentsAdapter:
+
+  def test_adapter_runs_generic_loop(self, tmp_path):
+    from tensor2robot_tpu.envs import run_env as run_env_lib
+
+    TimeStep = collections.namedtuple(
+        "TimeStep", ["step_type", "reward", "discount", "observation"])
+
+    class FakePyEnvironment:
+      """Duck-typed tf_agents py_environment."""
+
+      def __init__(self, horizon=3):
+        self._horizon = horizon
+        self._t = 0
+
+      def reset(self):
+        self._t = 0
+        return TimeStep(0, 0.0, 1.0, {"obs": np.zeros(2, np.float32)})
+
+      def step(self, action):
+        self._t += 1
+        last = self._t >= self._horizon
+        return TimeStep(2 if last else 1, 1.0, 1.0,
+                        {"obs": np.full(2, self._t, np.float32)})
+
+    class ZeroPolicy:
+      def reset(self):
+        pass
+
+      def sample_action(self, obs, explore_prob=0.0):
+        return np.zeros(2, np.float32)
+
+    stats = run_env_lib.run_tfagents_env(
+        env=FakePyEnvironment(), policy=ZeroPolicy(), num_episodes=2)
+    assert stats["collect/episode_reward_mean"] == pytest.approx(3.0)
+
+  def test_adapter_supports_last_method(self):
+    from tensor2robot_tpu.envs.run_env import TFAgentsEnvAdapter
+
+    class TS:
+      observation = {"o": np.zeros(1)}
+      reward = np.float32(0.5)
+
+      def last(self):
+        return True
+
+    class Env:
+      def reset(self):
+        return TS()
+
+      def step(self, action):
+        return TS()
+
+    adapter = TFAgentsEnvAdapter(Env())
+    obs, info = adapter.reset()
+    assert "o" in obs
+    obs, reward, done, truncated, info = adapter.step(np.zeros(1))
+    assert reward == 0.5 and done is True
+
+
+class TestResNet200:
+
+  def test_resnet_200_builds(self):
+    from tensor2robot_tpu.layers import film_resnet
+
+    model = film_resnet.ResNet(resnet_size=200)
+    x = np.zeros((1, 32, 32, 3), np.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    # bottleneck block counts: 3 + 24 + 36 + 3
+    names = [k for k in variables["params"] if k.startswith("layer")]
+    assert len(names) == 3 + 24 + 36 + 3
+    features, endpoints = model.apply(variables, x)
+    assert features.shape == (1, 2048)
