@@ -32,22 +32,85 @@ from tensor2robot_tpu.preprocessors import image_ops
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
-__all__ = ["POSE_COMPONENTS", "BCZPreprocessor", "BCZModel"]
+__all__ = ["POSE_COMPONENTS", "REFERENCE_ACTION_COMPONENTS",
+           "normalize_components", "BCZPreprocessor", "BCZModel",
+           "piecewise_scaled_huber", "xyz_action_trajectory"]
 
-# (name, size, loss_weight) — the action decomposition table
-# (reference pose_components_lib.py).
+# (name, size, residual, loss_weight) — the action decomposition table
+# (reference pose_components_lib.py). 3-tuples (name, size, weight) are
+# accepted and treated as non-residual.
 POSE_COMPONENTS: Tuple[Tuple[str, int, float], ...] = (
     ("xyz", 3, 1.0),
     ("axis_angle", 3, 1.0),
     ("gripper", 1, 1.0),
 )
+# The reference's published table (pose_components_lib.py:30-34):
+# residual xyz weighted 100x, absolute quaternion 10x, gripper 1x.
+REFERENCE_ACTION_COMPONENTS: Tuple[Tuple[str, int, bool, float], ...] = (
+    ("xyz", 3, True, 100.0),
+    ("quaternion", 4, False, 10.0),
+    ("target_close", 1, False, 1.0),
+)
 STOP_KEY = "stop"
+STOP_STATE_KEY = "stop_state"
+NUM_STOP_STATES = 3  # continue / fail-help / success (abstract StopState)
+
+
+def normalize_components(components) -> Tuple[Tuple[str, int, bool, float],
+                                              ...]:
+  """(name, size[, residual], weight) -> canonical 4-tuples; residual
+  components read/write `<name>_residual` wire features (reference
+  pose_components_lib.ActionComponent)."""
+  out = []
+  for entry in components:
+    entry = tuple(entry)
+    if len(entry) == 3:
+      name, size, weight = entry
+      out.append((name, int(size), False, float(weight)))
+    elif len(entry) == 4:
+      name, size, residual, weight = entry
+      out.append((name, int(size), bool(residual), float(weight)))
+    else:
+      raise ValueError(f"Bad action component {entry!r}")
+  return tuple(out)
+
+
+def component_wire_name(name: str, residual: bool) -> str:
+  return name + "_residual" if residual else name
 
 
 def huber(x: jnp.ndarray, delta: float = 1.0) -> jnp.ndarray:
   abs_x = jnp.abs(x)
   return jnp.where(abs_x <= delta, 0.5 * x ** 2,
                    delta * (abs_x - 0.5 * delta))
+
+
+def piecewise_scaled_huber(loss: jnp.ndarray, threshold: float = 0.2,
+                           slope: float = 0.001) -> jnp.ndarray:
+  """Flattens large component losses (outlier demos) to a shallow slope
+  (reference piecewise_scaled_huber, bcz/model.py:631-638)."""
+  return jnp.where(loss > 1.0, threshold + (loss - threshold) * slope,
+                   loss)
+
+
+def xyz_action_trajectory(outputs) -> jnp.ndarray:
+  """[xyz | rotation] trajectory tensor for serving consumers (reference
+  xyz_action_trajectory, bcz/model.py:621-628). Prefers the
+  `<name>_absolute` outputs (residual heads + present pose, reference
+  infer_outputs :321-431) so residual models emit absolute poses."""
+
+  def pick(name):
+    if name + "_absolute" in outputs:
+      return outputs[name + "_absolute"]
+    return outputs[name]
+
+  if "quaternion" in outputs:
+    rotation = pick("quaternion")
+  elif "axis_angle" in outputs:
+    rotation = pick("axis_angle")
+  else:
+    raise KeyError("outputs carry neither 'quaternion' nor 'axis_angle'")
+  return jnp.concatenate([pick("xyz"), rotation], axis=-1)
 
 
 @config.configurable
@@ -118,17 +181,22 @@ class BCZPreprocessor(preprocessors_lib.SpecTransformationPreprocessor):
 class _BCZNetwork(nn.Module):
   """FiLM-ResNet (or spatial-softmax tower) -> waypoint heads + stop."""
 
-  components: Tuple[Tuple[str, int, float], ...] = POSE_COMPONENTS
+  components: Tuple[Tuple[str, int, bool, float], ...] = ()
   num_waypoints: int = 10
   network: str = "resnet_film"  # 'resnet_film' | 'spatial_softmax'
   resnet_size: int = 18
-  condition_size: int = 0
+  condition_mode: Optional[str] = None  # 'language' | 'onehot_taskid'
+  condition_size: int = 0    # language-embedding width
+  num_subtasks: int = 0      # one-hot task-id vocabulary
+  task_embedding_noise_std: Optional[float] = None
+  ignore_task_embedding: bool = False
   num_users: int = 0
   user_embedding_size: int = 8
   use_past_frames: bool = False
   past_frames_hidden: int = 32
 
   predict_stop: bool = True
+  predict_stop_state: bool = False  # 3-class continue/fail/success head
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
@@ -136,11 +204,26 @@ class _BCZNetwork(nn.Module):
     image = features["image"]
     if jnp.issubdtype(image.dtype, jnp.integer):
       image = image.astype(jnp.float32) / 255.0
-    # Conditioning vector: language embedding, operator (user) identity
-    # embedding (reference user-id conditioning, bcz/model.py:641-950).
-    conditioning_parts = []
-    if self.condition_size:
-      conditioning_parts.append(features["condition_embedding"])
+    # Conditioning vector (reference ConditionMode + user-id conditioning
+    # + augment_condition_input, bcz/model.py:63-66, 756-846): a language
+    # embedding or a one-hot subtask id, optionally noise-augmented or
+    # zeroed as a baseline, plus an operator (user) identity embedding.
+    task_embedding = None
+    if self.condition_mode == "language":
+      task_embedding = features["condition_embedding"]
+    elif self.condition_mode == "onehot_taskid":
+      subtask = features["subtask_id"].astype(jnp.int32).reshape(
+          image.shape[0])
+      task_embedding = jax.nn.one_hot(subtask, self.num_subtasks)
+    if task_embedding is not None and train \
+        and self.task_embedding_noise_std:
+      noise_key = self.make_rng("dropout")
+      task_embedding = task_embedding + \
+          self.task_embedding_noise_std * jax.random.normal(
+              noise_key, task_embedding.shape)
+    if self.ignore_task_embedding:
+      task_embedding = None  # zero-conditioning baseline (reference)
+    conditioning_parts = [] if task_embedding is None else [task_embedding]
     if self.num_users and "user_id" in features:
       user_id = jnp.clip(features["user_id"].astype(jnp.int32), 0,
                          self.num_users - 1)
@@ -172,20 +255,47 @@ class _BCZNetwork(nn.Module):
     if "present_pose" in features:
       feats = jnp.concatenate(
           [feats, features["present_pose"].astype(feats.dtype)], axis=-1)
-    action_size = sum(size for _, size, _ in self.components)
+    action_size = sum(size for _, size, _, _ in self.components)
     waypoints = bcz_networks.MultiHeadMLP(
         num_waypoints=self.num_waypoints, action_size=action_size,
         name="decoder")(feats, train=train)  # [B, W, action_size]
     outputs = specs_lib.SpecStruct()
     offset = 0
-    for name, size, _ in self.components:
+    for name, size, residual, _ in self.components:
       outputs[name] = waypoints[:, :, offset:offset + size]
       offset += size
+      if residual and f"present_{name}" in features:
+        # Residual heads predict deltas; serving consumers get the
+        # absolute pose = delta + present state (reference infer_outputs,
+        # bcz/model.py:321-431 adds features.present before output).
+        outputs[name + "_absolute"] = outputs[name] + \
+            features[f"present_{name}"].astype(
+                outputs[name].dtype)[:, None, :]
     if self.predict_stop:
       stop_feats = jax.lax.stop_gradient(feats)
       x = nn.relu(nn.Dense(64, name="stop_fc")(stop_feats))
       outputs[STOP_KEY] = nn.Dense(self.num_waypoints,
                                    name="stop_logits")(x)
+    if self.predict_stop_state:
+      # 3-class continue / fail-help / success head (reference
+      # predict_stop_network, bcz/model.py:289-319): slim's
+      # linear -> layer_norm -> relu stack, fed the raw embedding — the
+      # first waypoint's logits DO backprop into the backbone; logits
+      # for the remaining waypoints come off a stop-gradient branch.
+      x = feats
+      for i, width in enumerate((100, 100)):
+        x = nn.relu(nn.LayerNorm(name=f"stop_state_ln{i}")(
+            nn.Dense(width, name=f"stop_state_fc{i}")(x)))
+      first = nn.Dense(NUM_STOP_STATES, name="stop_state_logits")(x)
+      if self.num_waypoints > 1:
+        rest = nn.Dense((self.num_waypoints - 1) * NUM_STOP_STATES,
+                        name="stop_state_rest_logits")(
+                            jax.lax.stop_gradient(x))
+        logits = jnp.concatenate([first, rest], axis=-1)
+      else:
+        logits = first
+      outputs[STOP_STATE_KEY] = logits.reshape(
+          logits.shape[0], self.num_waypoints, NUM_STOP_STATES)
     return outputs
 
 
@@ -199,26 +309,50 @@ class BCZModel(abstract_model.T2RModel):
                components: Sequence = POSE_COMPONENTS,
                network: str = "resnet_film",
                resnet_size: int = 18,
+               condition_mode: Optional[str] = None,
                condition_size: int = 0,
+               num_subtasks: int = 0,
+               task_embedding_noise_std: Optional[float] = None,
+               ignore_task_embedding: bool = False,
                num_users: int = 0,
                num_past_frames: int = 0,
                predict_stop: bool = True,
+               predict_stop_state: bool = False,
                huber_delta: float = 1.0,
+               loss_clip_threshold: Optional[float] = None,
+               loss_clip_slope: float = 0.001,
                stop_loss_weight: float = 0.1,
+               gripper_metrics_component: Optional[str] = None,
                **kwargs):
     kwargs.setdefault("preprocessor_cls", BCZPreprocessor)
     super().__init__(**kwargs)
+    if condition_mode is None and condition_size:
+      condition_mode = "language"  # back-compat: condition_size implied it
+    if condition_mode not in (None, "language", "onehot_taskid"):
+      raise ValueError(f"Unknown condition_mode {condition_mode!r}")
+    if condition_mode == "language" and not condition_size:
+      raise ValueError("condition_mode='language' needs condition_size.")
+    if condition_mode == "onehot_taskid" and not num_subtasks:
+      raise ValueError("condition_mode='onehot_taskid' needs num_subtasks.")
     self._image_size = image_size
     self._num_waypoints = num_waypoints
-    self._components = tuple(tuple(c) for c in components)
+    self._components = normalize_components(components)
     self._network = network
     self._resnet_size = resnet_size
+    self._condition_mode = condition_mode
     self._condition_size = condition_size
+    self._num_subtasks = num_subtasks
+    self._task_embedding_noise_std = task_embedding_noise_std
+    self._ignore_task_embedding = ignore_task_embedding
     self._num_users = num_users
     self._num_past_frames = num_past_frames
     self._predict_stop = predict_stop
+    self._predict_stop_state = predict_stop_state
     self._huber_delta = huber_delta
+    self._loss_clip_threshold = loss_clip_threshold
+    self._loss_clip_slope = loss_clip_slope
     self._stop_loss_weight = stop_loss_weight
+    self._gripper_metrics_component = gripper_metrics_component
 
   def get_feature_specification(self, mode):
     out = SpecStruct({
@@ -228,10 +362,26 @@ class BCZModel(abstract_model.T2RModel):
         "present_pose": TensorSpec(shape=(7,), dtype=np.float32,
                                    name="present_pose", is_optional=True),
     })
-    if self._condition_size:
+    if self._condition_mode == "language":
       out["condition_embedding"] = TensorSpec(
           shape=(self._condition_size,), dtype=np.float32,
           name="condition_embedding")
+    elif self._condition_mode == "onehot_taskid":
+      out["subtask_id"] = TensorSpec(shape=(1,), dtype=np.int64,
+                                     name="subtask_id")
+    if self._gripper_metrics_component:
+      # Present (sensed) gripper value for closing/opening metrics
+      # (reference get_gripper_accuracy_metrics reads present.sensed_close).
+      out["present_gripper"] = TensorSpec(
+          shape=(1,), dtype=np.float32, name="present/sensed_close",
+          is_optional=True)
+    for name, size, residual, _ in self._components:
+      if residual:
+        # Present state per residual component: residual + present =
+        # absolute serving outputs (reference infer_outputs :321-431).
+        out[f"present_{name}"] = TensorSpec(
+            shape=(size,), dtype=np.float32, name="present/" + name,
+            is_optional=True)
     if self._num_users:
       out["user_id"] = TensorSpec(shape=(), dtype=np.int64,
                                   name="user_id")
@@ -246,22 +396,31 @@ class BCZModel(abstract_model.T2RModel):
 
   def get_label_specification(self, mode):
     out = SpecStruct()
-    for name, size, _ in self._components:
+    for name, size, residual, _ in self._components:
+      wire = component_wire_name(name, residual)
       out[name] = TensorSpec(shape=(self._num_waypoints, size),
-                             dtype=np.float32, name=name)
+                             dtype=np.float32, name="future/" + wire)
     if self._predict_stop:
       out[STOP_KEY] = TensorSpec(shape=(self._num_waypoints,),
                                  dtype=np.float32, name=STOP_KEY)
+    if self._predict_stop_state:
+      out[STOP_STATE_KEY] = TensorSpec(
+          shape=(), dtype=np.int64, name="present/stop_state")
     return out
 
   def create_module(self):
     return _BCZNetwork(
         components=self._components, num_waypoints=self._num_waypoints,
         network=self._network, resnet_size=self._resnet_size,
+        condition_mode=self._condition_mode,
         condition_size=self._condition_size,
+        num_subtasks=self._num_subtasks,
+        task_embedding_noise_std=self._task_embedding_noise_std,
+        ignore_task_embedding=self._ignore_task_embedding,
         num_users=self._num_users,
         use_past_frames=bool(self._num_past_frames),
-        predict_stop=self._predict_stop)
+        predict_stop=self._predict_stop,
+        predict_stop_state=self._predict_stop_state)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
     scalars: Dict[str, jnp.ndarray] = {}
@@ -272,7 +431,7 @@ class BCZModel(abstract_model.T2RModel):
     if self._predict_stop and STOP_KEY in labels:
       stop = labels[STOP_KEY]  # 1.0 once stopped
       mask = (1.0 - stop)[:, :, None]
-    for name, size, weight in self._components:
+    for name, size, residual, weight in self._components:
       err = inference_outputs[name] - labels[name]
       elementwise = huber(err, self._huber_delta)
       if mask is None:
@@ -282,6 +441,10 @@ class BCZModel(abstract_model.T2RModel):
         # training signal is independent of episode length.
         denom = jnp.maximum((mask * jnp.ones_like(elementwise)).sum(), 1.0)
         component_loss = (elementwise * mask).sum() / denom
+      if self._loss_clip_threshold is not None:
+        component_loss = piecewise_scaled_huber(
+            component_loss, self._loss_clip_threshold,
+            self._loss_clip_slope)
       scalars[f"loss/{name}"] = component_loss
       total = total + weight * component_loss
     if self._predict_stop and STOP_KEY in labels:
@@ -292,13 +455,53 @@ class BCZModel(abstract_model.T2RModel):
           + jnp.log1p(jnp.exp(-jnp.abs(logits))))
       scalars["loss/stop"] = stop_loss
       total = total + self._stop_loss_weight * stop_loss
+    if self._predict_stop_state and STOP_STATE_KEY in labels:
+      logits = inference_outputs[STOP_STATE_KEY][:, 0]  # first waypoint
+      # Clip: out-of-range labels would make take_along_axis fill NaN
+      # under jit rather than raise.
+      target = jnp.clip(labels[STOP_STATE_KEY].astype(jnp.int32), 0,
+                        NUM_STOP_STATES - 1)
+      log_probs = jax.nn.log_softmax(logits, axis=-1)
+      state_loss = -jnp.take_along_axis(
+          log_probs, target[:, None], axis=-1).mean()
+      scalars["loss/stop_state"] = state_loss
+      total = total + self._stop_loss_weight * state_loss
     return total, scalars
+
+  def _gripper_metrics(self, features, labels, inference_outputs):
+    """Closing/opening accuracy, precision, recall and positive rate
+    (reference get_gripper_accuracy_metrics, bcz/model.py:588-620):
+    compares the FIRST waypoint's predicted gripper delta vs the sensed
+    present value against the labeled delta."""
+    key = self._gripper_metrics_component
+    current = features["present_gripper"][:, 0]
+    predicted = inference_outputs[key][:, 0, 0]
+    labeled = labels[key][:, 0, 0]
+    metrics = {}
+    for direction, sign in (("closing", 1.0), ("opening", -1.0)):
+      pred = (sign * (predicted - current) > 0).astype(jnp.float32)
+      label = (sign * (labeled - current) > 0).astype(jnp.float32)
+      tp = (pred * label).sum()
+      metrics[f"gripper/{direction}_accuracy"] = (pred == label).mean()
+      metrics[f"gripper/{direction}_precision"] = tp / jnp.maximum(
+          pred.sum(), 1.0)
+      metrics[f"gripper/{direction}_recall"] = tp / jnp.maximum(
+          label.sum(), 1.0)
+      metrics[f"gripper/{direction}_pos_freq"] = label.mean()
+    return metrics
 
   def model_eval_fn(self, features, labels, inference_outputs):
     loss, scalars = self.model_train_fn(
         features, labels, inference_outputs, modes_lib.EVAL)
     metrics = {"loss": loss, **scalars}
-    for name, size, _ in self._components:
+    for name, size, _, _ in self._components:
       metrics[f"mae/{name}"] = jnp.abs(
           inference_outputs[name] - labels[name]).mean()
+    if self._predict_stop_state and STOP_STATE_KEY in labels:
+      pred = jnp.argmax(inference_outputs[STOP_STATE_KEY][:, 0], axis=-1)
+      metrics["stop_state_accuracy"] = (
+          pred == labels[STOP_STATE_KEY].astype(pred.dtype)).mean()
+    if self._gripper_metrics_component and "present_gripper" in features:
+      metrics.update(
+          self._gripper_metrics(features, labels, inference_outputs))
     return metrics

@@ -202,3 +202,160 @@ class TestBCZConditioning:
     out2 = predict(state, f2)
     assert not np.allclose(np.asarray(out1["xyz"]),
                            np.asarray(out2["xyz"]))
+
+
+class TestBCZReferenceParity:
+  """Round-2 BC-Z deepening: condition modes, residual components with
+  reference weights, stop-state head, loss clipping, gripper metrics
+  (reference bcz/model.py:63-66, 289-319, 588-638, 756-846)."""
+
+  def _model(self, **kwargs):
+    defaults = dict(image_size=32, num_waypoints=3,
+                    network="spatial_softmax", device_type="cpu")
+    defaults.update(kwargs)
+    return bcz_models.BCZModel(**defaults)
+
+  def test_reference_components_and_residual_wires(self):
+    model = self._model(components=bcz_models.REFERENCE_ACTION_COMPONENTS)
+    labels = model.get_label_specification(modes.TRAIN)
+    assert labels["xyz"].name == "future/xyz_residual"  # residual wire
+    assert labels["quaternion"].name == "future/quaternion"
+    assert labels["xyz"].shape == (3, 3)
+    assert labels["quaternion"].shape == (3, 4)
+    # Published weights genuinely flow into the loss: with unit error on
+    # exactly one component at a time, the totals differ by the 100x /
+    # 10x / 1x ratios (huber(1.0, delta=1) contributes 0.5 per element).
+    model = self._model(components=bcz_models.REFERENCE_ACTION_COMPONENTS,
+                        predict_stop=False)
+    zeros = {name: jnp.zeros((2, 3, size))
+             for name, size, _, _ in bcz_models.normalize_components(
+                 bcz_models.REFERENCE_ACTION_COMPONENTS)}
+    per_weight = {}
+    for name, size, _, weight in bcz_models.normalize_components(
+        bcz_models.REFERENCE_ACTION_COMPONENTS):
+      outputs = dict(zeros)
+      outputs[name] = jnp.ones((2, 3, size))  # unit error, huber -> 0.5
+      loss, _ = model.model_train_fn({}, zeros, outputs, modes.TRAIN)
+      per_weight[name] = float(loss)
+    assert per_weight["xyz"] == pytest.approx(100.0 * 0.5, rel=1e-5)
+    assert per_weight["quaternion"] == pytest.approx(10.0 * 0.5, rel=1e-5)
+    assert per_weight["target_close"] == pytest.approx(1.0 * 0.5, rel=1e-5)
+
+  def test_residual_components_emit_absolute_outputs(self):
+    model = self._model(components=bcz_models.REFERENCE_ACTION_COMPONENTS,
+                        predict_stop=False)
+    features, _ = _random_batch(model, 2)
+    features = specs_lib.flatten_spec_structure(features)
+    features["present_xyz"] = np.full((2, 3), 5.0, np.float32)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    out, _ = model.inference_network_fn(variables, features, modes.EVAL)
+    np.testing.assert_allclose(
+        np.asarray(out["xyz_absolute"]),
+        np.asarray(out["xyz"]) + 5.0, rtol=1e-5)
+    traj = bcz_models.xyz_action_trajectory(out)
+    # serving trajectory uses the ABSOLUTE xyz, not the residual
+    np.testing.assert_allclose(np.asarray(traj[..., :3]),
+                               np.asarray(out["xyz_absolute"]), rtol=1e-5)
+
+  def test_stop_state_grads_reach_backbone(self):
+    """Reference predict_stop_network backprops the first waypoint's
+    stop-state logits into the vision tower (only extra-waypoint logits
+    are stop-gradient)."""
+    model = self._model(predict_stop_state=True, predict_stop=False)
+    features, labels = _random_batch(model, 2)
+    labels = specs_lib.flatten_spec_structure(labels)
+    labels["stop_state"] = np.array([0, 2], np.int64)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+
+    def stop_state_only_loss(params):
+      outputs, _ = model.inference_network_fn(
+          {"params": params}, features, modes.TRAIN)
+      logits = outputs[bcz_models.STOP_STATE_KEY][:, 0]
+      target = jnp.asarray(labels["stop_state"], jnp.int32)
+      return -jnp.take_along_axis(
+          jax.nn.log_softmax(logits), target[:, None], axis=-1).mean()
+
+    grads = jax.grad(stop_state_only_loss)(variables["params"])
+    tower_grad = jax.tree_util.tree_leaves(
+        {k: v for k, v in grads.items() if k.startswith("tower")})
+    assert any(float(jnp.abs(g).max()) > 0 for g in tower_grad), \
+        "stop-state loss must reach the vision tower"
+    # but the extra-waypoint head's input branch is stop-gradient: its
+    # own kernel gets gradient only via... none from waypoint-0 loss
+    assert float(jnp.abs(
+        grads["stop_state_rest_logits"]["kernel"]).max()) == 0.0
+
+  def test_onehot_taskid_conditions_output(self):
+    model = self._model(condition_mode="onehot_taskid", num_subtasks=4)
+    spec = model.get_feature_specification(modes.TRAIN)
+    assert "subtask_id" in spec
+    features, labels = _random_batch(model, 2)
+    features = specs_lib.flatten_spec_structure(features)
+    features["subtask_id"] = np.array([[0], [1]], np.int64)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    out1, _ = model.inference_network_fn(variables, features, modes.EVAL)
+    f2 = specs_lib.SpecStruct(dict(features))
+    f2["subtask_id"] = np.array([[2], [3]], np.int64)
+    out2, _ = model.inference_network_fn(variables, f2, modes.EVAL)
+    assert not np.allclose(np.asarray(out1["xyz"]), np.asarray(out2["xyz"]))
+
+  def test_ignore_task_embedding_baseline(self):
+    model = self._model(condition_mode="onehot_taskid", num_subtasks=4,
+                        ignore_task_embedding=True)
+    features, labels = _random_batch(model, 2)
+    features = specs_lib.flatten_spec_structure(features)
+    features["subtask_id"] = np.array([[0], [1]], np.int64)
+    variables = model.init_variables(jax.random.PRNGKey(0), features)
+    out1, _ = model.inference_network_fn(variables, features, modes.EVAL)
+    f2 = specs_lib.SpecStruct(dict(features))
+    f2["subtask_id"] = np.array([[2], [3]], np.int64)
+    out2, _ = model.inference_network_fn(variables, f2, modes.EVAL)
+    np.testing.assert_array_equal(np.asarray(out1["xyz"]),
+                                  np.asarray(out2["xyz"]))
+
+  def test_stop_state_head_and_accuracy(self):
+    model = self._model(predict_stop_state=True)
+    features, labels = _random_batch(model, 3)
+    labels = specs_lib.flatten_spec_structure(labels)
+    labels["stop_state"] = np.array([0, 1, 2], np.int64)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model, donate=False)
+    _, metrics = step(state, features, labels)
+    assert "loss/stop_state" in metrics
+    ev = ts.make_eval_step(model)(state, features, labels)
+    assert 0.0 <= float(ev["stop_state_accuracy"]) <= 1.0
+
+  def test_piecewise_loss_clipping(self):
+    big = jnp.asarray(5.0)
+    small = jnp.asarray(0.5)
+    assert float(bcz_models.piecewise_scaled_huber(big, 0.2, 0.001)) == \
+        pytest.approx(0.2 + 4.8 * 0.001)
+    assert float(bcz_models.piecewise_scaled_huber(small, 0.2, 0.001)) == \
+        pytest.approx(0.5)
+
+  def test_gripper_metrics_semantics(self):
+    model = self._model(components=(("xyz", 3, 1.0), ("gripper", 1, 1.0)),
+                        gripper_metrics_component="gripper")
+    features, labels = _random_batch(model, 4)
+    features = specs_lib.flatten_spec_structure(features)
+    labels = specs_lib.flatten_spec_structure(labels)
+    features["present_gripper"] = np.zeros((4, 1), np.float32)
+    # perfect predictions: first-waypoint gripper equals the label
+    labels["gripper"] = np.zeros((4, 3, 1), np.float32)
+    labels["gripper"][:2, 0, 0] = 1.0  # two closing, two holding
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    variables = {"params": state.params, **state.mutable_state}
+    outputs, _ = model.inference_network_fn(variables, features, modes.EVAL)
+    outputs = dict(outputs.items())
+    outputs["gripper"] = jnp.asarray(labels["gripper"])
+    metrics = model.model_eval_fn(features, labels, outputs)
+    assert float(metrics["gripper/closing_accuracy"]) == 1.0
+    assert float(metrics["gripper/closing_recall"]) == 1.0
+    assert float(metrics["gripper/closing_pos_freq"]) == 0.5
+
+  def test_xyz_action_trajectory_helper(self):
+    out = {"xyz": jnp.ones((2, 3, 3)), "quaternion": jnp.zeros((2, 3, 4))}
+    traj = bcz_models.xyz_action_trajectory(out)
+    assert traj.shape == (2, 3, 7)
+    with pytest.raises(KeyError):
+      bcz_models.xyz_action_trajectory({"xyz": jnp.ones((2, 3, 3))})
