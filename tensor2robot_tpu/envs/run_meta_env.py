@@ -17,7 +17,7 @@ from absl import logging
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import summaries as summaries_lib
 
-__all__ = ["run_meta_env"]
+__all__ = ["run_meta_env", "run_wtl_env"]
 
 
 @config.configurable
@@ -77,4 +77,87 @@ def run_meta_env(env=config.REQUIRED,
     writer.write_scalars(global_step, stats)
     writer.close()
   logging.info("run_meta_env @%d: %s", global_step, stats)
+  return stats
+
+
+def _run_episode(env, policy, task_seed: int, obs_to_state_fn):
+  """One episode; returns (episode_data, total_reward) where episode
+  entries are (state, action, reward) tuples (the pack_wtl format)."""
+  obs, _ = env.reset(seed=task_seed)
+  policy.reset()
+  episode, total, done = [], 0.0, False
+  while not done:
+    state = obs_to_state_fn(obs)
+    action = policy.sample_action(state)
+    obs, reward, terminated, truncated, _ = env.step(action)
+    episode.append((state, np.asarray(action), float(reward)))
+    total += float(reward)
+    done = terminated or truncated
+  return episode, total
+
+
+@config.configurable
+def run_wtl_env(env=config.REQUIRED,
+                trial_policy=config.REQUIRED,
+                retrial_policy=None,
+                demo_policy=None,
+                num_tasks: int = 5,
+                obs_to_state_fn: Optional[Callable] = None,
+                global_step: int = 0,
+                root_dir: Optional[str] = None,
+                tag: str = "wtl_eval") -> Dict[str, float]:
+  """The Watch-Try-Learn protocol over env tasks (reference WTL loop,
+  vrgripper_env_wtl_models.py + run_meta_env.py semantics):
+
+  watch — collect one demo episode with `demo_policy`;
+  try   — `trial_policy.adapt([demo])`, run the trial episode;
+  learn — `retrial_policy.adapt([demo, trial])`, run the retrial.
+
+  Returns mean demo/trial/retrial rewards (+ the retrial - trial gap,
+  the quantity WTL exists to maximize).
+  """
+  if obs_to_state_fn is None:
+    obs_to_state_fn = lambda obs: obs
+  if demo_policy is None:
+    raise ValueError("demo_policy is required (the 'watch' phase).")
+  if num_tasks < 1:
+    raise ValueError("num_tasks must be >= 1.")
+  retrial_policy = retrial_policy or trial_policy
+  retrial_model = getattr(retrial_policy, "_model", None)
+  if getattr(retrial_model, "num_condition_episodes", 2) < 2:
+    logging.warning(
+        "run_wtl_env: the retrial policy's model conditions on only one "
+        "episode, so adapt([demo, trial]) DROPS the trial episode and "
+        "retrial_gain measures sampling noise. Use a retrial=True model "
+        "(num_condition_episodes >= 2) for the 'learn' phase.")
+  demo_rewards, trial_rewards, retrial_rewards = [], [], []
+  for task_idx in range(num_tasks):
+    demo, demo_reward = _run_episode(env, demo_policy, task_idx,
+                                     obs_to_state_fn)
+    demo_rewards.append(demo_reward)
+    if hasattr(trial_policy, "reset_task"):
+      trial_policy.reset_task()
+    trial_policy.adapt([demo])
+    trial, trial_reward = _run_episode(env, trial_policy, task_idx,
+                                       obs_to_state_fn)
+    trial_rewards.append(trial_reward)
+    if hasattr(retrial_policy, "reset_task"):
+      retrial_policy.reset_task()
+    retrial_policy.adapt([demo, trial])
+    _, retrial_reward = _run_episode(env, retrial_policy, task_idx,
+                                     obs_to_state_fn)
+    retrial_rewards.append(retrial_reward)
+  stats = {
+      f"{tag}/reward_demo": float(np.mean(demo_rewards)),
+      f"{tag}/reward_trial": float(np.mean(trial_rewards)),
+      f"{tag}/reward_retrial": float(np.mean(retrial_rewards)),
+      f"{tag}/retrial_gain": float(np.mean(retrial_rewards)
+                                   - np.mean(trial_rewards)),
+  }
+  if root_dir is not None:
+    writer = summaries_lib.SummaryWriter(os.path.join(root_dir, tag),
+                                         use_tensorboard=False)
+    writer.write_scalars(global_step, stats)
+    writer.close()
+  logging.info("run_wtl_env @%d: %s", global_step, stats)
   return stats

@@ -24,7 +24,7 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["MetaLearningPolicy", "MAMLRegressionPolicy", "MAMLCEMPolicy",
            "FixedLengthSequentialRegressionPolicy",
-           "ScheduledExplorationMAMLRegressionPolicy"]
+           "ScheduledExplorationMAMLRegressionPolicy", "WTLPolicy"]
 
 
 class MetaLearningPolicy(policies_lib.Policy):
@@ -162,6 +162,67 @@ class ScheduledExplorationMAMLRegressionPolicy(MAMLRegressionPolicy):
   def sample_action(self, obs, explore_prob: float = 0.0):
     action = self.select_action(obs, explore_prob)
     return action, {"is_demo": False}
+
+
+@config.configurable
+class WTLPolicy(policies_lib.Policy):
+  """Watch-Try-Learn serving policy (reference wtl_models pack_features +
+  meta_policies SelectAction plumbing): holds the prior episode data
+  (demo for the trial phase; demo + trial for the retrial phase) and
+  builds model inputs via the model's `pack_features(state,
+  prev_episode_data, timestep)`.
+
+  Episode data entries are (obs, action, reward, ...) tuples, matching
+  `pack_wtl_meta_features`.
+  """
+
+  def __init__(self, model=None, predictor=None,
+               action_key: str = "inference_output"):
+    super().__init__(predictor)
+    if model is None:
+      raise ValueError("model (providing pack_features) is required.")
+    self._model = model
+    self._action_key = action_key
+    self._prev_episode_data: Optional[list] = None
+    self._timestep = 0
+
+  def adapt(self, prev_episode_data) -> None:
+    """Sets the conditioning episodes: [demo] or [demo, trial]."""
+    self._prev_episode_data = list(prev_episode_data)
+
+  def reset(self) -> None:
+    self._timestep = 0
+
+  def reset_task(self) -> None:
+    self._prev_episode_data = None
+    self._timestep = 0
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    if self._prev_episode_data is None:
+      raise ValueError("Call adapt() with episode data before acting.")
+    features = self._model.pack_features(obs, self._prev_episode_data,
+                                         self._timestep)
+    # pack_features emits the MODEL (post-preprocessor meta) layout;
+    # wire-format predict() would run the FixedLen preprocessor on it.
+    predict = getattr(self._predictor, "predict_preprocessed", None)
+    if predict is None:
+      raise TypeError(
+          f"{type(self._predictor).__name__} does not support model-layout "
+          "features (no predict_preprocessed); WTLPolicy requires one of "
+          "the JAX predictors.")
+    outputs = predict({k: np.asarray(v) for k, v in features.items()})
+    action = np.asarray(outputs[self._action_key])
+    # [task=1, inference_ep=1, T, A]: walk the predicted trajectory rows
+    # (reference rank-4 action handling, meta_policies.py:185-195).
+    if action.ndim == 4:
+      idx = min(self._timestep, action.shape[2] - 1)
+      action = action[0, 0, idx]
+    elif action.ndim == 3:
+      action = action[0, 0]
+    else:
+      raise ValueError(f"Invalid action rank {action.ndim}.")
+    self._timestep += 1
+    return action
 
 
 @config.configurable

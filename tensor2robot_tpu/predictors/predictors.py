@@ -99,6 +99,11 @@ class _JaxPredictorBase(AbstractPredictor):
       return predict(self._state, features)
 
     self._predict_fn = fn
+    # Model-layout path for callers that already built post-preprocessor
+    # features (e.g. WTL pack_features, whose meta layout is not the
+    # preprocessor's wire format).
+    self._predict_preprocessed_fn = lambda features: predict(self._state,
+                                                             features)
 
   def get_feature_specification(self) -> specs_lib.SpecStruct:
     self.assert_is_loaded()
@@ -117,6 +122,12 @@ class _JaxPredictorBase(AbstractPredictor):
   def predict(self, features) -> Dict[str, np.ndarray]:
     self.assert_is_loaded()
     outputs = self._predict_fn(features)
+    return {k: np.asarray(v) for k, v in dict(outputs.items()).items()}
+
+  def predict_preprocessed(self, features) -> Dict[str, np.ndarray]:
+    """Predict on MODEL-layout (already-preprocessed) features."""
+    self.assert_is_loaded()
+    outputs = self._predict_preprocessed_fn(features)
     return {k: np.asarray(v) for k, v in dict(outputs.items()).items()}
 
 

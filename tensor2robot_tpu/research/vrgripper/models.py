@@ -791,7 +791,8 @@ def pack_wtl_meta_features(state, prev_episode_data, timestep,
   (obs, action, reward, ...) transition tuples — episode 0 the demo,
   episode 1 the first trial, etc. Output leaves all have leading
   [1 (task), E or I, fixed_length, ...] dims matching the models' input
-  specs (the post-preprocessor layout, which is what predictors feed).
+  specs — the post-preprocessor (model) layout, fed through a
+  predictor's `predict_preprocessed` (WTLPolicy does this).
   """
   del timestep
   if len(prev_episode_data) < 1:
