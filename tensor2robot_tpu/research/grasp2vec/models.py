@@ -32,19 +32,47 @@ __all__ = ["SceneEmbedding", "GoalEmbedding", "Grasp2VecModel",
            "keypoint_heatmap"]
 
 
+TOWERS = ("conv", "resnet")
+
+
+def _tower_spatial_features(image: jnp.ndarray, tower: str,
+                            filters: Tuple[int, ...], resnet_size: int,
+                            train: bool) -> jnp.ndarray:
+  """Shared tower dispatch -> [B, H', W', C] spatial features.
+
+  'conv' is a small stride-2 stack; 'resnet' is the shared FiLM-ResNet
+  backbone's last spatial block, the analogue of the reference's
+  vendored Keras-style ResNet (grasp2vec/resnet.py:333-539). Must be
+  called inside an @nn.compact scope (creates submodules)."""
+  if tower == "resnet":
+    from tensor2robot_tpu.layers import film_resnet
+
+    _, endpoints = film_resnet.ResNet(
+        resnet_size=resnet_size, name="resnet")(image, train=train)
+    return endpoints["block_layer4"]
+  if tower != "conv":
+    raise ValueError(f"tower must be one of {TOWERS}, got {tower!r}")
+  x = image
+  for i, f in enumerate(filters):
+    x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
+    x = nn.LayerNorm(name=f"norm_{i}")(x)
+    x = nn.relu(x)
+  return x
+
+
 class SceneEmbedding(nn.Module):
-  """Conv tower -> (pooled embedding, spatial feature map)."""
+  """Tower -> (pooled embedding, spatial feature map); the spatial map
+  feeds localization heatmaps."""
 
   embedding_size: int = 64
   filters: Tuple[int, ...] = (32, 64, 64)
+  tower: str = "conv"  # 'conv' | 'resnet'
+  resnet_size: int = 18
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
-    x = image
-    for i, f in enumerate(self.filters):
-      x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
-      x = nn.LayerNorm(name=f"norm_{i}")(x)
-      x = nn.relu(x)
+    x = _tower_spatial_features(image, self.tower, self.filters,
+                                self.resnet_size, train)
     spatial = nn.Conv(self.embedding_size, (1, 1), name="proj")(x)
     pooled = spatial.mean(axis=(1, 2))
     return pooled, spatial
@@ -53,14 +81,13 @@ class SceneEmbedding(nn.Module):
 class GoalEmbedding(nn.Module):
   embedding_size: int = 64
   filters: Tuple[int, ...] = (32, 64, 64)
+  tower: str = "conv"  # 'conv' | 'resnet'
+  resnet_size: int = 18
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
-    x = image
-    for i, f in enumerate(self.filters):
-      x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
-      x = nn.LayerNorm(name=f"norm_{i}")(x)
-      x = nn.relu(x)
+    x = _tower_spatial_features(image, self.tower, self.filters,
+                                self.resnet_size, train)
     x = x.mean(axis=(1, 2))
     return nn.Dense(self.embedding_size, name="proj")(x)
 
@@ -74,6 +101,8 @@ def keypoint_heatmap(spatial_features: jnp.ndarray,
 
 class _Grasp2VecNetwork(nn.Module):
   embedding_size: int = 64
+  tower: str = "conv"
+  resnet_size: int = 18
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
@@ -83,8 +112,10 @@ class _Grasp2VecNetwork(nn.Module):
         return img.astype(jnp.float32) / 255.0
       return img
 
-    scene = SceneEmbedding(self.embedding_size, name="scene")
-    goal = GoalEmbedding(self.embedding_size, name="goal")
+    scene = SceneEmbedding(self.embedding_size, tower=self.tower,
+                           resnet_size=self.resnet_size, name="scene")
+    goal = GoalEmbedding(self.embedding_size, tower=self.tower,
+                         resnet_size=self.resnet_size, name="goal")
     pregrasp, pregrasp_spatial = scene(_norm(features["pregrasp_image"]),
                                        train=train)
     postgrasp, postgrasp_spatial = scene(_norm(features["postgrasp_image"]),
@@ -112,6 +143,7 @@ class Grasp2VecModel(abstract_model.T2RModel):
                 "cosine_arithmetic")
 
   def __init__(self, image_size: int = 48, embedding_size: int = 64,
+               tower: str = "conv", resnet_size: int = 18,
                loss_type: str = "npairs",
                non_negativity_constraint: bool = False,
                triplet_margin: float = 3.0,
@@ -121,8 +153,12 @@ class Grasp2VecModel(abstract_model.T2RModel):
     if loss_type not in self.LOSS_TYPES:
       raise ValueError(f"loss_type must be one of {self.LOSS_TYPES}, "
                        f"got {loss_type!r}")
+    if tower not in TOWERS:
+      raise ValueError(f"tower must be one of {TOWERS}, got {tower!r}")
     self._image_size = image_size
     self._embedding_size = embedding_size
+    self._tower = tower
+    self._resnet_size = resnet_size
     self._loss_type = loss_type
     self._non_negativity_constraint = non_negativity_constraint
     self._triplet_margin = triplet_margin
@@ -153,7 +189,9 @@ class Grasp2VecModel(abstract_model.T2RModel):
     })
 
   def create_module(self):
-    return _Grasp2VecNetwork(embedding_size=self._embedding_size)
+    return _Grasp2VecNetwork(embedding_size=self._embedding_size,
+                             tower=self._tower,
+                             resnet_size=self._resnet_size)
 
   def _grasp_success(self, labels):
     if labels is not None and "grasp_success" in labels \

@@ -206,3 +206,26 @@ class TestModelIntegration:
   def test_invalid_loss_type_raises(self):
     with pytest.raises(ValueError):
       g2v_models.Grasp2VecModel(loss_type="nope", device_type="cpu")
+
+
+class TestResNetTower:
+
+  def test_resnet_tower_trains_and_keeps_spatial_map(self):
+    """tower='resnet' (reference vendored-ResNet analogue) trains and
+    still exposes a spatial map for localization heatmaps."""
+    model = g2v_models.Grasp2VecModel(
+        image_size=64, embedding_size=8, tower="resnet", resnet_size=18,
+        device_type="cpu", optimizer_fn=lambda: optax.adam(1e-3))
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification(modes.TRAIN), batch_size=2, seed=0)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    step = ts.make_train_step(model, donate=False)
+    _, metrics = step(state, features, specs_lib.SpecStruct())
+    assert np.isfinite(float(metrics["loss"]))
+    pred = ts.make_predict_fn(model)(state, features)
+    assert pred["heatmap"].shape == (2, 2, 2)  # 64px / 32 resnet stride
+    assert pred["pregrasp_spatial"].ndim == 4
+
+  def test_invalid_tower_raises(self):
+    with pytest.raises(ValueError, match="tower"):
+      g2v_models.Grasp2VecModel(tower="resnet18", device_type="cpu")
