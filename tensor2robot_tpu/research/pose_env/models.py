@@ -27,6 +27,14 @@ __all__ = ["PoseEnvRegressionModel", "PoseEnvContinuousMCModel"]
 IMAGE_SIZE = 32
 
 
+def _obs_image(state):
+  """Env observations may be the raw image array or the toy env's
+  {'image', 'timestep'} dict (envs/pose_env.py)."""
+  if isinstance(state, dict) and "image" in state:
+    return state["image"]
+  return state
+
+
 class _PoseRegressionNet(nn.Module):
   filters: Tuple[int, ...] = (32, 16)
 
@@ -46,9 +54,11 @@ class _PoseRegressionNet(nn.Module):
 class PoseEnvRegressionModel(heads.RegressionModel):
   """Behavioral cloning of the reach action from the rendered image."""
 
-  def __init__(self, image_size: int = IMAGE_SIZE, **kwargs):
+  def __init__(self, image_size: int = IMAGE_SIZE,
+               success_reward_threshold: float = 0.0, **kwargs):
     super().__init__(target_label_key="target_pose", **kwargs)
     self._image_size = image_size
+    self._success_reward_threshold = success_reward_threshold
 
   def get_feature_specification(self, mode):
     return SpecStruct({
@@ -61,10 +71,42 @@ class PoseEnvRegressionModel(heads.RegressionModel):
     return SpecStruct({
         "target_pose": TensorSpec(shape=(2,), dtype=np.float32,
                                   name="action/action"),
+        # Success-weighted behavioral cloning from random collects
+        # (reference loss_fn weights=labels.reward,
+        # pose_env_models.py:247-325): zero-reward episodes contribute
+        # no regression signal. Optional so unweighted data still trains.
+        "reward": TensorSpec(shape=(1,), dtype=np.float32, name="reward",
+                             is_optional=True),
     })
 
   def create_module(self):
     return _PoseRegressionNet()
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    predicted = inference_outputs["inference_output"]
+    target = labels["target_pose"]
+    if "reward" in labels and labels["reward"] is not None:
+      # Binarize into a success indicator: the reference assumes {0, 1}
+      # rewards, but this repo's toy env writes negative -distance MC
+      # returns — raw weights would flip the gradient sign and blow up
+      # through the clamped denominator.
+      weights = (labels["reward"] > self._success_reward_threshold
+                 ).astype(predicted.dtype)
+      per_example = ((predicted - target) ** 2).mean(axis=-1, keepdims=True)
+      loss = (per_example * weights).sum() / jnp.maximum(
+          weights.sum(), 1e-6)
+      return loss, {"weighted_mse": loss,
+                    "success_fraction": weights.mean()}
+    return super().model_train_fn(features, labels, inference_outputs,
+                                  mode)
+
+  def pack_features(self, state, context=None, timestep=0):
+    """Single observation -> batch-1 model features (reference
+    pack_features, pose_env_models.py:253-257). Accepts the raw image
+    array or this repo's env observation dict ({'image': ...})."""
+    del context, timestep
+    return SpecStruct({"state/image": np.expand_dims(
+        np.asarray(_obs_image(state)), 0)})
 
 
 class _PoseCriticNet(nn.Module):
@@ -109,3 +151,17 @@ class PoseEnvContinuousMCModel(heads.CriticModel):
 
   def create_module(self):
     return _PoseCriticNet()
+
+  def pack_features(self, state, context=None, timestep=0,
+                    actions=None):
+    """Observation (+ candidate actions) -> model features (reference
+    MC-model pack_features, pose_env_models.py:176-180)."""
+    del context, timestep
+    out = SpecStruct()
+    image = np.expand_dims(np.asarray(_obs_image(state)), 0)
+    if actions is not None:
+      actions = np.asarray(actions, np.float32)
+      image = np.repeat(image, actions.shape[0], axis=0)
+      out["action/action"] = actions
+    out["state/image"] = image
+    return out

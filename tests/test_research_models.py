@@ -142,3 +142,55 @@ class TestPCGrad:
     g1, g2 = self._grads()
     out = pcgrad.pcgrad_combine([g1, g2], use_flat_projection=True)
     assert set(out.keys()) == {"a", "b"}
+
+
+class TestPoseEnvReferenceParity:
+
+  def test_reward_weighted_regression(self):
+    """Zero-reward examples contribute no loss (reference success-weighted
+    BC, pose_env_models.py loss_fn weights=labels.reward)."""
+    from tensor2robot_tpu.research.pose_env import models as pose_models
+
+    model = pose_models.PoseEnvRegressionModel(device_type="cpu")
+    batch = 4
+    outputs = {"inference_output": jnp.ones((batch, 2))}
+    labels = specs_lib.SpecStruct({
+        "target_pose": np.zeros((batch, 2), np.float32),
+        "reward": np.array([[1.0], [0.0], [1.0], [0.0]], np.float32),
+    })
+    loss, scalars = model.model_train_fn({}, labels, outputs, modes.TRAIN)
+    # only the two reward-1 examples count; each has error 1.0 per dim
+    assert float(loss) == pytest.approx(1.0, rel=1e-5)
+    assert "weighted_mse" in scalars
+    assert float(scalars["success_fraction"]) == pytest.approx(0.5)
+    # Negative MC returns (this repo's toy-env replay) binarize to zero
+    # success and must NOT flip the gradient or blow up (review r2).
+    neg = specs_lib.SpecStruct({
+        "target_pose": np.zeros((batch, 2), np.float32),
+        "reward": np.full((batch, 1), -3.0, np.float32),
+    })
+    loss_neg, _ = model.model_train_fn({}, neg, outputs, modes.TRAIN)
+    assert float(loss_neg) == pytest.approx(0.0, abs=1e-6)
+    # without reward labels, plain MSE path
+    loss2, _ = model.model_train_fn(
+        {}, specs_lib.SpecStruct(
+            {"target_pose": np.zeros((batch, 2), np.float32)}),
+        outputs, modes.TRAIN)
+    assert float(loss2) == pytest.approx(1.0, rel=1e-5)
+
+  def test_pack_features_shapes(self):
+    from tensor2robot_tpu.research.pose_env import models as pose_models
+
+    reg = pose_models.PoseEnvRegressionModel(device_type="cpu")
+    obs = np.zeros((32, 32, 1), np.uint8)
+    packed = reg.pack_features(obs)
+    assert packed["state/image"].shape == (1, 32, 32, 1)
+    # the toy env's dict observation unwraps too (review r2)
+    packed_dict = reg.pack_features({"image": obs, "timestep": 3})
+    assert packed_dict["state/image"].shape == (1, 32, 32, 1)
+
+    critic = pose_models.PoseEnvContinuousMCModel(device_type="cpu")
+    actions = np.random.RandomState(0).rand(5, 2).astype(np.float32)
+    packed = critic.pack_features(obs, actions=actions)
+    assert packed["state/image"].shape == (5, 32, 32, 1)
+    assert packed["action/action"].shape == (5, 2)
