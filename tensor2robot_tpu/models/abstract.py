@@ -77,6 +77,7 @@ class T2RModel(ModelInterface):
                use_bfloat16: bool = False,
                use_ema: bool = False,
                ema_decay: float = 0.9999,
+               remat: bool = False,
                init_checkpoint: Optional[str] = None,
                init_checkpoint_filter: Optional[Callable[[str], bool]] = None,
                use_summaries: bool = True):
@@ -86,6 +87,10 @@ class T2RModel(ModelInterface):
     self._use_bfloat16 = use_bfloat16
     self._use_ema = use_ema
     self._ema_decay = ema_decay
+    # Rematerialization: recompute the forward during the backward
+    # instead of keeping activations live — trades MXU FLOPs for HBM,
+    # the standard fit-bigger-batches knob on TPU (jax.checkpoint).
+    self._remat = remat
     self._init_checkpoint = init_checkpoint
     self._init_checkpoint_filter = init_checkpoint_filter
     self._use_summaries = use_summaries and device_type != "tpu"
@@ -105,6 +110,10 @@ class T2RModel(ModelInterface):
   @property
   def use_ema(self) -> bool:
     return self._use_ema
+
+  @property
+  def remat(self) -> bool:
+    return self._remat
 
   @property
   def ema_decay(self) -> float:

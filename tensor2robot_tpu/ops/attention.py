@@ -386,15 +386,27 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh,
                    axis_name: str = "sp",
                    causal: bool = False,
-                   batch_axis: Optional[str] = "data") -> jnp.ndarray:
+                   batch_axis: Optional[str] = "data",
+                   block_k: Optional[int] = None) -> jnp.ndarray:
   """Exact attention with the sequence dim sharded over `axis_name`.
 
   Inputs are global [B, H, T, D] arrays (T divisible by the axis size).
   Each device keeps its Q shard resident and absorbs one rotating K/V
   block per ring hop; `ppermute` rides the ICI ring. Returns the global
   [B, H, T, D] output with the same sharding.
+
+  `block_k` additionally chunks each hop's K/V block through the online
+  softmax (a lax.scan), bounding per-hop score memory at
+  [B, H, Tq_local, block_k] instead of [B, H, Tq_local, Tk_local] —
+  flash-style streaming inside the ring, useful when the per-device
+  shard is itself long. Must divide the local block length.
   """
   axis_size = mesh.shape[axis_name]
+  if block_k is not None and (k.shape[2] // axis_size) % block_k:
+    raise ValueError(
+        f"block_k={block_k} must divide the per-device K length "
+        f"{k.shape[2] // axis_size} (T={k.shape[2]} over "
+        f"{axis_size} '{axis_name}' shards)")
   io_spec = PartitionSpec(batch_axis, None, axis_name, None)
 
   def local_fn(q_local, k_local, v_local):
@@ -404,15 +416,41 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     l = jnp.zeros(q_local.shape[:-1], jnp.float32)
     o = jnp.zeros(q_local.shape, jnp.float32)
     k_blk, v_blk = k_local, v_local
+
+    def absorb(src, m, l, o, k_blk, v_blk):
+      q_pos = idx * tq + jnp.arange(tq)
+      if block_k is None:
+        mask = None
+        if causal:
+          k_pos = src * tq + jnp.arange(tq)
+          mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        return _online_block_update(q_local, k_blk, v_blk, m, l, o, mask)
+      num_chunks = k_blk.shape[2] // block_k  # divisibility checked above
+      # [C, B, H, block_k, D] chunk-major for the scan.
+      k_chunks = jnp.moveaxis(
+          k_blk.reshape(k_blk.shape[:2] + (num_chunks, block_k, -1)),
+          2, 0)
+      v_chunks = jnp.moveaxis(
+          v_blk.reshape(v_blk.shape[:2] + (num_chunks, block_k, -1)),
+          2, 0)
+
+      def chunk_step(carry, chunk):
+        m, l, o = carry
+        c_idx, k_c, v_c = chunk
+        mask = None
+        if causal:
+          k_pos = src * tq + c_idx * block_k + jnp.arange(block_k)
+          mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+        return _online_block_update(q_local, k_c, v_c, m, l, o, mask), None
+
+      (m, l, o), _ = jax.lax.scan(
+          chunk_step, (m, l, o),
+          (jnp.arange(num_chunks), k_chunks, v_chunks))
+      return m, l, o
+
     for step in range(axis_size):
       src = (idx - step) % axis_size  # whose shard we currently hold
-      mask = None
-      if causal:
-        q_pos = idx * tq + jnp.arange(tq)
-        k_pos = src * tq + jnp.arange(tq)
-        mask = q_pos[:, None] >= k_pos[None, :]
-        mask = mask[None, None]  # broadcast over [B, H]
-      m, l, o = _online_block_update(q_local, k_blk, v_blk, m, l, o, mask)
+      m, l, o = absorb(src, m, l, o, k_blk, v_blk)
       if step + 1 < axis_size:
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)

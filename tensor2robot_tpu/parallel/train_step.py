@@ -183,7 +183,7 @@ def make_train_step(model,
   def step_fn(state: TrainState, features, labels):
     step_rng = jax.random.fold_in(state.rng, state.step)
 
-    def _forward(params):
+    def _forward_impl(params, features):
       variables = {"params": params, **state.mutable_state}
       compute_features = model.cast_features_for_compute(features)
       outputs, new_mutable = model.inference_network_fn(
@@ -193,6 +193,15 @@ def make_train_step(model,
           lambda x: x.astype(jnp.float32)
           if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 else x, outputs)
       return outputs, new_mutable
+
+    if getattr(model, "remat", False):
+      # Recompute the forward in the backward pass instead of storing
+      # activations (jax.checkpoint): HBM for FLOPs, the standard knob
+      # for fitting reference-scale batches on one chip.
+      _forward_impl = jax.checkpoint(_forward_impl)
+
+    def _forward(params):
+      return _forward_impl(params, features)
 
     if use_pcgrad:
       from tensor2robot_tpu.ops import pcgrad as pcgrad_lib

@@ -55,9 +55,13 @@ class PoseEnvRegressionModel(heads.RegressionModel):
   """Behavioral cloning of the reach action from the rendered image."""
 
   def __init__(self, image_size: int = IMAGE_SIZE,
-               success_reward_threshold: float = 0.0, **kwargs):
+               success_reward_threshold: float = -0.25, **kwargs):
     super().__init__(target_label_key="target_pose", **kwargs)
     self._image_size = image_size
+    # Default matches the bundled toy env's reward scale: per-step
+    # reward is -distance in the [-1, 1]^2 box (envs/pose_env.py:65), so
+    # MC returns near 0 mean a close reach. For reference-style {0, 1}
+    # success rewards set e.g. 0.5 via gin.
     self._success_reward_threshold = success_reward_threshold
 
   def get_feature_specification(self, mode):
@@ -83,8 +87,8 @@ class PoseEnvRegressionModel(heads.RegressionModel):
     return _PoseRegressionNet()
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
-    predicted = inference_outputs["inference_output"]
-    target = labels["target_pose"]
+    predicted = inference_outputs[self._output_key]
+    target = labels[self._target_label_key]
     if "reward" in labels and labels["reward"] is not None:
       # Binarize into a success indicator: the reference assumes {0, 1}
       # rewards, but this repo's toy env writes negative -distance MC
@@ -157,11 +161,15 @@ class PoseEnvContinuousMCModel(heads.CriticModel):
     """Observation (+ candidate actions) -> model features (reference
     MC-model pack_features, pose_env_models.py:176-180)."""
     del context, timestep
+    if actions is None:
+      raise ValueError(
+          "PoseEnvContinuousMCModel.pack_features requires candidate "
+          "`actions` — the critic's feature spec has a non-optional "
+          "action/action input.")
     out = SpecStruct()
-    image = np.expand_dims(np.asarray(_obs_image(state)), 0)
-    if actions is not None:
-      actions = np.asarray(actions, np.float32)
-      image = np.repeat(image, actions.shape[0], axis=0)
-      out["action/action"] = actions
+    actions = np.asarray(actions, np.float32)
+    image = np.repeat(np.expand_dims(np.asarray(_obs_image(state)), 0),
+                      actions.shape[0], axis=0)
+    out["action/action"] = actions
     out["state/image"] = image
     return out

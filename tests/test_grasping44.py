@@ -231,3 +231,24 @@ class TestOptimizerHParams:
     params = {"w": jnp.ones((3, 3))}
     updates, _ = tx.update({"w": jnp.ones((3, 3))}, tx.init(params), params)
     assert np.abs(np.asarray(updates["w"])).max() > 0
+
+
+class TestRemat:
+
+  def test_remat_matches_plain_training(self):
+    """remat=True recomputes activations in the backward but must be
+    numerically identical (jax.checkpoint) and still thread BN stats."""
+    results = {}
+    for remat in (False, True):
+      model = _small_model(remat=remat)
+      features, labels = _batch(model)
+      state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                       features)
+      step = ts.make_train_step(model, donate=False)
+      state, metrics = step(state, features, labels)
+      state, metrics = step(state, features, labels)
+      results[remat] = (float(metrics["loss"]),
+                        jax.tree_util.tree_leaves(state.params)[0])
+    assert results[False][0] == pytest.approx(results[True][0], rel=1e-6)
+    np.testing.assert_allclose(np.asarray(results[True][1]),
+                               np.asarray(results[False][1]), atol=1e-6)

@@ -154,6 +154,8 @@ class TestPoseEnvReferenceParity:
     model = pose_models.PoseEnvRegressionModel(device_type="cpu")
     batch = 4
     outputs = {"inference_output": jnp.ones((batch, 2))}
+    model = pose_models.PoseEnvRegressionModel(
+        device_type="cpu", success_reward_threshold=0.5)  # {0,1} rewards
     labels = specs_lib.SpecStruct({
         "target_pose": np.zeros((batch, 2), np.float32),
         "reward": np.array([[1.0], [0.0], [1.0], [0.0]], np.float32),
@@ -163,14 +165,19 @@ class TestPoseEnvReferenceParity:
     assert float(loss) == pytest.approx(1.0, rel=1e-5)
     assert "weighted_mse" in scalars
     assert float(scalars["success_fraction"]) == pytest.approx(0.5)
-    # Negative MC returns (this repo's toy-env replay) binarize to zero
-    # success and must NOT flip the gradient or blow up (review r2).
-    neg = specs_lib.SpecStruct({
+    # The bundled toy env writes negative -distance MC returns; the
+    # default threshold (-0.25) treats near-zero returns as successes so
+    # its own replay is trainable, while far-miss episodes drop out and
+    # can never flip the gradient (review r2).
+    env_like = specs_lib.SpecStruct({
         "target_pose": np.zeros((batch, 2), np.float32),
-        "reward": np.full((batch, 1), -3.0, np.float32),
+        "reward": np.array([[-0.05], [-1.5], [-0.1], [-2.0]], np.float32),
     })
-    loss_neg, _ = model.model_train_fn({}, neg, outputs, modes.TRAIN)
-    assert float(loss_neg) == pytest.approx(0.0, abs=1e-6)
+    model_default = pose_models.PoseEnvRegressionModel(device_type="cpu")
+    loss_env, scalars_env = model_default.model_train_fn(
+        {}, env_like, outputs, modes.TRAIN)
+    assert float(scalars_env["success_fraction"]) == pytest.approx(0.5)
+    assert float(loss_env) == pytest.approx(1.0, rel=1e-5)
     # without reward labels, plain MSE path
     loss2, _ = model.model_train_fn(
         {}, specs_lib.SpecStruct(
@@ -194,3 +201,5 @@ class TestPoseEnvReferenceParity:
     packed = critic.pack_features(obs, actions=actions)
     assert packed["state/image"].shape == (5, 32, 32, 1)
     assert packed["action/action"].shape == (5, 2)
+    with pytest.raises(ValueError, match="actions"):
+      critic.pack_features(obs)
