@@ -17,6 +17,7 @@ from typing import Iterator, List, Optional
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [os.path.join(_DIR, "tfrecord_io.cc"),
             os.path.join(_DIR, "example_parser.cc")]
+_JPEG_SOURCE = os.path.join(_DIR, "jpeg_decode.cc")
 _LIB_PATH = os.path.join(_DIR, "libt2r_native.so")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -24,14 +25,22 @@ _LOAD_FAILED = False
 
 
 def _build() -> bool:
-  try:
-    subprocess.run(
-        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", *_SOURCES,
-         "-o", _LIB_PATH],
-        check=True, capture_output=True, timeout=120)
-    return True
-  except Exception:
-    return False
+  # Preferred build includes the libjpeg-backed batch decoder; if the
+  # toolchain lacks jpeglib.h / -ljpeg, fall back to building without it
+  # (the reader/parser fast paths must not depend on libjpeg).
+  base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
+  attempts = [
+      base + [*_SOURCES, _JPEG_SOURCE, "-o", _LIB_PATH, "-ljpeg",
+              "-lpthread"],
+      base + [*_SOURCES, "-o", _LIB_PATH],
+  ]
+  for cmd in attempts:
+    try:
+      subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+      return True
+    except Exception:
+      continue
+  return False
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -43,7 +52,7 @@ def load() -> Optional[ctypes.CDLL]:
       return _LIB
     if not os.path.isfile(_LIB_PATH) or any(
         os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-        for src in _SOURCES):
+        for src in [*_SOURCES, _JPEG_SOURCE]):
       if not _build():
         _LOAD_FAILED = True
         return None
@@ -92,6 +101,12 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_uint8)]
+    if hasattr(lib, "t2r_decode_jpeg_batch"):  # libjpeg build variant
+      lib.t2r_decode_jpeg_batch.restype = ctypes.c_int
+      lib.t2r_decode_jpeg_batch.argtypes = [
+          ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+          ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+          ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
     _LIB = lib
     return _LIB
 
@@ -132,6 +147,34 @@ def iter_records_native(path: str, verify_crc: bool = False,
             ctypes.addressof(data.contents) + offsets[i], lengths[i])
   finally:
     lib.t2r_reader_close(handle)
+
+
+def decode_jpeg_batch(datas, height: int, width: int, channels: int,
+                      num_threads: int = 0):
+  """GIL-free batched JPEG decode to a uint8 [N, H, W, C] array.
+
+  Returns None when unavailable (no libjpeg build) or when ANY image in
+  the batch fails to decode to exactly (height, width, channels) — the
+  caller then takes the Python (PIL) path for the whole batch.
+  """
+  import numpy as np
+
+  lib = load()
+  if lib is None or not hasattr(lib, "t2r_decode_jpeg_batch"):
+    return None
+  datas = list(datas)
+  n = len(datas)
+  if n == 0:
+    return np.zeros((0, height, width, channels), np.uint8)
+  if any(not d for d in datas):
+    return None  # empty payloads use the Python zeros fallback
+  arr = (ctypes.c_char_p * n)(*datas)
+  lens = (ctypes.c_int64 * n)(*[len(d) for d in datas])
+  out = np.empty((n, height, width, channels), np.uint8)
+  status = lib.t2r_decode_jpeg_batch(
+      arr, lens, n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+      height, width, channels, num_threads)
+  return out if status == 0 else None
 
 
 KIND_FLOAT, KIND_INT64, KIND_BYTES = 0, 1, 2
