@@ -58,6 +58,37 @@ def _is_identity_preprocessor(preprocessor) -> bool:
                     preprocessors_lib.NoOpPreprocessor)
 
 
+def _preprocess_is_traceable(model) -> bool:
+  """True iff the PREDICT-mode preprocessor jit-traces (pure jnp ops).
+
+  Probed with jax.eval_shape over in-spec placeholders: a host-side
+  preprocessor (numpy math, PIL decode, python RNG on values) raises on
+  abstract tracers; a jnp-pure one traces and can therefore be embedded
+  into a jax2tf SavedModel (the reference serves preprocess inside the
+  receiver graph, default_export_generator.py:56-82 — this restores that
+  parity for embeddable preprocessors).
+  """
+  preprocessor = model.preprocessor
+  try:
+    in_spec = specs_lib.filter_required(
+        preprocessor.get_in_feature_specification(modes_lib.PREDICT))
+    placeholders = specs_lib.SpecStruct()
+    for key, spec in in_spec.items():
+      placeholders[key] = jax.ShapeDtypeStruct(
+          (2,) + tuple(d if d is not None else 3 for d in spec.shape),
+          np.dtype(spec.dtype))
+
+    def run(feats):
+      out, _ = preprocessor.preprocess(feats, specs_lib.SpecStruct(),
+                                       modes_lib.PREDICT)
+      return out
+
+    jax.eval_shape(run, placeholders)
+    return True
+  except Exception:  # noqa: BLE001 - any failure means "not embeddable"
+    return False
+
+
 class AbstractExportGenerator:
   """Holds model specs; produces timestamped export bundles."""
 
@@ -126,6 +157,8 @@ class DefaultExportGenerator(AbstractExportGenerator):
         "model_class": f"{type(model).__module__}.{type(model).__qualname__}",
         "outputs": outputs,
         "raw_receivers": self._export_raw_receivers,
+        "preprocessor_embedded": getattr(self, "_embed_preprocessor",
+                                         False),
         "global_step": step,
     }
     with open(os.path.join(path, SIGNATURE_FILENAME), "w") as f:
@@ -149,21 +182,28 @@ class DefaultExportGenerator(AbstractExportGenerator):
       self._check_saved_model_compat(model)
 
   def _check_saved_model_compat(self, model) -> None:
-    """The SavedModel wraps the jitted predict fn WITHOUT the host-side
-    preprocessor (numpy/stateful transforms are not jax2tf-traceable).
-    With a non-identity preprocessor and in-spec receivers it would
-    trace fine (size-agnostic convs) yet serve silently wrong,
-    distribution-shifted outputs (ADVICE r1) — refuse loudly instead."""
+    """Decides how the SavedModel treats the preprocessor.
+
+    jnp-pure preprocessors are EMBEDDED into the jax2tf graph (the
+    SavedModel serves wire-layout features, reference receiver parity).
+    Host-side preprocessors (numpy/PIL/stateful — not jax2tf-traceable)
+    cannot embed; wrapping the raw predict fn behind in-spec receivers
+    would trace fine yet serve silently wrong, distribution-shifted
+    outputs (ADVICE r1) — refuse loudly instead."""
+    self._embed_preprocessor = False
     if self._export_raw_receivers or _is_identity_preprocessor(
         model.preprocessor):
       return
+    if _preprocess_is_traceable(model):
+      self._embed_preprocessor = True
+      return
     inner = _unwrap_preprocessor(model.preprocessor)
     raise ValueError(
-        f"write_saved_model=True with the non-identity preprocessor "
-        f"{type(inner).__name__} requires export_raw_receivers=True "
-        "(clients feed model-layout, already-preprocessed features); "
-        "the pure-JAX bundle applies the preprocessor and serves "
-        "wire-layout features.")
+        f"write_saved_model=True with the non-embeddable host-side "
+        f"preprocessor {type(inner).__name__} requires "
+        "export_raw_receivers=True (clients feed model-layout, "
+        "already-preprocessed features); the pure-JAX bundle applies the "
+        "preprocessor and serves wire-layout features.")
 
   def _predict_with_preprocess(self, model):
     from tensor2robot_tpu.parallel import train_step as ts
@@ -196,7 +236,9 @@ class DefaultExportGenerator(AbstractExportGenerator):
                           saved_model_dir: str) -> None:
     """jax2tf SavedModel with a dense numpy-feed signature whose input
     names are the spec `name`s (robot-side feed compatibility,
-    SURVEY.md §7 hard parts)."""
+    SURVEY.md §7 hard parts). When the preprocessor is jnp-pure it runs
+    INSIDE the exported graph, so the SavedModel accepts the same
+    wire-layout feeds as the reference's serving receivers."""
     import tensorflow as tf
     from jax.experimental import jax2tf
     from tensor2robot_tpu.parallel import train_step as ts
@@ -205,11 +247,15 @@ class DefaultExportGenerator(AbstractExportGenerator):
     host_state = jax.device_get(state)
     flat_spec = specs_lib.filter_required(feature_spec)
     keys = list(flat_spec.keys())
+    embed = getattr(self, "_embed_preprocessor", False)
 
     def jax_fn(*arrays):
       features = specs_lib.SpecStruct()
       for key, array in zip(keys, arrays):
         features[key] = array
+      if embed:
+        features, _ = model.preprocessor.preprocess(
+            features, specs_lib.SpecStruct(), modes_lib.PREDICT)
       return dict(predict(host_state, features).items())
 
     # Dynamic batch dim via shape polymorphism: serving batches (e.g. CEM

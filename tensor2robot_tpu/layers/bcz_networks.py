@@ -10,7 +10,7 @@ a GRU cell — static-shape, scan-based, TPU-friendly.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -28,6 +28,7 @@ class ConvGRUEncoder(nn.Module):
 
   hidden_size: int = 128
   filters: Sequence[int] = (32, 32)
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, frames: jnp.ndarray,
@@ -41,7 +42,7 @@ class ConvGRUEncoder(nn.Module):
     torso = BerkeleyNet(filters=tuple(self.filters),
                         kernel_sizes=(5,) + (3,) * (len(self.filters) - 1),
                         strides=(2,) + (1,) * (len(self.filters) - 1),
-                        name="torso")
+                        dtype=self.dtype, name="torso")
     points = torso(flat, cond, train=train)
     points = points.reshape(b, t, -1)
     rnn = nn.RNN(nn.GRUCell(features=self.hidden_size), name="gru")

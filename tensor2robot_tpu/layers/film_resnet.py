@@ -11,10 +11,12 @@ normalization — all shapes static so XLA tiles convs onto the MXU.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from tensor2robot_tpu.ops.image_norm import normalize_image
 
 __all__ = ["ResNet", "LinearFilmGenerator", "RESNET_BLOCK_SIZES"]
 
@@ -58,11 +60,15 @@ def _film_modulate(x, gamma, beta):
 class _BasicBlock(nn.Module):
   filters: int
   strides: int = 1
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x, film_params=None, train: bool = False):
+    # Explicit BN dtype: with dtype=None flax BatchNorm promotes its
+    # output to f32 (f32 stats win the promotion), silently turning the
+    # rest of a bf16 tower into f32.
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
-                                     name=name)
+                                     dtype=self.dtype, name=name)
     shortcut = x
     y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
                 use_bias=False, name="conv1")(x)
@@ -83,11 +89,12 @@ class _BasicBlock(nn.Module):
 class _BottleneckBlock(nn.Module):
   filters: int
   strides: int = 1
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x, film_params=None, train: bool = False):
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
-                                     name=name)
+                                     dtype=self.dtype, name=name)
     shortcut = x
     y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
     y = nn.relu(norm("bn1")(y))
@@ -107,19 +114,84 @@ class _BottleneckBlock(nn.Module):
     return nn.relu(y + shortcut)
 
 
+class _BasicBlockV2(nn.Module):
+  """Pre-activation basic block (reference `_building_block_v2`,
+  film_resnet_model.py:195-217): BN+relu precede each conv, the shortcut
+  taps the pre-activated input, and FiLM modulates after the block's LAST
+  BatchNorm — before the relu and the final conv — at `filters` width."""
+
+  filters: int
+  strides: int = 1
+  dtype: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, x, film_params=None, train: bool = False):
+    norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     dtype=self.dtype, name=name)
+    preact = nn.relu(norm("bn1")(x))
+    needs_proj = (x.shape[-1] != self.filters) or self.strides != 1
+    shortcut = (nn.Conv(self.filters, (1, 1), strides=(self.strides,) * 2,
+                        use_bias=False, name="proj")(preact)
+                if needs_proj else x)
+    y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                use_bias=False, name="conv1")(preact)
+    y = norm("bn2")(y)
+    if film_params is not None:
+      gamma, beta = film_params
+      y = _film_modulate(y, gamma.astype(y.dtype), beta.astype(y.dtype))
+    y = nn.Conv(self.filters, (3, 3), use_bias=False,
+                name="conv2")(nn.relu(y))
+    return y + shortcut
+
+
+class _BottleneckBlockV2(nn.Module):
+  """Pre-activation bottleneck (reference `_bottleneck_block_v2`,
+  film_resnet_model.py:320-341); FiLM after the last BN at `filters`
+  (not 4*filters) width, before the relu and the final 1x1 conv."""
+
+  filters: int
+  strides: int = 1
+  dtype: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, x, film_params=None, train: bool = False):
+    norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     dtype=self.dtype, name=name)
+    preact = nn.relu(norm("bn1")(x))
+    needs_proj = (x.shape[-1] != 4 * self.filters) or self.strides != 1
+    shortcut = (nn.Conv(4 * self.filters, (1, 1),
+                        strides=(self.strides,) * 2, use_bias=False,
+                        name="proj")(preact)
+                if needs_proj else x)
+    y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(preact)
+    y = nn.relu(norm("bn2")(y))
+    y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                use_bias=False, name="conv2")(y)
+    y = norm("bn3")(y)
+    if film_params is not None:
+      gamma, beta = film_params
+      y = _film_modulate(y, gamma.astype(y.dtype), beta.astype(y.dtype))
+    y = nn.Conv(4 * self.filters, (1, 1), use_bias=False,
+                name="conv3")(nn.relu(y))
+    return y + shortcut
+
+
 class ResNet(nn.Module):
-  """ResNet v1 with optional FiLM conditioning and endpoint extraction.
+  """ResNet v1/v2 with optional FiLM conditioning and endpoint extraction.
 
   `__call__` returns (features, endpoints): features is the pooled final
   representation (or logits when num_classes is set); endpoints maps
   block-layer names to intermediate activations (reference endpoint
-  extraction, resnet.py:80-94).
+  extraction, resnet.py:80-94). `version=2` selects pre-activation
+  blocks (reference film_resnet_model.py supports both v1 and v2).
   """
 
   resnet_size: int = 18
   num_classes: Optional[int] = None
   width_multiplier: float = 1.0
   film_generator: Optional[Callable] = None
+  version: int = 1  # 1 (post-activation) | 2 (pre-activation)
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, images: jnp.ndarray,
@@ -128,25 +200,36 @@ class ResNet(nn.Module):
     if self.resnet_size not in RESNET_BLOCK_SIZES:
       raise ValueError(f"Unsupported resnet_size {self.resnet_size}; "
                        f"choose from {sorted(RESNET_BLOCK_SIZES)}")
+    if self.version not in (1, 2):
+      raise ValueError(f"version must be 1 or 2, got {self.version}")
     blocks_per_layer = RESNET_BLOCK_SIZES[self.resnet_size]
-    block_cls = (_BottleneckBlock if self.resnet_size >= _BOTTLENECK_FROM
-                 else _BasicBlock)
+    bottleneck = self.resnet_size >= _BOTTLENECK_FROM
+    if self.version == 1:
+      block_cls = _BottleneckBlock if bottleneck else _BasicBlock
+    else:
+      block_cls = _BottleneckBlockV2 if bottleneck else _BasicBlockV2
     base_channels = [int(c * self.width_multiplier)
                      for c in (64, 128, 256, 512)]
 
     film_params = None
     if conditioning is not None:
+      # v1 modulates the block output (4*filters for bottleneck); v2
+      # modulates after the last BN at `filters` width (reference
+      # film_resnet_model.py:210-215, 333-338).
+      film_width = 4 if (bottleneck and self.version == 1) else 1
       generator = self.film_generator or LinearFilmGenerator(
-          block_channels=[c * (4 if block_cls is _BottleneckBlock else 1)
-                          for c in base_channels],
+          block_channels=[c * film_width for c in base_channels],
           blocks_per_layer=blocks_per_layer,
           name="film_generator")
       film_params = generator(conditioning)
 
+    images = normalize_image(images, self.dtype)
     x = nn.Conv(base_channels[0], (7, 7), strides=(2, 2), use_bias=False,
                 name="conv_stem")(images)
-    x = nn.relu(nn.BatchNorm(use_running_average=not train,
-                             name="bn_stem")(x))
+    if self.version == 1:
+      # v2 defers normalization to the first block's pre-activation.
+      x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                               dtype=self.dtype, name="bn_stem")(x))
     x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
     endpoints = {}
@@ -156,11 +239,15 @@ class ResNet(nn.Module):
         strides = 2 if (block_idx == 0 and layer_idx > 0) else 1
         block_film = (film_params[layer_idx][block_idx]
                       if film_params is not None else None)
-        x = block_cls(channels, strides,
+        x = block_cls(channels, strides, dtype=self.dtype,
                       name=f"layer{layer_idx + 1}_block{block_idx}")(
                           x, film_params=block_film, train=train)
       endpoints[f"block_layer{layer_idx + 1}"] = x
 
+    if self.version == 2:
+      # v2 closes with a final normalization + activation before pooling.
+      x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                               dtype=self.dtype, name="bn_final")(x))
     x = x.mean(axis=(1, 2))  # global average pool
     endpoints["final_reduce_mean"] = x
     if self.num_classes is not None:

@@ -34,10 +34,16 @@ def spatial_softmax(features: jnp.ndarray,
         jax.random.uniform(gumbel_key, flat.shape, minval=1e-10,
                            maxval=1.0) + 1e-10))
     flat = flat + gumbel
-  attention = jax.nn.softmax(flat, axis=-1)  # [B, C, H*W]
+  # The softmax runs in f32 (exp/normalization stability); the expectation
+  # then runs in the tower's compute dtype — on TPU a bf16 dot still
+  # accumulates in f32 on the MXU, and keeping the [B, C, H*W] attention
+  # tensor bf16 halves its HBM traffic. The output stays in the compute
+  # dtype so it cannot promote downstream bf16 layers to f32.
+  attention = jax.nn.softmax(flat, axis=-1).astype(features.dtype)
   pos_x, pos_y = jnp.meshgrid(jnp.linspace(-1.0, 1.0, w),
                               jnp.linspace(-1.0, 1.0, h))
-  pos = jnp.stack([pos_x.ravel(), pos_y.ravel()], axis=-1)  # [H*W, 2]
+  pos = jnp.stack([pos_x.ravel(), pos_y.ravel()],
+                  axis=-1).astype(features.dtype)  # [H*W, 2]
   points = attention @ pos  # [B, C, 2]
   return points.reshape(b, c * 2)
 

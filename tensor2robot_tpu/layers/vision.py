@@ -10,12 +10,13 @@ dtype so the MXU sees bfloat16.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from tensor2robot_tpu.layers.spatial_softmax import SpatialSoftmax
+from tensor2robot_tpu.ops.image_norm import normalize_image
 
 __all__ = ["FilmParams", "film", "BerkeleyNet", "HighResBerkeleyNet",
            "PoseHead"]
@@ -53,20 +54,23 @@ class BerkeleyNet(nn.Module):
   strides: Sequence[int] = (2, 1, 1)
   use_spatial_softmax: bool = True
   normalizer: str = "layer_norm"  # 'batch_norm'|'layer_norm'|'none'
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, images: jnp.ndarray,
                conditioning: Optional[jnp.ndarray] = None,
                train: bool = False) -> jnp.ndarray:
-    x = images
+    x = normalize_image(images, self.dtype)
     for i, (f, k, s) in enumerate(zip(self.filters, self.kernel_sizes,
                                       self.strides)):
       x = nn.Conv(f, (k, k), strides=(s, s), name=f"conv_{i}")(x)
+      # Explicit norm dtype: with dtype=None the f32 stats/params win the
+      # flax promotion and the rest of a bf16 tower silently runs f32.
       if self.normalizer == "batch_norm":
-        x = nn.BatchNorm(use_running_average=not train,
+        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
                          name=f"norm_{i}")(x)
       elif self.normalizer == "layer_norm":
-        x = nn.LayerNorm(name=f"norm_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, name=f"norm_{i}")(x)
       if conditioning is not None:
         gamma, beta = FilmParams(f, name=f"film_{i}")(conditioning)
         x = film(x, gamma.astype(x.dtype), beta.astype(x.dtype))
@@ -82,13 +86,17 @@ class HighResBerkeleyNet(nn.Module):
 
   filters: Sequence[int] = (64, 32, 32)
   high_res_filters: int = 16
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, images: jnp.ndarray,
                conditioning: Optional[jnp.ndarray] = None,
                train: bool = False) -> jnp.ndarray:
-    points = BerkeleyNet(filters=self.filters, name="main")(
-        images, conditioning, train=train)
+    # Normalize once so both branches see the same scale and dtype
+    # (BerkeleyNet's internal normalize_image is a no-op on the result).
+    images = normalize_image(images, self.dtype)
+    points = BerkeleyNet(filters=self.filters, dtype=self.dtype,
+                         name="main")(images, conditioning, train=train)
     hi = nn.Conv(self.high_res_filters, (3, 3), name="high_res_conv")(images)
     hi = nn.relu(hi)
     hi_points = SpatialSoftmax(name="high_res_ssm")(hi, train=train)

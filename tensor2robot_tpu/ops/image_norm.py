@@ -1,0 +1,39 @@
+"""Device-side image normalization that respects the compute dtype.
+
+The reference casts uint8 images to float inside each network
+(/root/reference/research/qtopt/networks.py input conversions and the
+bfloat16_scope in tpu_model_wrapper.py:185-191). In flax, a module that
+writes ``image.astype(jnp.float32) / 255`` poisons the whole tower: every
+layer promotes to the widest input dtype, so one f32 activation silently
+turns all bf16-policy convolutions into f32 (measured on the Grasping44
+train step: 47/47 f32 convolutions before this fix). Normalizing INTO the
+module's compute dtype keeps the tower on the MXU's bf16 path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["normalize_image"]
+
+
+def normalize_image(image: jnp.ndarray,
+                    dtype: Optional[Any] = None) -> jnp.ndarray:
+  """uint8 [0, 255] -> float [0, 1] in ``dtype``; float passes through.
+
+  Args:
+    image: integer wire image or an already-normalized float image.
+    dtype: the module's compute dtype (e.g. ``jnp.bfloat16`` under the
+      bfloat16 policy). ``None`` keeps float32 for integer inputs and
+      leaves float inputs' dtype untouched.
+  """
+  # jnp.asarray first: on a raw numpy input, numpy's promotion rules would
+  # turn `bf16_array / 255.0` back into float32; jax weak typing keeps the
+  # requested dtype (and is a no-op on tracers inside jit).
+  if jnp.issubdtype(image.dtype, jnp.integer):
+    image = jnp.asarray(image).astype(dtype or jnp.float32) / 255.0
+  elif dtype is not None and image.dtype != dtype:
+    image = jnp.asarray(image).astype(dtype)
+  return image

@@ -16,7 +16,7 @@ stop-gradient MultiHeadMLP; mixup and image distortion run as jnp ops.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -27,6 +27,7 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.layers import bcz_networks, film_resnet, vision
 from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.preprocessors import base as preprocessors_lib
 from tensor2robot_tpu.preprocessors import image_ops
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
@@ -185,6 +186,7 @@ class _BCZNetwork(nn.Module):
   num_waypoints: int = 10
   network: str = "resnet_film"  # 'resnet_film' | 'spatial_softmax'
   resnet_size: int = 18
+  resnet_version: int = 1
   condition_mode: Optional[str] = None  # 'language' | 'onehot_taskid'
   condition_size: int = 0    # language-embedding width
   num_subtasks: int = 0      # one-hot task-id vocabulary
@@ -197,13 +199,12 @@ class _BCZNetwork(nn.Module):
 
   predict_stop: bool = True
   predict_stop_state: bool = False  # 3-class continue/fail/success head
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    image = features["image"]
-    if jnp.issubdtype(image.dtype, jnp.integer):
-      image = image.astype(jnp.float32) / 255.0
+    image = normalize_image(features["image"], self.dtype)
     # Conditioning vector (reference ConditionMode + user-id conditioning
     # + augment_condition_input, bcz/model.py:63-66, 756-846): a language
     # embedding or a one-hot subtask id, optionally noise-augmented or
@@ -234,22 +235,21 @@ class _BCZNetwork(nn.Module):
                     if conditioning_parts else None)
     if self.network == "resnet_film":
       feats, _ = film_resnet.ResNet(
-          resnet_size=self.resnet_size, name="resnet")(
+          resnet_size=self.resnet_size, version=self.resnet_version,
+          dtype=self.dtype, name="resnet")(
               image, conditioning, train=train)
     else:
-      feats = vision.BerkeleyNet(name="tower")(image, conditioning,
-                                               train=train)
+      feats = vision.BerkeleyNet(dtype=self.dtype, name="tower")(
+          image, conditioning, train=train)
     if self.use_past_frames:
       # Past-frame conditioning (reference past-conditioning): a small
       # ConvGRU over the history, final hidden state concatenated.
       # Gated on static config (not feature presence) so module
       # structure cannot vary between batches.
-      past = features["past_frames"]
-      if jnp.issubdtype(past.dtype, jnp.integer):
-        past = past.astype(jnp.float32) / 255.0
+      past = normalize_image(features["past_frames"], self.dtype)
       history = bcz_networks.ConvGRUEncoder(
           hidden_size=self.past_frames_hidden, filters=(16,),
-          name="past_encoder")(past, train=train)
+          dtype=self.dtype, name="past_encoder")(past, train=train)
       feats = jnp.concatenate(
           [feats, history[:, -1].astype(feats.dtype)], axis=-1)
     if "present_pose" in features:
@@ -309,6 +309,7 @@ class BCZModel(abstract_model.T2RModel):
                components: Sequence = POSE_COMPONENTS,
                network: str = "resnet_film",
                resnet_size: int = 18,
+               resnet_version: int = 1,
                condition_mode: Optional[str] = None,
                condition_size: int = 0,
                num_subtasks: int = 0,
@@ -339,6 +340,7 @@ class BCZModel(abstract_model.T2RModel):
     self._components = normalize_components(components)
     self._network = network
     self._resnet_size = resnet_size
+    self._resnet_version = resnet_version
     self._condition_mode = condition_mode
     self._condition_size = condition_size
     self._num_subtasks = num_subtasks
@@ -410,8 +412,10 @@ class BCZModel(abstract_model.T2RModel):
 
   def create_module(self):
     return _BCZNetwork(
+        dtype=self.compute_dtype if self.use_bfloat16 else None,
         components=self._components, num_waypoints=self._num_waypoints,
         network=self._network, resnet_size=self._resnet_size,
+        resnet_version=self._resnet_version,
         condition_mode=self._condition_mode,
         condition_size=self._condition_size,
         num_subtasks=self._num_subtasks,

@@ -14,7 +14,7 @@ dot-producted against it for localization heatmaps — all batched matmuls.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -24,6 +24,7 @@ import numpy as np
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.research.grasp2vec import losses as g2v_losses
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
@@ -37,7 +38,8 @@ TOWERS = ("conv", "resnet")
 
 def _tower_spatial_features(image: jnp.ndarray, tower: str,
                             filters: Tuple[int, ...], resnet_size: int,
-                            train: bool) -> jnp.ndarray:
+                            train: bool,
+                            dtype: Optional[Any] = None) -> jnp.ndarray:
   """Shared tower dispatch -> [B, H', W', C] spatial features.
 
   'conv' is a small stride-2 stack; 'resnet' is the shared FiLM-ResNet
@@ -48,14 +50,15 @@ def _tower_spatial_features(image: jnp.ndarray, tower: str,
     from tensor2robot_tpu.layers import film_resnet
 
     _, endpoints = film_resnet.ResNet(
-        resnet_size=resnet_size, name="resnet")(image, train=train)
+        resnet_size=resnet_size, dtype=dtype, name="resnet")(
+            image, train=train)
     return endpoints["block_layer4"]
   if tower != "conv":
     raise ValueError(f"tower must be one of {TOWERS}, got {tower!r}")
   x = image
   for i, f in enumerate(filters):
     x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"conv_{i}")(x)
-    x = nn.LayerNorm(name=f"norm_{i}")(x)
+    x = nn.LayerNorm(dtype=dtype, name=f"norm_{i}")(x)
     x = nn.relu(x)
   return x
 
@@ -68,11 +71,12 @@ class SceneEmbedding(nn.Module):
   filters: Tuple[int, ...] = (32, 64, 64)
   tower: str = "conv"  # 'conv' | 'resnet'
   resnet_size: int = 18
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
     x = _tower_spatial_features(image, self.tower, self.filters,
-                                self.resnet_size, train)
+                                self.resnet_size, train, self.dtype)
     spatial = nn.Conv(self.embedding_size, (1, 1), name="proj")(x)
     pooled = spatial.mean(axis=(1, 2))
     return pooled, spatial
@@ -83,11 +87,12 @@ class GoalEmbedding(nn.Module):
   filters: Tuple[int, ...] = (32, 64, 64)
   tower: str = "conv"  # 'conv' | 'resnet'
   resnet_size: int = 18
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
     x = _tower_spatial_features(image, self.tower, self.filters,
-                                self.resnet_size, train)
+                                self.resnet_size, train, self.dtype)
     x = x.mean(axis=(1, 2))
     return nn.Dense(self.embedding_size, name="proj")(x)
 
@@ -103,19 +108,19 @@ class _Grasp2VecNetwork(nn.Module):
   embedding_size: int = 64
   tower: str = "conv"
   resnet_size: int = 18
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    def _norm(img):
-      if jnp.issubdtype(img.dtype, jnp.integer):
-        return img.astype(jnp.float32) / 255.0
-      return img
+    _norm = lambda img: normalize_image(img, self.dtype)
 
     scene = SceneEmbedding(self.embedding_size, tower=self.tower,
-                           resnet_size=self.resnet_size, name="scene")
+                           resnet_size=self.resnet_size, dtype=self.dtype,
+                           name="scene")
     goal = GoalEmbedding(self.embedding_size, tower=self.tower,
-                         resnet_size=self.resnet_size, name="goal")
+                         resnet_size=self.resnet_size, dtype=self.dtype,
+                         name="goal")
     pregrasp, pregrasp_spatial = scene(_norm(features["pregrasp_image"]),
                                        train=train)
     postgrasp, postgrasp_spatial = scene(_norm(features["postgrasp_image"]),
@@ -189,9 +194,10 @@ class Grasp2VecModel(abstract_model.T2RModel):
     })
 
   def create_module(self):
-    return _Grasp2VecNetwork(embedding_size=self._embedding_size,
-                             tower=self._tower,
-                             resnet_size=self._resnet_size)
+    return _Grasp2VecNetwork(
+        embedding_size=self._embedding_size, tower=self._tower,
+        resnet_size=self._resnet_size,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def _grasp_success(self, labels):
     if labels is not None and "grasp_success" in labels \

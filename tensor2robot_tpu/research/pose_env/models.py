@@ -9,7 +9,7 @@ env's 32x32 grayscale observations (tensor2robot_tpu.envs.pose_env).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -19,6 +19,7 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.layers import vision
 from tensor2robot_tpu.models import heads
+from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
@@ -37,14 +38,15 @@ def _obs_image(state):
 
 class _PoseRegressionNet(nn.Module):
   filters: Tuple[int, ...] = (32, 16)
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    image = features["state/image"].astype(jnp.float32) / 255.0
+    image = normalize_image(features["state/image"], self.dtype)
     points = vision.BerkeleyNet(
         filters=self.filters, kernel_sizes=(5, 3), strides=(2, 1),
-        name="torso")(image, train=train)
+        dtype=self.dtype, name="torso")(image, train=train)
     action = vision.PoseHead(output_size=2, hidden_sizes=(64,),
                              name="head")(points, train=train)
     return specs_lib.SpecStruct({"inference_output": action})
@@ -84,7 +86,8 @@ class PoseEnvRegressionModel(heads.RegressionModel):
     })
 
   def create_module(self):
-    return _PoseRegressionNet()
+    return _PoseRegressionNet(
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
     predicted = inference_outputs[self._output_key]
@@ -115,14 +118,15 @@ class PoseEnvRegressionModel(heads.RegressionModel):
 
 class _PoseCriticNet(nn.Module):
   filters: Tuple[int, ...] = (32, 16)
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    image = features["state/image"].astype(jnp.float32) / 255.0
+    image = normalize_image(features["state/image"], self.dtype)
     points = vision.BerkeleyNet(
         filters=self.filters, kernel_sizes=(5, 3), strides=(2, 1),
-        name="torso")(image, train=train)
+        dtype=self.dtype, name="torso")(image, train=train)
     action = features["action/action"].astype(points.dtype)
     x = jnp.concatenate([points, action], axis=-1)
     for i, size in enumerate((64, 64)):
@@ -154,7 +158,8 @@ class PoseEnvContinuousMCModel(heads.CriticModel):
     })
 
   def create_module(self):
-    return _PoseCriticNet()
+    return _PoseCriticNet(
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def pack_features(self, state, context=None, timestep=0,
                     actions=None):

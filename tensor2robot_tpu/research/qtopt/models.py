@@ -19,7 +19,7 @@ all-reduce are both subsumed by the data-parallel mesh.
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -31,6 +31,7 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.models import heads
 from tensor2robot_tpu.models import optimizers as optimizers_lib
+from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
@@ -44,19 +45,17 @@ class GraspingCNN(nn.Module):
   post_merge_filters: Sequence[int] = (32, 32)
   action_embedding_size: int = 32
   head_hidden_sizes: Sequence[int] = (64, 64)
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    image = features["state/image"]
-    if jnp.issubdtype(image.dtype, jnp.integer):
-      image = image.astype(jnp.float32) / 255.0
-    x = image
+    x = normalize_image(features["state/image"], self.dtype)
     # Stem: stride-2 convs — large spatial dims shrink fast, keeping the
     # deep tower cheap (the reference pools aggressively too).
     for i, f in enumerate(self.stem_filters):
       x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"stem_{i}")(x)
-      x = nn.LayerNorm(name=f"stem_norm_{i}")(x)
+      x = nn.LayerNorm(dtype=self.dtype, name=f"stem_norm_{i}")(x)
       x = nn.relu(x)
 
     # Action (and any extra state vectors) -> embedding, broadcast-added
@@ -73,7 +72,7 @@ class GraspingCNN(nn.Module):
 
     for i, f in enumerate(self.post_merge_filters):
       x = nn.Conv(f, (3, 3), strides=(2, 2), name=f"merge_{i}")(x)
-      x = nn.LayerNorm(name=f"merge_norm_{i}")(x)
+      x = nn.LayerNorm(dtype=self.dtype, name=f"merge_norm_{i}")(x)
       x = nn.relu(x)
 
     x = x.reshape(x.shape[0], -1)
@@ -117,19 +116,23 @@ class Grasping44(nn.Module):
   # name -> (offset, size) sub-blocks of the grasp-param vector, each
   # embedded by its own Dense (reference grasp_param_names).
   grasp_param_names: Optional[Dict[str, Tuple[int, int]]] = None
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   def _bn(self, name):
+    # Explicit dtype: flax BatchNorm computes stats in f32 internally and,
+    # with dtype=None, PROMOTES its output to f32 (the f32 running stats /
+    # stat computation win the promotion) — one BN would re-poison the
+    # bf16 tower after every conv.
     return nn.BatchNorm(momentum=self.batch_norm_decay,
-                        epsilon=self.batch_norm_epsilon, name=name)
+                        epsilon=self.batch_norm_epsilon, dtype=self.dtype,
+                        name=name)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False,
                goal_spatial: Optional[jnp.ndarray] = None,
                goal_vector: Optional[jnp.ndarray] = None):
-    image = features["state/image"]
-    if jnp.issubdtype(image.dtype, jnp.integer):
-      image = image.astype(jnp.float32) / 255.0
+    image = normalize_image(features["state/image"], self.dtype)
     use_ra = not train
 
     # Stem (reference conv1_1 + pool1).
@@ -309,10 +312,12 @@ class QTOptModel(heads.CriticModel):
     })
 
   def create_module(self):
+    dtype = self.compute_dtype if self.use_bfloat16 else None
     if self._network == "grasping44":
       return Grasping44(num_convs=self._num_convs,
-                        grasp_param_names=self._grasp_param_names)
-    return GraspingCNN()
+                        grasp_param_names=self._grasp_param_names,
+                        dtype=dtype)
+    return GraspingCNN(dtype=dtype)
 
   def create_optimizer(self):
     if self._optimizer_fn is not None:

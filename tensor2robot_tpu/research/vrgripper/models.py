@@ -16,7 +16,7 @@ over time with `multi_batch_apply` (a reshape — free under XLA).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -30,6 +30,7 @@ from tensor2robot_tpu.layers import tec as tec_lib
 from tensor2robot_tpu.layers import vision
 from tensor2robot_tpu.meta_learning import batch_utils
 from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.preprocessors import base as preprocessors_lib
 from tensor2robot_tpu.preprocessors import image_ops
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
@@ -84,19 +85,18 @@ class _EpisodeRegressionNet(nn.Module):
   action_size: int = 7
   num_mixture_components: int = 0  # 0 -> plain MSE head
   num_feature_points: int = 32
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    image = features["image"]  # [B, T, H, W, C]
-    if jnp.issubdtype(image.dtype, jnp.integer):
-      image = image.astype(jnp.float32) / 255.0
+    image = normalize_image(features["image"], self.dtype)  # [B,T,H,W,C]
 
     def per_frame(flat_image):
       points = vision.BerkeleyNet(
           filters=(self.num_feature_points,),
-          kernel_sizes=(5,), strides=(2,), name="torso")(
-              flat_image, train=train)
+          kernel_sizes=(5,), strides=(2,), dtype=self.dtype,
+          name="torso")(flat_image, train=train)
       return points
 
     points = batch_utils.multi_batch_apply(per_frame, 2, image)
@@ -159,7 +159,8 @@ class VRGripperRegressionModel(abstract_model.T2RModel):
   def create_module(self):
     return _EpisodeRegressionNet(
         action_size=self._action_size,
-        num_mixture_components=self._num_mixture_components)
+        num_mixture_components=self._num_mixture_components,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
     target = labels["action"]
@@ -289,20 +290,19 @@ class _DANetwork(nn.Module):
   num_feature_points: int = 32
   predict_con_gripper_pose: bool = False
   learned_loss_conv1d_layers: Optional[Tuple[int, ...]] = (10, 10, 6)
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False, inner: bool = False):
-    image = features["image"]  # [B, T, H, W, C]
-    if jnp.issubdtype(image.dtype, jnp.integer):
-      image = image.astype(jnp.float32) / 255.0
+    image = normalize_image(features["image"], self.dtype)  # [B,T,H,W,C]
     pose = features["gripper_pose"]
 
     def per_frame(flat_image):
       return vision.BerkeleyNet(
           filters=(self.num_feature_points,),
-          kernel_sizes=(5,), strides=(2,), name="torso")(
-              flat_image, train=train)
+          kernel_sizes=(5,), strides=(2,), dtype=self.dtype,
+          name="torso")(flat_image, train=train)
 
     feature_points = batch_utils.multi_batch_apply(per_frame, 2, image)
 
@@ -392,7 +392,8 @@ class VRGripperDomainAdaptiveModel(VRGripperRegressionModel):
     return _DANetwork(
         action_size=self._action_size,
         predict_con_gripper_pose=self._predict_con_gripper_pose,
-        learned_loss_conv1d_layers=self._learned_loss_conv1d_layers)
+        learned_loss_conv1d_layers=self._learned_loss_conv1d_layers,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   # -- MAML integration hooks (see meta_learning/maml.py) -------------------
 
@@ -520,13 +521,14 @@ class _WTLVisionTrialNetwork(nn.Module):
   num_mixture_components: int = 1
   num_condition_episodes: int = 1
   ignore_embedding: bool = False
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
     torso = vision.BerkeleyNet(
         filters=(self.num_feature_points,), kernel_sizes=(5,),
-        strides=(2,), name="image_embedding")
+        strides=(2,), dtype=self.dtype, name="image_embedding")
 
     def _frames_to_features(images):
       """[..., T, H, W, C] -> [..., T, F] shared per-frame conv torso."""
@@ -538,10 +540,8 @@ class _WTLVisionTrialNetwork(nn.Module):
     con_success = 2.0 * features["condition/labels/success"] - 1.0
     inf_images = features["inference/features/image"]  # [B,I,T,H,W,C]
     inf_pose = features["inference/features/gripper_pose"]
-    if jnp.issubdtype(con_images.dtype, jnp.integer):
-      con_images = con_images.astype(jnp.float32) / 255.0
-    if jnp.issubdtype(inf_images.dtype, jnp.integer):
-      inf_images = inf_images.astype(jnp.float32) / 255.0
+    con_images = normalize_image(con_images, self.dtype)
+    inf_images = normalize_image(inf_images, self.dtype)
     b, num_inference, t = inf_images.shape[:3]
 
     demo_fp = _frames_to_features(con_images[:, 0])  # [B,T,F]
@@ -749,7 +749,8 @@ class WTLVisionTrialModel(_WTLModelBase):
         fc_embed_size=self._fc_embed_size,
         num_mixture_components=self._num_mixture_components,
         num_condition_episodes=self._num_condition_episodes,
-        ignore_embedding=self._ignore_embedding)
+        ignore_embedding=self._ignore_embedding,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def pack_features(self, state, prev_episode_data, timestep):
     return pack_wtl_meta_features(

@@ -173,7 +173,14 @@ class TensorSpec:
 
   def is_compatible_with(self, array: Any, ignore_batch: bool = False) -> bool:
     shape = tuple(np.shape(array))
-    dtype = _canonical_dtype(getattr(array, "dtype", np.asarray(array).dtype))
+    # NOTE: not getattr(array, "dtype", np.asarray(array).dtype) — Python
+    # evaluates the getattr default EAGERLY, which forced a host conversion
+    # of every validated array (device transfer on the hot path) and broke
+    # validation under jit tracers.
+    if hasattr(array, "dtype"):
+      dtype = _canonical_dtype(array.dtype)
+    else:
+      dtype = _canonical_dtype(np.asarray(array).dtype)
     spec_shape = self.shape
     if ignore_batch:
       if not shape:

@@ -113,6 +113,53 @@ class TestFilmResnet:
     variables = module.init(jax.random.PRNGKey(0), x)
     assert "batch_stats" in variables
 
+  @pytest.mark.parametrize("size,expect_bottleneck", [(18, False),
+                                                      (50, True)])
+  def test_resnet_v2_shapes(self, size, expect_bottleneck):
+    module = film_resnet.ResNet(resnet_size=size, num_classes=5, version=2)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    (logits, endpoints), variables = _init_apply(module, x)
+    assert logits.shape == (2, 5)
+    final = endpoints["final_reduce_mean"]
+    assert final.shape == (2, 2048 if expect_bottleneck else 512)
+    # v2 signature params: no stem BN, but a final pre-pool BN.
+    assert "bn_stem" not in variables["params"]
+    assert "bn_final" in variables["params"]
+
+  def test_resnet_v2_differs_from_v1(self):
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 32, 32, 3))
+    outs = {}
+    for version in (1, 2):
+      module = film_resnet.ResNet(resnet_size=18, version=version)
+      variables = module.init(jax.random.PRNGKey(0), x)
+      outs[version], _ = module.apply(variables, x)
+    assert not np.allclose(np.asarray(outs[1]), np.asarray(outs[2]))
+
+  def test_resnet_v2_film_and_gradients(self):
+    module = film_resnet.ResNet(resnet_size=18, version=2)
+    x = jax.random.uniform(jax.random.PRNGKey(0), (1, 32, 32, 3))
+    variables = module.init(jax.random.PRNGKey(0), x, jnp.zeros((1, 4)))
+    out1, _ = module.apply(variables, x, jnp.zeros((1, 4)))
+    out2, _ = module.apply(variables, x, jnp.ones((1, 4)))
+    assert not np.allclose(np.asarray(out1), np.asarray(out2))
+
+    def loss(params):
+      out, _ = module.apply({**variables, "params": params}, x,
+                            jnp.ones((1, 4)))
+      return (out ** 2).mean()
+
+    grads = jax.grad(loss)(variables["params"])
+    leaves = jax.tree_util.tree_leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in leaves)
+    # The pre-activation path must keep the stem conv trainable.
+    assert float(np.abs(np.asarray(
+        grads["conv_stem"]["kernel"])).max()) > 0
+
+  def test_resnet_bad_version_raises(self):
+    module = film_resnet.ResNet(resnet_size=18, version=3)
+    with pytest.raises(ValueError, match="version"):
+      module.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+
 
 class TestMDN:
 

@@ -1,0 +1,155 @@
+"""bfloat16 policy: no silent f32 promotion in model towers.
+
+Regression guard for the round-2 finding that one f32 activation (the
+uint8 image normalized to float32 inside a module) silently promoted
+every convolution of the Grasping44 train step to f32 (47/47 f32 convs,
+~2x the HBM bytes of the intended bf16 program). The reference keeps its
+whole tower under a bfloat16 scope on TPU
+(/root/reference/models/tpu_model_wrapper.py:185-191); this asserts our
+equivalent — module compute dtype + policy casts — holds end to end by
+lowering the real train step and counting conv/dot result dtypes.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+import jax
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import modes, specs as specs_lib
+from tensor2robot_tpu.parallel import train_step as ts
+
+
+# f32 dots at or below this output size are loss-side math (npairs /
+# triplet logits, MDN likelihoods), which intentionally runs in f32 — a
+# tower-sized activation is orders of magnitude larger.
+_SMALL_F32_DOT_ELEMENTS = 4096
+
+
+def _conv_dot_dtypes(model, batch_size=2):
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  step = ts.make_train_step(model, donate=False)
+  hlo = step.lower(state, features, labels).as_text()
+  counts = Counter()
+  big_f32 = []
+  for ln in hlo.splitlines():
+    is_conv = "stablehlo.convolution" in ln
+    if not (is_conv or "stablehlo.dot_general" in ln):
+      continue
+    m = re.search(r"-> tensor<((?:[0-9]+x)*)(\w+)>", ln)
+    if not m:
+      continue
+    dims, dtype = m.group(1), m.group(2)
+    counts[dtype] += 1
+    if dtype != "bf16":
+      size = int(np.prod([int(d) for d in dims.split("x") if d] or [1]))
+      if is_conv or size > _SMALL_F32_DOT_ELEMENTS:
+        big_f32.append(ln.strip()[:140])
+  return counts, big_f32
+
+
+def _assert_all_bf16(counts_and_leaks):
+  counts, leaks = counts_and_leaks
+  assert counts, "expected at least one conv/dot in the lowered step"
+  assert "bf16" in counts, f"no bf16 compute at all: {dict(counts)}"
+  assert not leaks, (
+      "f32 leak into the bf16-policy tower "
+      f"(counts {dict(counts)}):\n" + "\n".join(leaks))
+
+
+def test_qtopt_grasping44_bf16_end_to_end():
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  model = qtopt_models.QTOptModel(
+      image_size=252, device_type="tpu", network="grasping44",
+      action_size=5,
+      grasp_param_names={"world_vector": (0, 3),
+                         "vertical_rotation": (3, 2)},
+      use_bfloat16=True, use_ema=True)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_qtopt_small_bf16_end_to_end():
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  model = qtopt_models.QTOptModel(
+      image_size=32, device_type="tpu", network="small",
+      use_bfloat16=True)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_bcz_resnet_film_bf16_end_to_end():
+  from tensor2robot_tpu.research.bcz import models as bcz_models
+
+  model = bcz_models.BCZModel(
+      image_size=48, device_type="tpu", use_bfloat16=True,
+      condition_mode="language", condition_size=8)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_vrgripper_regression_bf16_end_to_end():
+  from tensor2robot_tpu.research.vrgripper import models as vr_models
+
+  model = vr_models.VRGripperRegressionModel(
+      episode_length=3, image_size=32, device_type="tpu",
+      use_bfloat16=True)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_grasp2vec_bf16_end_to_end():
+  from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+
+  model = g2v_models.Grasp2VecModel(image_size=32, device_type="tpu",
+                                    use_bfloat16=True)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_pose_env_critic_bf16_end_to_end():
+  from tensor2robot_tpu.research.pose_env import models as pose_models
+
+  model = pose_models.PoseEnvContinuousMCModel(device_type="tpu",
+                                               use_bfloat16=True)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_f32_policy_unchanged():
+  """Without the bf16 policy everything still computes in f32."""
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  model = qtopt_models.QTOptModel(image_size=32, network="small")
+  counts, _ = _conv_dot_dtypes(model)
+  assert set(counts) == {"f32"}, dict(counts)
+
+
+def test_bf16_loss_close_to_f32():
+  """The bf16 tower trains to numerics close to the f32 tower (same
+  init): guards against the dtype plumbing changing semantics."""
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  losses = {}
+  for use_bf16 in (False, True):
+    model = qtopt_models.QTOptModel(
+        image_size=32, device_type="tpu", network="small",
+        use_bfloat16=use_bf16)
+    features = specs_lib.make_random_numpy(
+        model.preprocessor.get_out_feature_specification(modes.TRAIN),
+        batch_size=8, seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.preprocessor.get_out_label_specification(modes.TRAIN),
+        batch_size=8, seed=1)
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                     features)
+    step = ts.make_train_step(model, donate=False)
+    for _ in range(3):
+      state, metrics = step(state, features, labels)
+    losses[use_bf16] = float(np.asarray(metrics["loss"]))
+  assert losses[True] == pytest.approx(losses[False], rel=0.1), losses

@@ -101,6 +101,56 @@ class TestSavedModelPreprocessorGuard:
     path = gen.export(state, str(tmp_path / "exports"))
     assert os.path.isdir(os.path.join(path, "saved_model"))
 
+  def _jnp_preprocessor(self):
+    from tensor2robot_tpu.preprocessors import base as pre_lib
+
+    class JnpShiftPreprocessor(pre_lib.SpecTransformationPreprocessor):
+      """Same affine transform as ShiftPreprocessor, but jnp-pure — the
+      jax2tf export embeds it instead of refusing."""
+
+      def _preprocess_fn(self, features, labels, mode):
+        features = dict(features.items())
+        features["x"] = jnp.asarray(features["x"]) * 2.0 - 1.0
+        return features, labels
+
+    return JnpShiftPreprocessor
+
+  def test_jnp_preprocessor_embeds_into_saved_model(self, tmp_path):
+    import json
+
+    model, state = self._state_and_model(self._jnp_preprocessor())
+    gen = export_lib.DefaultExportGenerator(write_saved_model=True)
+    gen.set_specification_from_model(model)  # must NOT raise
+    path = gen.export(state, str(tmp_path / "exports"))
+    assert os.path.isdir(os.path.join(path, "saved_model"))
+    with open(os.path.join(path, export_lib.SIGNATURE_FILENAME)) as f:
+      assert json.load(f)["preprocessor_embedded"] is True
+
+    # The SavedModel serves WIRE-layout features: its outputs must match
+    # the pure-JAX path that applies the preprocessor host-side (this is
+    # exactly what silently diverged in the ADVICE r1 finding).
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.predictors import saved_model_predictor
+
+    wire = {"x": np.linspace(-1.0, 1.0, 6, dtype=np.float32
+                             ).reshape(2, 3)}
+    predictor = saved_model_predictor.SavedModelPredictor(
+        export_dir=str(tmp_path / "exports"))
+    assert predictor.restore()
+    served = predictor.predict(wire)
+
+    predict = ts.make_predict_fn(model)
+    preprocessed, _ = model.preprocessor.preprocess(
+        dict(wire), {}, "predict")
+    expected = predict(state, preprocessed)
+    np.testing.assert_allclose(served["prediction"],
+                               np.asarray(expected["prediction"]),
+                               rtol=1e-5)
+    # And feeding already-preprocessed features must NOT match (the
+    # transform is really inside the graph, not a no-op).
+    double = predictor.predict({"x": np.asarray(preprocessed["x"])})
+    assert not np.allclose(double["prediction"], served["prediction"])
+
 
 class TestCheckpointPredictor:
 
