@@ -85,7 +85,14 @@ def _preprocess_is_traceable(model) -> bool:
 
     jax.eval_shape(run, placeholders)
     return True
-  except Exception:  # noqa: BLE001 - any failure means "not embeddable"
+  except Exception as e:  # noqa: BLE001 - any failure means "not embeddable"
+    # Logged so genuine bugs (spec typos) in jnp-pure preprocessors are
+    # not silently misreported as "host-side, not embeddable".
+    from absl import logging
+
+    logging.info("Preprocessor %s not embeddable (trace probe failed: "
+                 "%s: %s)", type(_unwrap_preprocessor(preprocessor)
+                                 ).__name__, type(e).__name__, e)
     return False
 
 

@@ -34,7 +34,8 @@ def pipelined_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
                     stage_params: Any,
                     microbatches: jnp.ndarray,
                     mesh: Mesh,
-                    axis_name: str = "pp") -> jnp.ndarray:
+                    axis_name: str = "pp",
+                    batch_axis: str = None) -> jnp.ndarray:
   """Runs microbatches through a pipeline of stages.
 
   Args:
@@ -43,18 +44,26 @@ def pipelined_apply(stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stage_params: pytree with leading [num_stages] dim on every leaf;
       sharded over `axis_name`.
     microbatches: [num_microbatches, mb, ...] global input (replicated
-      over the pp axis).
+      over the pp axis; when `batch_axis` is given, the mb dim stays
+      sharded over it so PP composes with data parallelism instead of
+      all-gathering the batch).
     mesh: mesh containing `axis_name` with size == num_stages.
+    batch_axis: optional mesh axis the microbatch (second) dim is sharded
+      over.
 
   Returns:
-    [num_microbatches, mb, ...] outputs (replicated over the pp axis).
+    [num_microbatches, mb, ...] outputs (replicated over the pp axis,
+    mb dim sharded over `batch_axis` when given).
   """
   num_stages = mesh.shape[axis_name]
   num_micro = microbatches.shape[0]
   total_ticks = num_micro + num_stages - 1
 
   params_spec = PartitionSpec(axis_name)
-  replicated = PartitionSpec()
+  if batch_axis is not None and mesh.shape.get(batch_axis, 1) > 1:
+    replicated = PartitionSpec(None, batch_axis)
+  else:
+    replicated = PartitionSpec()
 
   def local_fn(local_params, micro):
     # local_params leaves: [1, ...] (this device's stage); squeeze.

@@ -119,6 +119,7 @@ def train_eval_model(
     export_num_versions: int = 3,
     mesh=None,
     mesh_shape: Optional[Sequence[int]] = None,
+    mesh_axis_names: Optional[Sequence[str]] = None,
     partition_rules=None,
     seed: int = 0,
     continuous_eval_timeout_secs: Optional[float] = None,
@@ -131,7 +132,13 @@ def train_eval_model(
     raise ValueError(f"Unknown train_eval mode {mode!r}")
   os.makedirs(model_dir, exist_ok=True)
   if mesh is None:
-    mesh = mesh_lib.create_mesh(mesh_shape=mesh_shape)
+    kwargs = {"axis_names": tuple(mesh_axis_names)} if mesh_axis_names \
+        else {}
+    mesh = mesh_lib.create_mesh(mesh_shape=mesh_shape, **kwargs)
+  if hasattr(model, "set_mesh"):
+    # Models whose module runs explicit collectives (e.g. the pipelined
+    # trunk's shard_map schedule) need the mesh before create_module.
+    model.set_mesh(mesh)
   print_specification(model)
 
   writer = summaries_lib.SummaryWriter(os.path.join(model_dir,

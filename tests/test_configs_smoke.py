@@ -105,6 +105,22 @@ def test_moe_ep_config_trains_on_mesh(tmp_path):
   assert_output_files(model_dir, expect_operative_config=False)
 
 
+def test_pipelined_pp_config_trains_on_mesh(tmp_path):
+  """PP through the full training path: train_pipelined_pp.gin trains the
+  GPipe-trunk model through train_eval_model on a ('data', 'pp', 'model')
+  = (2, 4, 1) mesh with stage params sharded over 'pp'."""
+  config_path = os.path.join(REPO_ROOT, "tensor2robot_tpu", "configs",
+                             "train_pipelined_pp.gin")
+  model_dir = str(tmp_path / "pp")
+  bindings = [b for b in _SHRINK
+              if "mesh_shape" not in b and "batch_size" not in b]
+  bindings.append(f"train_eval_model.model_dir = {model_dir!r}")
+  config.parse_config_files_and_bindings([config_path], bindings)
+  metrics = train_eval.train_eval_model()
+  assert metrics
+  assert_output_files(model_dir, expect_operative_config=False)
+
+
 def test_actor_configs_drive_collect_loop(tmp_path):
   """Non-trainer (actor-side) configs run the collect/eval loop and
   write replay records."""

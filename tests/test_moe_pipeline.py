@@ -235,6 +235,21 @@ class TestPipelineParallel:
     assert np.isfinite(np.asarray(grads["w"])).all()
     assert float(jnp.abs(grads["w"]).max()) > 0
 
+  def test_composes_with_data_parallel_batch_sharding(self, pp_mesh):
+    """batch_axis keeps the microbatch dim sharded over 'data' instead of
+    all-gathering it (PP x DP composition)."""
+    dim, num_micro, mb = 6, 4, 4
+    stages = _stages(4, dim)
+    stacked = pp.stack_stage_params(stages)
+    micro = jax.random.normal(jax.random.PRNGKey(2), (num_micro, mb, dim))
+    out = pp.pipelined_apply(_stage_fn, stacked, micro, pp_mesh,
+                             axis_name="pp", batch_axis="data")
+    expected = micro
+    for params in stages:
+      expected = jax.vmap(lambda x, p=params: _stage_fn(p, x))(expected)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                               atol=1e-5)
+
   def test_pipelined_training_step(self, pp_mesh):
     """PP as a *training capability*: the pipelined train step fits a
     target and matches the gradients of the sequential equivalent."""
@@ -277,3 +292,108 @@ class TestPipelineParallel:
     assert float(loss) < first * 0.5, (first, float(loss))
     # params stayed sharded over the pp axis
     assert params["w"].sharding.spec == PartitionSpec("pp")
+
+
+class TestPipelinedModelTrainStep:
+  """PP as a T2RModel training capability (models/pipelined_model.py):
+  the GPipe trunk runs through the generic step factory and
+  train_eval_model, stage params sharded over 'pp'."""
+
+  def _model(self, **kwargs):
+    import optax
+
+    from tensor2robot_tpu.models import pipelined_model
+
+    kwargs.setdefault("obs_size", 8)
+    kwargs.setdefault("action_size", 3)
+    kwargs.setdefault("hidden_size", 16)
+    kwargs.setdefault("num_stages", 4)
+    kwargs.setdefault("num_microbatches", 4)
+    kwargs.setdefault("device_type", "cpu")
+    kwargs.setdefault("optimizer_fn", lambda: optax.adam(3e-3))
+    return pipelined_model.PipelinedRegressionModel(**kwargs)
+
+  def _batch(self, model, batch_size=16):
+    from tensor2robot_tpu import specs as specs_lib
+
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification("train"), batch_size=batch_size,
+        seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification("train"), batch_size=batch_size,
+        seed=1)
+    return features, labels
+
+  def test_pipelined_step_matches_sequential_step(self):
+    """Same init, one train step: the pipelined schedule on a pp mesh
+    produces the same loss and updated params as the sequential trunk
+    (GPipe is a schedule, not a different function)."""
+    from tensor2robot_tpu.models import pipelined_model
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    results = {}
+    for name, use_mesh in (("seq", False), ("pp", True)):
+      model = self._model()
+      features, labels = self._batch(model)
+      if use_mesh:
+        model.set_mesh(mesh)
+        state, shardings = ts.create_train_state(
+            model, jax.random.PRNGKey(0), features, mesh=mesh,
+            rules=pipelined_model.pipeline_parallel_rules())
+        step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
+                                  donate=False)
+        f = mesh_lib.put_host_batch(mesh, features)
+        l = mesh_lib.put_host_batch(mesh, labels)
+      else:
+        state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                         features)
+        step = ts.make_train_step(model, donate=False)
+        f, l = features, labels
+      new_state, metrics = step(state, f, l)
+      results[name] = (float(metrics["loss"]),
+                       jax.device_get(new_state.params))
+    assert results["pp"][0] == pytest.approx(results["seq"][0], rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(results["pp"][1]),
+                    jax.tree_util.tree_leaves(results["seq"][1])):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+  def test_stage_params_sharded_and_loss_decreases(self):
+    from tensor2robot_tpu.models import pipelined_model
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    model = self._model()
+    model.set_mesh(mesh)
+    features, labels = self._batch(model, batch_size=32)
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(0), features, mesh=mesh,
+        rules=pipelined_model.pipeline_parallel_rules())
+    w1 = state.params["stages_w1"]
+    assert w1.sharding.spec == PartitionSpec("pp", None, None), w1.sharding
+    step = ts.make_train_step(model, mesh=mesh, shardings=shardings)
+    f = mesh_lib.put_host_batch(mesh, features)
+    l = mesh_lib.put_host_batch(mesh, labels)
+    first = None
+    for _ in range(40):
+      state, metrics = step(state, f, l)
+      first = first if first is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
+
+  def test_set_mesh_rejects_stage_mismatch(self):
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    model = self._model(num_stages=3)
+    with pytest.raises(ValueError, match="must match"):
+      model.set_mesh(mesh)
+
+  def test_indivisible_microbatch_raises(self):
+    model = self._model(num_microbatches=5)
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    model.set_mesh(mesh)
+    features, _ = self._batch(model, batch_size=16)  # 16 % 5 != 0
+    with pytest.raises(ValueError, match="microbatches"):
+      ts.create_train_state(model, jax.random.PRNGKey(0), features,
+                            mesh=mesh)
