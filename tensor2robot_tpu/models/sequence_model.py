@@ -1,0 +1,155 @@
+"""A T2RModel with a causal-attention trunk — the training-path carrier
+for sequence/context parallelism.
+
+Beyond the reference (SURVEY.md §2.5 and §5: the reference handles long
+sequences only at the data level — SequenceExample padding/subsampling —
+never with sequence-parallel compute). This model makes SP a *training
+capability*: a stack of pre-LN causal attention + MLP blocks whose
+attention runs `MultiHeadAttention(backend='ring')`, the exact online-
+softmax ring over the mesh's `sp` axis (ops/attention.ring_attention:
+each device keeps its Q shard resident, K/V blocks rotate over the ICI
+ring via ppermute). Trained through `train_eval_model` like any model —
+see `configs/train_sp_ring.gin`. The `batch_partition_spec` property
+commits sequence batches sharded ('data', 'sp') at infeed so activations
+are born sequence-sharded.
+
+Backends 'reference' (plain XLA attention) and 'flash' (the Pallas
+kernel) use the same module single-chip — the SAME function, so tests
+pin ring == reference numerics through the full train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensor2robot_tpu import modes as modes_lib
+from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.layers import attention_layers
+from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+from tensor2robot_tpu.utils import config
+
+__all__ = ["SequenceRegressionModel"]
+
+
+class _AttentionTrunk(nn.Module):
+  """embed -> N x (pre-LN causal MHA + pre-LN MLP, residual) -> head."""
+
+  action_size: int = 7
+  hidden_size: int = 64
+  num_blocks: int = 2
+  num_heads: int = 4
+  backend: str = "reference"  # 'reference' | 'flash' | 'ring'
+  mesh: Optional[Any] = None
+  sp_axis: str = "sp"
+  dtype: Optional[Any] = None
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    x = features["observation"]  # [B, T, obs]
+    if self.dtype is not None and x.dtype != self.dtype:
+      x = x.astype(self.dtype)
+    x = nn.Dense(self.hidden_size, name="embed")(x)
+    head_dim = self.hidden_size // self.num_heads
+    for i in range(self.num_blocks):
+      y = nn.LayerNorm(dtype=self.dtype, name=f"ln_attn_{i}")(x)
+      y = attention_layers.MultiHeadAttention(
+          num_heads=self.num_heads, head_dim=head_dim, causal=True,
+          backend=self.backend, mesh=self.mesh, sp_axis=self.sp_axis,
+          name=f"attn_{i}")(y, train=train)
+      x = x + y
+      y = nn.LayerNorm(dtype=self.dtype, name=f"ln_mlp_{i}")(x)
+      y = nn.Dense(2 * self.hidden_size, name=f"mlp_in_{i}")(y)
+      y = nn.Dense(self.hidden_size, name=f"mlp_out_{i}")(nn.gelu(y))
+      x = x + y
+    action = nn.Dense(self.action_size, name="head")(x)  # [B, T, act]
+    return specs_lib.SpecStruct({
+        "action": action,
+        "inference_output": action,
+    })
+
+
+@config.configurable
+class SequenceRegressionModel(abstract_model.T2RModel):
+  """[B, T, obs] -> [B, T, action] causal regression; attention backend
+  selects single-chip XLA/flash or the sequence-parallel ring."""
+
+  def __init__(self, obs_size: int = 16, action_size: int = 7,
+               sequence_length: int = 32, hidden_size: int = 64,
+               num_blocks: int = 2, num_heads: int = 4,
+               attention_backend: str = "reference",
+               sp_axis: str = "sp", **kwargs):
+    super().__init__(**kwargs)
+    if attention_backend not in ("reference", "flash", "ring"):
+      raise ValueError(f"Unknown attention_backend {attention_backend!r}")
+    self._obs_size = obs_size
+    self._action_size = action_size
+    self._sequence_length = sequence_length
+    self._hidden_size = hidden_size
+    self._num_blocks = num_blocks
+    self._num_heads = num_heads
+    self._attention_backend = attention_backend
+    self._sp_axis = sp_axis
+    self._mesh = None
+
+  def set_mesh(self, mesh) -> None:
+    """Receives the training mesh (train_eval_model / test harness);
+    required before module build for the 'ring' backend."""
+    if self._module is not None and self._mesh is not mesh:
+      raise ValueError("set_mesh must be called before the module is "
+                       "built (create_train_state / first forward).")
+    if mesh is not None and self._attention_backend == "ring":
+      sp = mesh.shape.get(self._sp_axis, 0)
+      if not sp:
+        raise ValueError(
+            f"attention_backend='ring' needs a {self._sp_axis!r} mesh "
+            f"axis; mesh has {dict(mesh.shape)}")
+      if self._sequence_length % sp:
+        raise ValueError(
+            f"sequence_length {self._sequence_length} not divisible by "
+            f"the {sp}-way {self._sp_axis!r} axis")
+    self._mesh = mesh
+
+  @property
+  def batch_partition_spec(self):
+    """Sequence batches are born ('data', 'sp')-sharded at infeed when
+    the ring backend is active (pass to make_train_step's batch_spec)."""
+    if self._attention_backend == "ring" and self._mesh is not None \
+        and self._mesh.shape.get(self._sp_axis, 1) > 1:
+      return jax.sharding.PartitionSpec("data", self._sp_axis)
+    return None
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "observation": TensorSpec(
+            shape=(self._sequence_length, self._obs_size),
+            dtype=np.float32, name="observation"),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(
+            shape=(self._sequence_length, self._action_size),
+            dtype=np.float32, name="action"),
+    })
+
+  def create_module(self):
+    backend = self._attention_backend
+    if backend == "ring" and self._mesh is None:
+      raise ValueError("attention_backend='ring' requires set_mesh() "
+                       "before the module is built.")
+    return _AttentionTrunk(
+        action_size=self._action_size, hidden_size=self._hidden_size,
+        num_blocks=self._num_blocks, num_heads=self._num_heads,
+        backend=backend, mesh=self._mesh, sp_axis=self._sp_axis,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    loss = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
+    return loss, {"mse": loss}

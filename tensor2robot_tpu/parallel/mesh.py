@@ -99,21 +99,26 @@ def local_batch_size(global_batch_size: int, mesh: Mesh) -> int:
 
 
 def put_host_batch(mesh: Mesh, batch, batch_axis: str = "data",
-                   spec_structure: Optional[specs_lib.SpecStructLike] = None
-                   ) -> Any:
+                   spec_structure: Optional[specs_lib.SpecStructLike] = None,
+                   batch_spec: Optional[PartitionSpec] = None) -> Any:
   """Forms the global on-device array from each host's local numpy batch.
 
   Single-host: a plain sharded device_put. Multi-host: every process
   passes its local shard and `jax.make_array_from_process_local_data`
   assembles the global array — the infeed path that replaces
   TPUEstimator's per-host infeed threads.
+
+  `batch_spec` overrides the default batch-dim-only placement for every
+  leaf (e.g. PartitionSpec('data', 'sp') for sequence-parallel infeed);
+  it must match the step's committed in_shardings.
   """
   flat_partition = None
   if spec_structure is not None:
     flat_partition = specs_lib.partition_specs(spec_structure, batch_axis)
 
   def _put(path_key, x):
-    pspec = PartitionSpec(batch_axis)
+    pspec = batch_spec if batch_spec is not None \
+        else PartitionSpec(batch_axis)
     if flat_partition is not None and path_key in flat_partition:
       pspec = flat_partition[path_key]
     sharding = NamedSharding(mesh, pspec)

@@ -168,9 +168,15 @@ def make_train_step(model,
                     mesh: Optional[Mesh] = None,
                     shardings: Any = None,
                     batch_axis: str = "data",
+                    batch_spec: Optional[PartitionSpec] = None,
                     donate: bool = True) -> Callable:
   """Builds the jitted SPMD train step: (state, features, labels) ->
-  (state, scalars)."""
+  (state, scalars).
+
+  `batch_spec` overrides the default batch-dim-only sharding for
+  features/labels — e.g. PartitionSpec('data', 'sp') commits sequence
+  batches [B, T, ...] sharded over BOTH the data and sequence-parallel
+  axes at infeed (models expose it via `batch_partition_spec`)."""
   optimizer = model.create_optimizer()
   ema_decay = model.ema_decay
   # Multi-task gradient surgery (QT-Opt PCGrad,
@@ -257,7 +263,7 @@ def make_train_step(model,
 
   if mesh is None:
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
-  batch_ns = NamedSharding(mesh, PartitionSpec(batch_axis))
+  batch_ns = NamedSharding(mesh, batch_spec or PartitionSpec(batch_axis))
   replicated_ns = NamedSharding(mesh, PartitionSpec())
   return jax.jit(
       step_fn,
@@ -271,6 +277,7 @@ def make_eval_step(model,
                    mesh: Optional[Mesh] = None,
                    shardings: Any = None,
                    batch_axis: str = "data",
+                   batch_spec: Optional[PartitionSpec] = None,
                    use_ema: bool = True) -> Callable:
   """Jitted eval step: (state, features, labels) -> metric scalars."""
 
@@ -287,7 +294,7 @@ def make_eval_step(model,
 
   if mesh is None:
     return jax.jit(eval_fn)
-  batch_ns = NamedSharding(mesh, PartitionSpec(batch_axis))
+  batch_ns = NamedSharding(mesh, batch_spec or PartitionSpec(batch_axis))
   return jax.jit(eval_fn, in_shardings=(shardings, batch_ns, batch_ns))
 
 

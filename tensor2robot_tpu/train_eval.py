@@ -70,14 +70,17 @@ def print_specification(model) -> None:
         logging.info("  %s: %r", key, spec)
 
 
-def _device_batch(mesh, batch):
-  features = mesh_lib.put_host_batch(mesh, batch["features"])
-  labels = (mesh_lib.put_host_batch(mesh, batch["labels"])
+def _device_batch(mesh, batch, batch_spec=None):
+  features = mesh_lib.put_host_batch(mesh, batch["features"],
+                                     batch_spec=batch_spec)
+  labels = (mesh_lib.put_host_batch(mesh, batch["labels"],
+                                    batch_spec=batch_spec)
             if "labels" in batch else specs_lib.SpecStruct())
   return features, labels
 
 
-def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int):
+def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
+              batch_spec=None):
   """Runs eval_steps batches, averaging metric scalars.
 
   Accumulation stays ON DEVICE (async dispatch): a per-batch host
@@ -92,7 +95,7 @@ def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int):
       batch = next(dataset)
     except StopIteration:
       break
-    features, labels = _device_batch(mesh, batch)
+    features, labels = _device_batch(mesh, batch, batch_spec)
     metrics = eval_step(state, features, labels)
     for key, value in metrics.items():
       totals[key] = (totals[key] + value) if key in totals else value
@@ -222,12 +225,14 @@ def train_eval_model(
         hook.after_checkpoint(ctx, step)
 
   # -- evaluate-only modes --------------------------------------------------
+  batch_spec = getattr(model, "batch_partition_spec", None)
   if mode == "evaluate":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  batch_spec=batch_spec,
                                   use_ema=use_ema_for_eval)
     eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
     final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                              eval_steps)
+                              eval_steps, batch_spec)
     writer.write_scalars(int(state.step), final_metrics)
     for hook in hooks:
       hook.after_eval(ctx, int(state.step), final_metrics)
@@ -238,6 +243,7 @@ def train_eval_model(
 
   if mode == "continuous_eval":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  batch_spec=batch_spec,
                                   use_ema=use_ema_for_eval)
     ckpt_dir = os.path.join(model_dir, CHECKPOINT_DIRNAME)
     abstract = jax.tree_util.tree_map(
@@ -259,7 +265,7 @@ def train_eval_model(
           state = manager.restore(step, abstract_state=abstract)
         eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
         final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                  eval_steps)
+                                  eval_steps, batch_spec)
       finally:
         if backup is not None:
           import shutil
@@ -278,10 +284,12 @@ def train_eval_model(
     return final_metrics
 
   # -- training loop --------------------------------------------------------
-  train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings)
+  train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
+                                  batch_spec=batch_spec)
   eval_step = None
   if mode == "train_and_evaluate":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                  batch_spec=batch_spec,
                                   use_ema=use_ema_for_eval)
 
   step = int(state.step)
@@ -289,7 +297,7 @@ def train_eval_model(
   last_log = time.time()
   last_eval_time = 0.0
   while step < max_train_steps:
-    features, labels = _device_batch(mesh, batch)
+    features, labels = _device_batch(mesh, batch, batch_spec)
     state, metrics = train_step(state, features, labels)
     step += 1
     for hook in hooks:
@@ -323,7 +331,7 @@ def train_eval_model(
         last_eval_time = now
         eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
         eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                 eval_steps)
+                                 eval_steps, batch_spec)
         writer.write_scalars(step, {f"eval/{k}": v
                                     for k, v in eval_metrics.items()})
         for hook in hooks:

@@ -121,6 +121,31 @@ def test_pipelined_pp_config_trains_on_mesh(tmp_path):
   assert_output_files(model_dir, expect_operative_config=False)
 
 
+def test_sp_ring_config_trains_on_mesh(tmp_path):
+  """SP through the full training path: train_sp_ring.gin trains the
+  causal ring-attention model through train_eval_model on a
+  ('data', 'sp', 'model') = (2, 2, 1) mesh, sequence batches sharded
+  over 'sp' at infeed."""
+  config_path = os.path.join(REPO_ROOT, "tensor2robot_tpu", "configs",
+                             "train_sp_ring.gin")
+  model_dir = str(tmp_path / "sp")
+  bindings = [b for b in _SHRINK
+              if "mesh_shape" not in b and "batch_size" not in b]
+  bindings.append(f"train_eval_model.model_dir = {model_dir!r}")
+  # train_and_evaluate: the in-loop eval must place batches with the
+  # model's ('data', 'sp') batch_partition_spec too (regression guard —
+  # it once used the default 'data'-only placement and mismatched the
+  # eval step's committed in_shardings).
+  bindings.append("train_eval_model.mode = 'train_and_evaluate'")
+  bindings.append("train_eval_model.input_generator_eval = "
+                  "@eval/DefaultRandomInputGenerator()")
+  config.parse_config_files_and_bindings([config_path], bindings)
+  metrics = train_eval.train_eval_model()
+  assert metrics
+  assert any(k.startswith("eval/") for k in metrics), metrics
+  assert_output_files(model_dir, expect_operative_config=False)
+
+
 def test_actor_configs_drive_collect_loop(tmp_path):
   """Non-trainer (actor-side) configs run the collect/eval loop and
   write replay records."""
