@@ -28,13 +28,27 @@ from tensor2robot_tpu.utils import backend  # noqa: E402 (before jax use)
 
 
 def timed(fn, *args, iters=10):
+  """Per-iter wall time with the host-fetch barrier cost cancelled.
+
+  The tunnel has no cheap barrier: the only reliable one is a host fetch,
+  which costs real time that would otherwise be amortized into the
+  measurement. Time (1 iter + fetch) and (iters + fetch) and difference
+  them, so the fetch (and any fixed dispatch overhead) cancels.
+  """
   out = fn(*args)          # warmup / compile
   backend.sync(out)
-  t0 = time.perf_counter()
-  for _ in range(iters):
-    out = fn(*args)
-  backend.sync(out)
-  return (time.perf_counter() - t0) / iters
+
+  def run(n):
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+      out = fn(*args)
+    backend.sync(out)
+    return time.perf_counter() - t0
+
+  t1 = run(1)
+  tn = run(iters)
+  return (tn - t1) / (iters - 1)
 
 
 def _qkv(shape, dtype, seed):

@@ -7,7 +7,7 @@ XLA inserting the dispatch/combine collectives (all_to_all-class traffic
 over ICI when experts and tokens live on different axes).
 
 Design notes for TPU:
-* two dispatch modes, both static-shaped and MXU-friendly:
+* three dispatch modes, all static-shaped and MXU-friendly:
   - `dense`: every expert computes every token, the gate zeroes the rest.
     Exact, collective-free, right for few-expert robot-scale models.
   - `sparse`: GShard/Switch-style capacity routing. Tokens are packed into
@@ -15,7 +15,19 @@ Design notes for TPU:
     expert FLOPs are O(E * capacity) = O(N * capacity_factor) instead of
     O(E * N), and over-capacity tokens are dropped (their gate mass
     renormalizes away). With `experts_*` sharded over a mesh axis the
-    ecf/eco einsums become the all_to_all dispatch/combine.
+    ecf/eco einsums become all_to_all-class traffic — but GSPMD chooses
+    the collectives.
+  - `alltoall`: the same capacity routing with the collectives made
+    explicit: a `shard_map` over `ep_axis` in which each device packs its
+    LOCAL tokens' slots, a `lax.all_to_all` ships each expert-group's
+    slots to the device that owns those experts, local experts run, and a
+    second all_to_all ships results home (Switch-Transformer §2.2 token
+    routing). Per-device dispatch traffic is exactly 2 * E * C_local * F
+    instead of whatever GSPMD infers — requires `experts_*` sharded over
+    the SAME axis as the tokens (`expert_parallel_rules(axis="data")`)
+    and `set_mesh`-style mesh plumbing. Capacity is per source shard, so
+    drop behavior is per-shard rather than global (documented delta vs
+    `sparse`).
 * router in float32 for numerics, experts in the compute dtype;
 * auxiliary load-balancing loss (Switch-style) returned alongside.
 """
@@ -28,13 +40,24 @@ from typing import Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec
 
-__all__ = ["MixtureOfExperts", "EXPERT_AXIS_PARAM_RULE"]
+__all__ = ["MixtureOfExperts", "EXPERT_AXIS_PARAM_RULE",
+           "expert_axis_param_rule"]
 
-# Partition rule: expert-major params shard their leading dim over the
-# 'model' mesh axis (EP = expert dim sharded). Pass to make_train_step's
-# rules to activate expert parallelism.
-EXPERT_AXIS_PARAM_RULE = (r"experts_", ("model", None, None))
+def expert_axis_param_rule(axis: str = "model"):
+  """Partition rule: expert-major params shard their leading dim over
+  `axis` (EP = expert dim sharded). Pass to make_train_step's rules.
+
+  `dispatch='alltoall'` wants experts sharded over the SAME axis as the
+  tokens (classically the data axis) so the all_to_all rides that axis;
+  pass `expert_axis_param_rule("data")` to the step factory's rules.
+  """
+  return (r"experts_", (axis, None, None))
+
+
+# The default 'model'-axis rule (GSPMD sparse/dense dispatch layouts).
+EXPERT_AXIS_PARAM_RULE = expert_axis_param_rule()
 
 
 class MixtureOfExperts(nn.Module):
@@ -45,14 +68,16 @@ class MixtureOfExperts(nn.Module):
   output_size: int = 64
   top_k: int = 1
   router_noise: float = 0.0
-  dispatch: str = "dense"  # 'dense' | 'sparse'
-  capacity_factor: float = 1.25  # sparse only
+  dispatch: str = "dense"  # 'dense' | 'sparse' | 'alltoall'
+  capacity_factor: float = 1.25  # sparse/alltoall only
+  mesh: Optional[Mesh] = None  # alltoall only
+  ep_axis: str = "data"  # alltoall only: axis sharding tokens AND experts
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, train: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Returns (output, aux_load_balancing_loss)."""
-    if self.dispatch not in ("dense", "sparse"):
+    if self.dispatch not in ("dense", "sparse", "alltoall"):
       raise ValueError(f"Unknown dispatch mode {self.dispatch!r}")
     leading = x.shape[:-1]
     features = x.shape[-1]
@@ -89,8 +114,11 @@ class MixtureOfExperts(nn.Module):
       combined = jnp.einsum("eno,ne->no", expert_out,
                             gates.astype(expert_out.dtype))
       load = gates.astype(jnp.float32).mean(0)
-    else:
+    elif self.dispatch == "sparse":
       combined, load = self._sparse_dispatch(
+          tokens, top_probs, top_idx, w1, b1, w2, b2)
+    else:
+      combined, load = self._alltoall_dispatch(
           tokens, top_probs, top_idx, w1, b1, w2, b2)
 
     # Switch-transformer load-balancing auxiliary.
@@ -99,21 +127,27 @@ class MixtureOfExperts(nn.Module):
 
     return combined.reshape(leading + (self.output_size,)), aux_loss
 
-  def _sparse_dispatch(self, tokens, top_probs, top_idx, w1, b1, w2, b2):
-    """Capacity-bounded routing via one-hot dispatch/combine einsums."""
-    n = tokens.shape[0]
-    e = self.num_experts
-    capacity = max(1, int(math.ceil(
-        self.top_k * n / e * self.capacity_factor)))
+  def _capacity(self, n_tokens: int) -> int:
+    return max(1, int(math.ceil(
+        self.top_k * n_tokens / self.num_experts * self.capacity_factor)))
 
+  def _pack_combine(self, top_probs, top_idx, capacity):
+    """Packs top-k choices into per-expert slots: combine [N, E, C].
+
+    Tokens earlier in the batch (and earlier slots) claim lower slot
+    positions; over-capacity choices are dropped and the kept gate mass
+    renormalizes (matches dense top-k renorm; fully-dropped tokens
+    produce zero output).
+    """
+    n = top_probs.shape[0]
+    e = self.num_experts
     combine = jnp.zeros((n, e, capacity), jnp.float32)
     counts = jnp.zeros((e,), jnp.float32)  # slots already claimed per e
     kept_gate_sum = jnp.zeros((n,), jnp.float32)
     for slot in range(self.top_k):
       expert = top_idx[:, slot]                      # [N]
       oh = jax.nn.one_hot(expert, e)                 # [N, E]
-      # Position of each token within its expert's buffer: tokens earlier
-      # in the batch (and earlier slots) claim lower positions.
+      # Position of each token within its expert's buffer.
       pos_within = jnp.cumsum(oh, axis=0) - oh       # [N, E]
       pos = ((pos_within + counts[None, :]) * oh).sum(-1)  # [N]
       keep = (pos < capacity).astype(jnp.float32)
@@ -123,9 +157,12 @@ class MixtureOfExperts(nn.Module):
           * jax.nn.one_hot(pos.astype(jnp.int32), capacity)[:, None, :])
       counts = counts + (oh * keep[:, None]).sum(0)
       kept_gate_sum = kept_gate_sum + gate
-    # Renormalize over the kept choices (matches dense top-k renorm;
-    # fully-dropped tokens produce zero output).
-    combine = combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+    return combine / jnp.maximum(kept_gate_sum, 1e-9)[:, None, None]
+
+  def _sparse_dispatch(self, tokens, top_probs, top_idx, w1, b1, w2, b2):
+    """Capacity-bounded routing via one-hot dispatch/combine einsums."""
+    combine = self._pack_combine(top_probs, top_idx,
+                                 self._capacity(tokens.shape[0]))
     dispatch = (combine > 0).astype(tokens.dtype)    # [N, E, C]
 
     expert_inputs = jnp.einsum("nec,nf->ecf", dispatch,
@@ -139,3 +176,64 @@ class MixtureOfExperts(nn.Module):
     # the meaning of moe_aux_loss.
     load = combine.sum(-1).mean(0)
     return combined, load
+
+  def _alltoall_dispatch(self, tokens, top_probs, top_idx, w1, b1, w2, b2):
+    """Explicit token routing: shard_map + all_to_all over `ep_axis`.
+
+    Layout: tokens [N, F] and the expert dim of `experts_*` are both
+    sharded over `ep_axis` (size S, E % S == 0). Each device packs its
+    n_local tokens into [E, C_local] slots, an all_to_all ships each
+    expert-group's slots to its owner (-> [E_local, S*C_local]), local
+    experts run, and the transpose all_to_all ships results home. The
+    backward pass is the transposed schedule (all_to_all is its own
+    transpose), derived by autodiff through shard_map.
+    """
+    if self.mesh is None:
+      raise ValueError("dispatch='alltoall' requires a mesh (set the "
+                       "`mesh` attr, e.g. via the model's set_mesh hook)")
+    axis = self.ep_axis
+    s = self.mesh.shape[axis]
+    e = self.num_experts
+    n = tokens.shape[0]
+    if e % s:
+      raise ValueError(f"num_experts={e} must be divisible by the "
+                       f"'{axis}' axis size {s}")
+    if n % s:
+      raise ValueError(f"token count {n} must be divisible by the "
+                       f"'{axis}' axis size {s}")
+    e_local = e // s
+    capacity = self._capacity(n // s)  # per SOURCE shard (doc delta)
+    compute_dtype = w1.dtype
+
+    def local_fn(tokens_l, top_probs_l, top_idx_l, w1_l, b1_l, w2_l, b2_l):
+      combine = self._pack_combine(top_probs_l, top_idx_l, capacity)
+      dispatch = (combine > 0).astype(compute_dtype)   # [n_l, E, C]
+      slots = jnp.einsum("nec,nf->ecf", dispatch,
+                         tokens_l.astype(compute_dtype))
+      # [E, C, F] -> [S, E_l, C, F]; all_to_all scatters dim 0 and
+      # gathers the source dim in its place: on the receiver, dim 0
+      # indexes the SOURCE shard and E_l are its own experts.
+      slots = slots.reshape(s, e_local, capacity, -1)
+      slots = jax.lax.all_to_all(slots, axis, 0, 0)    # [S, E_l, C, F]
+      slots = jnp.moveaxis(slots, 0, 1).reshape(e_local, s * capacity, -1)
+      hidden = nn.relu(jnp.einsum("ekf,efh->ekh", slots, w1_l) + b1_l)
+      out = jnp.einsum("ekh,eho->eko", hidden, w2_l) + b2_l
+      # Ship results back to the token owners (transpose of the inbound
+      # schedule), landing as [E, C, O] in global-expert order.
+      out = jnp.moveaxis(out.reshape(e_local, s, capacity, -1), 1, 0)
+      out = jax.lax.all_to_all(out, axis, 0, 0)        # [S, E_l, C, O]
+      out = out.reshape(e, capacity, -1)
+      combined = jnp.einsum("nec,eco->no",
+                            combine.astype(out.dtype), out)
+      load = jax.lax.pmean(combine.sum(-1).mean(0), axis)
+      return combined, load
+
+    spec_tok = PartitionSpec(axis, None)
+    spec_exp = PartitionSpec(axis, None, None)
+    sharded = jax.shard_map(
+        local_fn, mesh=self.mesh,
+        in_specs=(spec_tok, spec_tok, spec_tok,
+                  spec_exp, spec_exp, spec_exp, spec_exp),
+        out_specs=(spec_tok, PartitionSpec()),
+        check_vma=False)
+    return sharded(tokens, top_probs, top_idx, w1, b1, w2, b2)

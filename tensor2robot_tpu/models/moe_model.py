@@ -26,9 +26,14 @@ __all__ = ["MoERegressionModel", "expert_parallel_rules"]
 
 
 @config.configurable
-def expert_parallel_rules(extra_rules=()):
-  """Partition rules activating EP for `experts_*` params (gin-friendly)."""
-  return (moe_lib.EXPERT_AXIS_PARAM_RULE,) + tuple(extra_rules)
+def expert_parallel_rules(extra_rules=(), axis: str = "model"):
+  """Partition rules activating EP for `experts_*` params (gin-friendly).
+
+  `axis="model"` is the GSPMD einsum layout (`dispatch='sparse'`);
+  `axis="data"` co-shards experts with the tokens, the layout
+  `dispatch='alltoall'`'s explicit routing requires.
+  """
+  return (moe_lib.expert_axis_param_rule(axis),) + tuple(extra_rules)
 
 
 class _MoENetwork(nn.Module):
@@ -38,6 +43,8 @@ class _MoENetwork(nn.Module):
   top_k: int = 1
   dispatch: str = "sparse"
   capacity_factor: float = 1.25
+  mesh: object = None
+  ep_axis: str = "data"
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
@@ -48,6 +55,7 @@ class _MoENetwork(nn.Module):
         num_experts=self.num_experts, hidden_size=self.hidden_size,
         output_size=self.hidden_size, top_k=self.top_k,
         dispatch=self.dispatch, capacity_factor=self.capacity_factor,
+        mesh=self.mesh, ep_axis=self.ep_axis,
         name="moe")(x, train=train)
     x = nn.relu(x)
     action = nn.Dense(self.action_size, name="action")(x)
@@ -66,7 +74,8 @@ class MoERegressionModel(abstract_model.T2RModel):
                num_experts: int = 4, hidden_size: int = 64,
                top_k: int = 1, dispatch: str = "sparse",
                capacity_factor: float = 1.25,
-               aux_loss_weight: float = 0.01, **kwargs):
+               aux_loss_weight: float = 0.01,
+               ep_axis: str = "data", **kwargs):
     super().__init__(**kwargs)
     self._obs_size = obs_size
     self._action_size = action_size
@@ -76,6 +85,17 @@ class MoERegressionModel(abstract_model.T2RModel):
     self._dispatch = dispatch
     self._capacity_factor = capacity_factor
     self._aux_loss_weight = aux_loss_weight
+    self._ep_axis = ep_axis
+    self._mesh = None
+
+  def set_mesh(self, mesh) -> None:
+    """Mesh hook (train_eval.py calls this): dispatch='alltoall' runs
+    explicit shard_map collectives and needs the mesh before tracing."""
+    if self._module is not None and self._mesh is not mesh:
+      raise ValueError("set_mesh must be called before the module is "
+                       "created (the mesh is baked into the traced "
+                       "collectives)")
+    self._mesh = mesh
 
   def get_feature_specification(self, mode):
     return SpecStruct({
@@ -90,10 +110,15 @@ class MoERegressionModel(abstract_model.T2RModel):
     })
 
   def create_module(self):
+    if self._dispatch == "alltoall" and self._mesh is None:
+      raise ValueError("dispatch='alltoall' needs set_mesh() before the "
+                       "module is created (train_eval_model does this "
+                       "when given mesh axis names)")
     return _MoENetwork(
         action_size=self._action_size, num_experts=self._num_experts,
         hidden_size=self._hidden_size, top_k=self._top_k,
-        dispatch=self._dispatch, capacity_factor=self._capacity_factor)
+        dispatch=self._dispatch, capacity_factor=self._capacity_factor,
+        mesh=self._mesh, ep_axis=self._ep_axis)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
     mse = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)

@@ -145,6 +145,130 @@ class TestSparseDispatch:
     assert float(jnp.abs(grads["experts_w1"]).max()) > 0
 
 
+class TestMoEAllToAll:
+  """Explicit shard_map + lax.all_to_all token routing (dispatch='alltoall')."""
+
+  def _pair(self, num_experts=8, top_k=2, mesh_shape=(8, 1, 1),
+            capacity_factor=64.0, n=32):
+    mesh = mesh_lib.create_mesh(mesh_shape=mesh_shape)
+    kw = dict(num_experts=num_experts, hidden_size=8, output_size=6,
+              top_k=top_k)
+    dense = MixtureOfExperts(dispatch="dense", **kw)
+    a2a = MixtureOfExperts(dispatch="alltoall", mesh=mesh, ep_axis="data",
+                           capacity_factor=capacity_factor, **kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (n, 5))
+    variables = dense.init(jax.random.PRNGKey(1), x)  # same param tree
+    return dense, a2a, variables, x
+
+  @pytest.mark.parametrize("mesh_shape,num_experts",
+                           [((8, 1, 1), 8), ((4, 1, 1), 8)])
+  def test_matches_dense_when_nothing_drops(self, mesh_shape, num_experts):
+    dense, a2a, variables, x = self._pair(num_experts=num_experts,
+                                          mesh_shape=mesh_shape)
+    out_d, aux_d = dense.apply(variables, x)
+    out_a, aux_a = jax.jit(lambda v, x: a2a.apply(v, x))(variables, x)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_d),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_a), float(aux_d), atol=2e-5)
+
+  def test_grads_match_dense_when_nothing_drops(self):
+    dense, a2a, variables, x = self._pair()
+
+    def loss(module):
+      def f(v):
+        out, aux = module.apply(v, x)
+        return (out ** 2).mean() + 0.01 * aux
+      return f
+
+    g_d = jax.grad(loss(dense))(variables)["params"]
+    g_a = jax.jit(jax.grad(loss(a2a)))(variables)["params"]
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5), g_a, g_d)
+
+  def test_capacity_drops_are_per_source_shard(self):
+    """Pin the router so every token routes to expert 0; with 1 slot per
+    expert, alltoall keeps the FIRST token of each source shard while
+    sparse (global capacity) keeps the first `capacity` tokens of the
+    batch — the documented per-shard-vs-global drop delta."""
+    mesh = mesh_lib.create_mesh(mesh_shape=(8, 1, 1))
+    kw = dict(num_experts=8, hidden_size=8, output_size=6, top_k=1)
+    # alltoall: capacity = ceil(1 * n_local / E * cf) = ceil(4/8*1) = 1
+    # sparse:   capacity = ceil(1 * n / E * cf)       = ceil(32/8)  = 4
+    a2a = MixtureOfExperts(dispatch="alltoall", mesh=mesh, ep_axis="data",
+                           capacity_factor=1.0, **kw)
+    sparse = MixtureOfExperts(dispatch="sparse", capacity_factor=1.0, **kw)
+    x = jax.random.normal(jax.random.PRNGKey(0), (32, 5))
+    variables = sparse.init(jax.random.PRNGKey(1), x)
+    # Router logits = +10 for expert 0, 0 elsewhere, for every token.
+    kernel = variables["params"]["router"]["kernel"]
+    pinned = jnp.zeros_like(kernel)
+    bias = jnp.zeros((8,)).at[0].set(10.0)
+    variables = {"params": {**variables["params"],
+                            "router": {"kernel": pinned, "bias": bias}}}
+    out_a = np.asarray(jax.jit(
+        lambda v, x: a2a.apply(v, x)[0])(variables, x))
+    out_s = np.asarray(jax.jit(
+        lambda v, x: sparse.apply(v, x)[0])(variables, x))
+    kept_a = set(np.nonzero(np.abs(out_a).sum(-1) > 1e-9)[0].tolist())
+    kept_s = set(np.nonzero(np.abs(out_s).sum(-1) > 1e-9)[0].tolist())
+    # 32 tokens over 8 shards of 4: alltoall keeps token 0 of each shard.
+    assert kept_a == {0, 4, 8, 12, 16, 20, 24, 28}, kept_a
+    # sparse packs globally in batch order: first 4 tokens keep slots.
+    assert kept_s == {0, 1, 2, 3}, kept_s
+
+  def test_requires_mesh_and_divisibility(self):
+    module = MixtureOfExperts(num_experts=8, dispatch="alltoall")
+    x = jnp.zeros((8, 5))
+    with pytest.raises(ValueError, match="mesh"):
+      module.init(jax.random.PRNGKey(0), x)
+    mesh = mesh_lib.create_mesh(mesh_shape=(8, 1, 1))
+    bad_experts = MixtureOfExperts(num_experts=6, dispatch="alltoall",
+                                   mesh=mesh)
+    with pytest.raises(ValueError, match="divisible"):
+      bad_experts.init(jax.random.PRNGKey(0), x)
+    bad_tokens = MixtureOfExperts(num_experts=8, dispatch="alltoall",
+                                  mesh=mesh)
+    with pytest.raises(ValueError, match="divisible"):
+      bad_tokens.init(jax.random.PRNGKey(0), jnp.zeros((12, 5)))
+
+  def test_trains_through_step_factory_on_data_axis(self):
+    """EP over the data axis: experts co-sharded with tokens, explicit
+    all_to_all dispatch inside the jitted train step."""
+    from tensor2robot_tpu.models import moe_model
+    from tensor2robot_tpu import specs as specs_lib
+    import optax
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(8, 1, 1))
+    model = moe_model.MoERegressionModel(
+        obs_size=8, action_size=3, num_experts=8, hidden_size=16,
+        dispatch="alltoall", capacity_factor=2.0, device_type="cpu",
+        optimizer_fn=lambda: optax.adam(3e-3))
+    model.set_mesh(mesh)
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification("train"), batch_size=64, seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification("train"), batch_size=64, seed=1)
+    rules = moe_model.expert_parallel_rules(axis="data")
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(0), features, mesh=mesh, rules=rules)
+    expert_specs = [
+        l.sharding.spec for p, l in
+        jax.tree_util.tree_leaves_with_path(state.params)
+        if "experts_w" in jax.tree_util.keystr(p)]
+    assert expert_specs and all(
+        s == PartitionSpec("data", None, None) for s in expert_specs)
+    step = ts.make_train_step(model, mesh=mesh, shardings=shardings)
+    f = mesh_lib.put_host_batch(mesh, features)
+    l = mesh_lib.put_host_batch(mesh, labels)
+    first = None
+    for _ in range(30):
+      state, metrics = step(state, f, l)
+      first = first if first is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
+
+
 class TestExpertParallelTrainStep:
   """EP as a *training capability*: MoERegressionModel through the
   generic step factory on a mesh, expert params sharded over 'model'."""
