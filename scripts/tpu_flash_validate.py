@@ -35,6 +35,9 @@ def timed(fn, *args, iters=10):
   measurement. Time (1 iter + fetch) and (iters + fetch) and difference
   them, so the fetch (and any fixed dispatch overhead) cancels.
   """
+  if iters < 2:
+    raise ValueError("iters must be >= 2 (the fetch-cancel difference "
+                     "needs two run lengths)")
   out = fn(*args)          # warmup / compile
   backend.sync(out)
 

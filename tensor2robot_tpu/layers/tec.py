@@ -10,15 +10,15 @@ data-dependent shapes), so everything jits.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional, Sequence
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
 __all__ = ["reduce_temporal_embeddings", "EmbedEpisode",
-           "TemporalConvEmbedding", "npairs_loss", "triplet_semihard_loss",
-           "cosine_distance_matrix"]
+           "EmbedConditionImages", "TemporalConvEmbedding", "npairs_loss",
+           "triplet_semihard_loss", "cosine_distance_matrix"]
 
 
 def reduce_temporal_embeddings(embeddings: jnp.ndarray,
@@ -50,6 +50,50 @@ class EmbedEpisode(nn.Module):
     if self.normalize:
       x = x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-7)
     return x
+
+
+class EmbedConditionImages(nn.Module):
+  """Full conv-tower image embedding with an optional fc head.
+
+  Reference `embed_condition_images` (/root/reference/layers/tec.py:
+  61-112): BuildImagesToFeaturesModel (conv stack + spatial softmax) per
+  frame, then — when `fc_layers` is set — relu+layer-norm hidden layers
+  and a linear final layer (1x1 convs instead when spatial softmax is
+  off and the features are still spatial).
+  """
+
+  fc_layers: Optional[Sequence[int]] = None
+  use_spatial_softmax: bool = True
+  filters: Sequence[int] = (64, 32, 32)
+  kernel_sizes: Sequence[int] = (7, 3, 3)
+  strides: Sequence[int] = (2, 1, 1)
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               train: bool = False) -> jnp.ndarray:
+    # Import here: vision.py sits above tec.py in the layer DAG only for
+    # this module (everything else in this file is tower-free math).
+    from tensor2robot_tpu.layers import vision
+
+    x = vision.BerkeleyNet(
+        filters=tuple(self.filters), kernel_sizes=tuple(self.kernel_sizes),
+        strides=tuple(self.strides),
+        use_spatial_softmax=self.use_spatial_softmax, flatten=False,
+        dtype=self.dtype,
+        name="images_to_features")(images, train=train)
+    if self.fc_layers is None:
+      return x
+    hidden, final = tuple(self.fc_layers[:-1]), self.fc_layers[-1]
+    if x.ndim == 2:  # spatial softmax: [N, F] feature points
+      for i, units in enumerate(hidden):
+        x = nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
+            nn.relu(nn.Dense(units, name=f"fc_{i}")(x)))
+      return nn.Dense(final, name="fc_out")(x)
+    for i, units in enumerate(hidden):  # spatial: 1x1 convs
+      x = nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
+          nn.relu(nn.Conv(units, (1, 1), name=f"fc_{i}")(x)))
+    return nn.Conv(final, (1, 1), name="fc_out")(x)
 
 
 class TemporalConvEmbedding(nn.Module):

@@ -53,6 +53,7 @@ class BerkeleyNet(nn.Module):
   kernel_sizes: Sequence[int] = (7, 3, 3)
   strides: Sequence[int] = (2, 1, 1)
   use_spatial_softmax: bool = True
+  flatten: bool = True  # no-spatial-softmax path: flatten vs keep [H,W,C]
   normalizer: str = "layer_norm"  # 'batch_norm'|'layer_norm'|'none'
   dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
@@ -77,7 +78,7 @@ class BerkeleyNet(nn.Module):
       x = nn.relu(x)
     if self.use_spatial_softmax:
       return SpatialSoftmax(name="spatial_softmax")(x, train=train)
-    return x.reshape(x.shape[0], -1)
+    return x.reshape(x.shape[0], -1) if self.flatten else x
 
 
 class HighResBerkeleyNet(nn.Module):

@@ -513,11 +513,20 @@ class _WTLVisionTrialNetwork(nn.Module):
   VRGripperEnvVisionTrialModel, wtl_models.py:354-570): per-frame conv
   embeddings of condition images + gripper pose reduced to a task
   embedding; with 2+ condition episodes the prior trial (with success and
-  the demo embedding) contributes a second embedding (TEC-style)."""
+  the demo embedding) contributes a second embedding (TEC-style).
+
+  Torso wiring matches the reference: condition frames (demo AND trial)
+  share one `embed_condition_images` stack — full conv tower + spatial
+  softmax + fc head (fc_layers=(100, 64) per the reference's
+  run_train_wtl_vision_trial.gin) under a single 'image_embedding' scope
+  (wtl_models.py:434-448) — while inference frames get a SEPARATE
+  full BuildImagesToFeaturesModel tower under 'state_features'
+  (wtl_models.py:474-477)."""
 
   action_size: int = 7
   fc_embed_size: int = 32
   num_feature_points: int = 32
+  embed_fc_layers: Optional[Tuple[int, ...]] = (100, 64)
   num_mixture_components: int = 1
   num_condition_episodes: int = 1
   ignore_embedding: bool = False
@@ -526,14 +535,17 @@ class _WTLVisionTrialNetwork(nn.Module):
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
-    torso = vision.BerkeleyNet(
-        filters=(self.num_feature_points,), kernel_sizes=(5,),
-        strides=(2,), dtype=self.dtype, name="image_embedding")
+    conv_filters = (64, 32, self.num_feature_points)
+    cond_torso = tec_lib.EmbedConditionImages(
+        fc_layers=self.embed_fc_layers, filters=conv_filters,
+        dtype=self.dtype, name="image_embedding")
+    state_torso = vision.BerkeleyNet(
+        filters=conv_filters, dtype=self.dtype, name="state_features")
 
-    def _frames_to_features(images):
-      """[..., T, H, W, C] -> [..., T, F] shared per-frame conv torso."""
+    def _frames_to_features(net, images):
+      """[..., T, H, W, C] -> [..., T, F] per-frame conv torso."""
       return batch_utils.multi_batch_apply(
-          lambda flat: torso(flat, train=train), images.ndim - 3, images)
+          lambda flat: net(flat, train=train), images.ndim - 3, images)
 
     con_images = features["condition/features/image"]  # [B,E,T,H,W,C]
     con_pose = features["condition/features/gripper_pose"]  # [B,E,T,P]
@@ -544,14 +556,14 @@ class _WTLVisionTrialNetwork(nn.Module):
     inf_images = normalize_image(inf_images, self.dtype)
     b, num_inference, t = inf_images.shape[:3]
 
-    demo_fp = _frames_to_features(con_images[:, 0])  # [B,T,F]
+    demo_fp = _frames_to_features(cond_torso, con_images[:, 0])
     demo_in = jnp.concatenate(
         [demo_fp, con_pose[:, 0].astype(demo_fp.dtype)], axis=-1)
     embedding = tec_lib.TemporalConvEmbedding(
         self.fc_embed_size, name="fc_demo_reduce")(demo_in)
 
     if self.num_condition_episodes > 1:
-      trial_fp = _frames_to_features(con_images[:, 1])
+      trial_fp = _frames_to_features(cond_torso, con_images[:, 1])
       demo_tiled = jnp.broadcast_to(
           embedding[:, None, :], (b, t, embedding.shape[-1]))
       trial_in = jnp.concatenate([
@@ -561,7 +573,7 @@ class _WTLVisionTrialNetwork(nn.Module):
           self.fc_embed_size, name="fc_trial_reduce")(trial_in)
       embedding = jnp.concatenate([embedding, trial_embedding], axis=-1)
 
-    state_features = _frames_to_features(inf_images)  # [B, I, T, F]
+    state_features = _frames_to_features(state_torso, inf_images)
     emb_tiled = jnp.broadcast_to(
         embedding[:, None, None, :],
         (b, num_inference, t, embedding.shape[-1]))
@@ -726,10 +738,15 @@ class WTLVisionTrialModel(_WTLModelBase):
   VRGripperEnvVisionTrialModel, wtl_models.py:354-570); retrial behavior
   turns on with num_condition_episodes > 1, matching the reference."""
 
-  def __init__(self, image_size: int = 48, pose_size: int = 7, **kwargs):
+  def __init__(self, image_size: int = 48, pose_size: int = 7,
+               num_feature_points: int = 32,
+               embed_fc_layers: Optional[Tuple[int, ...]] = (100, 64),
+               **kwargs):
     super().__init__(**kwargs)
     self._image_size = image_size
     self._pose_size = pose_size
+    self._num_feature_points = num_feature_points
+    self._embed_fc_layers = embed_fc_layers
 
   def _episode_feature_specification(self, mode):
     del mode
@@ -747,6 +764,8 @@ class WTLVisionTrialModel(_WTLModelBase):
     return _WTLVisionTrialNetwork(
         action_size=self._action_size,
         fc_embed_size=self._fc_embed_size,
+        num_feature_points=self._num_feature_points,
+        embed_fc_layers=self._embed_fc_layers,
         num_mixture_components=self._num_mixture_components,
         num_condition_episodes=self._num_condition_episodes,
         ignore_embedding=self._ignore_embedding,

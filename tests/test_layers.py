@@ -256,6 +256,43 @@ class TestTEC:
     with pytest.raises(ValueError):
       tec.reduce_temporal_embeddings(x, "nope")
 
+  def test_embed_condition_images_fc_head(self):
+    """Spatial-softmax path: [N,H,W,C] -> [N, fc_layers[-1]], with the
+    hidden fc layers present in the param tree (reference
+    embed_condition_images fc stack, tec.py:90-99)."""
+    module = tec.EmbedConditionImages(fc_layers=(100, 64),
+                                      filters=(8, 8, 8))
+    images = jax.random.uniform(jax.random.PRNGKey(0), (3, 24, 24, 3))
+    variables = module.init(jax.random.PRNGKey(1), images)
+    out = module.apply(variables, images)
+    assert out.shape == (3, 64)
+    params = variables["params"]
+    assert "fc_0" in params and "fc_out" in params
+    assert params["fc_0"]["kernel"].shape[-1] == 100
+    # conv tower lives under its own scope like the reference's
+    # BuildImagesToFeaturesModel call
+    assert "images_to_features" in params
+
+  def test_embed_condition_images_no_fc_passthrough(self):
+    module = tec.EmbedConditionImages(fc_layers=None, filters=(8, 8, 8))
+    images = jax.random.uniform(jax.random.PRNGKey(0), (3, 24, 24, 3))
+    variables = module.init(jax.random.PRNGKey(1), images)
+    out = module.apply(variables, images)
+    assert out.shape == (3, 16)  # spatial softmax: 2 coords per filter
+
+  def test_embed_condition_images_spatial_uses_1x1(self):
+    """With spatial softmax off the fc head becomes 1x1 convs over the
+    spatial map (reference tec.py:100-112)."""
+    module = tec.EmbedConditionImages(fc_layers=(12, 6),
+                                      use_spatial_softmax=False,
+                                      filters=(8,), kernel_sizes=(3,),
+                                      strides=(1,))
+    images = jax.random.uniform(jax.random.PRNGKey(0), (2, 8, 8, 3))
+    variables = module.init(jax.random.PRNGKey(1), images)
+    out = module.apply(variables, images)
+    assert out.ndim == 4 and out.shape[0] == 2 and out.shape[-1] == 6
+    assert variables["params"]["fc_0"]["kernel"].shape[:2] == (1, 1)
+
   def test_npairs_loss_prefers_aligned(self):
     anchors = jnp.eye(4)
     aligned = float(tec.npairs_loss(anchors, anchors * 10))
