@@ -1,0 +1,130 @@
+"""TPU step tuning probes for the flagship Grasping44 train step.
+
+Usage (healthy axon tunnel, cwd=/root/repo) — each phase is a separate
+short process on purpose (tunnel compiles are 20-40 s; NEVER wrap in
+shell `timeout`, see PERFORMANCE.md incident rules):
+
+  python scripts/tpu_step_tuning.py roofline
+  python scripts/tpu_step_tuning.py batch 32
+  python scripts/tpu_step_tuning.py batch 128
+  python scripts/tpu_step_tuning.py profile
+
+Phases:
+  roofline — XLA cost_analysis (FLOPs + bytes accessed) of the compiled
+             bf16 train step + measured step time -> compute/memory
+             bounds and MXU utilization (PERFORMANCE.md round-2 method).
+  batch N  — train-step throughput at batch N (bench.py method: host
+             fetch of the smallest param leaf as the barrier).
+  profile  — jax.profiler trace over a few steps into profiles/
+             (inspect with tensorboard --logdir profiles/).
+"""
+import sys
+import time
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from tensor2robot_tpu.utils import backend
+
+
+IMAGE_SIZE = 472
+NUM_CONVS = (7, 6, 3)  # full Grasping44; reduce for small-image sanity runs
+
+
+def _setup(batch_size):
+  import jax
+
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+  device = jax.devices()[0]
+  model = qtopt_models.QTOptModel(
+      image_size=IMAGE_SIZE, device_type=device.platform,
+      network="grasping44", num_convs=NUM_CONVS, action_size=5,
+      grasp_param_names={"world_vector": (0, 3),
+                         "vertical_rotation": (3, 2)},
+      use_bfloat16=device.platform != "cpu", use_ema=True)
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  features = jax.device_put(features, device)
+  labels = jax.device_put(labels, device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  step = ts.make_train_step(model)
+  return jax, state, step, features, labels
+
+
+def _barrier(jax, state):
+  return backend.sync(
+      min(jax.tree_util.tree_leaves(state.params), key=lambda a: a.size))
+
+
+def _step_time(jax, state, step, features, labels, iters=20):
+  for _ in range(3):
+    state, _ = step(state, features, labels)
+  _barrier(jax, state)
+  t0 = time.perf_counter()
+  for _ in range(iters):
+    state, _ = step(state, features, labels)
+  _barrier(jax, state)
+  return (time.perf_counter() - t0) / iters, state
+
+
+def roofline(batch_size=64):
+  jax, state, step, features, labels = _setup(batch_size)
+  compiled = step.lower(state, features, labels).compile()
+  cost = compiled.cost_analysis()
+  cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+  flops = cost.get("flops", float("nan"))
+  bytes_accessed = cost.get("bytes accessed", float("nan"))
+  sec, _ = _step_time(jax, state, step, features, labels)
+  # TPU v5e: ~197 bf16 TFLOP/s peak, ~819 GB/s HBM.
+  peak_flops, peak_bw = 197e12, 819e9
+  print(f"batch={batch_size} step={sec * 1e3:.1f} ms  "
+        f"flops={flops / 1e12:.3f} TF  bytes={bytes_accessed / 1e9:.2f} GB")
+  print(f"compute bound={flops / peak_flops * 1e3:.1f} ms  "
+        f"memory bound={bytes_accessed / peak_bw * 1e3:.1f} ms  "
+        f"mxu util={flops / sec / peak_flops * 100:.1f}%  "
+        f"hbm util={bytes_accessed / sec / peak_bw * 100:.1f}%")
+
+
+def batch(batch_size):
+  jax, state, step, features, labels = _setup(batch_size)
+  sec, _ = _step_time(jax, state, step, features, labels)
+  print(f"batch={batch_size}: {sec * 1e3:.1f} ms/step = "
+        f"{batch_size / sec:.1f} examples/sec "
+        f"(vs_baseline {batch_size / sec / 400.0:.3f})")
+
+
+def profile(batch_size):
+  jax, state, step, features, labels = _setup(batch_size)
+  # warm up + compile outside the trace window
+  sec, state = _step_time(jax, state, step, features, labels, iters=5)
+  with jax.profiler.trace("profiles"):
+    for _ in range(5):
+      state, _ = step(state, features, labels)
+    _barrier(jax, state)
+  print(f"trace written to profiles/ (step ~{sec * 1e3:.1f} ms); view "
+        f"with: tensorboard --logdir profiles")
+
+
+def main():
+  if not backend.accelerator_healthy(timeout=90):
+    print("tunnel unhealthy; refusing to run (would hang)", flush=True)
+    sys.exit(2)
+  phase = sys.argv[1] if len(sys.argv) > 1 else "roofline"
+  if phase == "roofline":
+    roofline(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
+  elif phase == "batch":
+    batch(int(sys.argv[2]))
+  elif phase == "profile":
+    profile(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
+  else:
+    raise SystemExit(f"unknown phase {phase!r}")
+
+
+if __name__ == "__main__":
+  main()

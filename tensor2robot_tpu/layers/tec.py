@@ -84,15 +84,19 @@ class EmbedConditionImages(nn.Module):
         name="images_to_features")(images, train=train)
     if self.fc_layers is None:
       return x
+    # Hidden layers follow the reference's slim normalizer contract
+    # (tec.py:90-110 with normalizer_fn=layer_norm): dense -> layer norm
+    # -> relu, bias omitted because the norm's shift absorbs it. The
+    # final layer is linear with a bias and no norm.
     hidden, final = tuple(self.fc_layers[:-1]), self.fc_layers[-1]
     if x.ndim == 2:  # spatial softmax: [N, F] feature points
       for i, units in enumerate(hidden):
-        x = nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
-            nn.relu(nn.Dense(units, name=f"fc_{i}")(x)))
+        x = nn.relu(nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
+            nn.Dense(units, use_bias=False, name=f"fc_{i}")(x)))
       return nn.Dense(final, name="fc_out")(x)
     for i, units in enumerate(hidden):  # spatial: 1x1 convs
-      x = nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
-          nn.relu(nn.Conv(units, (1, 1), name=f"fc_{i}")(x)))
+      x = nn.relu(nn.LayerNorm(dtype=self.dtype, name=f"fc_ln_{i}")(
+          nn.Conv(units, (1, 1), use_bias=False, name=f"fc_{i}")(x)))
     return nn.Conv(final, (1, 1), name="fc_out")(x)
 
 

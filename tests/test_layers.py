@@ -273,6 +273,31 @@ class TestTEC:
     # BuildImagesToFeaturesModel call
     assert "images_to_features" in params
 
+  def test_embed_condition_images_fc_head_semantics(self):
+    """The fc head computes dense(no-bias) -> layer-norm -> relu ->
+    linear, verified by hand against the same conv-tower features
+    (reference slim normalizer ordering, tec.py:90-99)."""
+    fc = tec.EmbedConditionImages(fc_layers=(10, 4), filters=(8, 8, 8))
+    raw = tec.EmbedConditionImages(fc_layers=None, filters=(8, 8, 8))
+    images = jax.random.uniform(jax.random.PRNGKey(0), (3, 24, 24, 3))
+    variables = fc.init(jax.random.PRNGKey(1), images)
+    params = variables["params"]
+    points = raw.apply(
+        {"params": {"images_to_features": params["images_to_features"]}},
+        images)
+    h = np.asarray(points) @ np.asarray(params["fc_0"]["kernel"])
+    mean = h.mean(-1, keepdims=True)
+    var = h.var(-1, keepdims=True)
+    h = (h - mean) / np.sqrt(var + 1e-6)
+    h = h * np.asarray(params["fc_ln_0"]["scale"]) + np.asarray(
+        params["fc_ln_0"]["bias"])
+    h = np.maximum(h, 0.0)
+    expected = h @ np.asarray(params["fc_out"]["kernel"]) + np.asarray(
+        params["fc_out"]["bias"])
+    got = np.asarray(fc.apply(variables, images))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+    assert "bias" not in params["fc_0"]  # norm'd hidden layers drop bias
+
   def test_embed_condition_images_no_fc_passthrough(self):
     module = tec.EmbedConditionImages(fc_layers=None, filters=(8, 8, 8))
     images = jax.random.uniform(jax.random.PRNGKey(0), (3, 24, 24, 3))
