@@ -80,7 +80,9 @@ def roofline(batch_size=64):
   cost = cost[0] if isinstance(cost, (list, tuple)) else cost
   flops = cost.get("flops", float("nan"))
   bytes_accessed = cost.get("bytes accessed", float("nan"))
-  sec, _ = _step_time(jax, state, step, features, labels)
+  # Time the AOT executable itself — calling `step` would jit-compile the
+  # same computation a second time (~20-40 s over the tunnel).
+  sec, _ = _step_time(jax, state, compiled, features, labels)
   # TPU v5e: ~197 bf16 TFLOP/s peak, ~819 GB/s HBM.
   peak_flops, peak_bw = 197e12, 819e9
   print(f"batch={batch_size} step={sec * 1e3:.1f} ms  "

@@ -27,9 +27,10 @@ class MultiHeadAttention(nn.Module):
   head_dim: int = 32
   causal: bool = False
   dropout_rate: float = 0.0
-  backend: str = "reference"  # 'reference' | 'flash' | 'ring'
-  mesh: Optional[Mesh] = None  # required for 'ring'
+  backend: str = "reference"  # 'reference'|'flash'|'ring'|'ulysses'
+  mesh: Optional[Mesh] = None  # required for 'ring'/'ulysses'
   sp_axis: str = "sp"
+  ulysses_inner: str = "reference"  # per-device kernel under 'ulysses' 
 
   @nn.compact
   def __call__(self, x: jnp.ndarray,
@@ -54,6 +55,12 @@ class MultiHeadAttention(nn.Module):
         raise ValueError("ring backend requires a mesh.")
       out = attention_ops.ring_attention(
           q, k, v, self.mesh, axis_name=self.sp_axis, causal=self.causal)
+    elif self.backend == "ulysses":
+      if self.mesh is None:
+        raise ValueError("ulysses backend requires a mesh.")
+      out = attention_ops.ulysses_attention(
+          q, k, v, self.mesh, axis_name=self.sp_axis, causal=self.causal,
+          inner=self.ulysses_inner)
     else:
       out = attention_ops.attention(q, k, v, causal=self.causal)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, proj)

@@ -44,9 +44,10 @@ class _AttentionTrunk(nn.Module):
   hidden_size: int = 64
   num_blocks: int = 2
   num_heads: int = 4
-  backend: str = "reference"  # 'reference' | 'flash' | 'ring'
+  backend: str = "reference"  # 'reference'|'flash'|'ring'|'ulysses'
   mesh: Optional[Any] = None
   sp_axis: str = "sp"
+  ulysses_inner: str = "reference"  # per-device kernel under 'ulysses'
   dtype: Optional[Any] = None
 
   @nn.compact
@@ -62,6 +63,7 @@ class _AttentionTrunk(nn.Module):
       y = attention_layers.MultiHeadAttention(
           num_heads=self.num_heads, head_dim=head_dim, causal=True,
           backend=self.backend, mesh=self.mesh, sp_axis=self.sp_axis,
+          ulysses_inner=self.ulysses_inner,
           name=f"attn_{i}")(y, train=train)
       x = x + y
       y = nn.LayerNorm(dtype=self.dtype, name=f"ln_mlp_{i}")(x)
@@ -84,9 +86,10 @@ class SequenceRegressionModel(abstract_model.T2RModel):
                sequence_length: int = 32, hidden_size: int = 64,
                num_blocks: int = 2, num_heads: int = 4,
                attention_backend: str = "reference",
-               sp_axis: str = "sp", **kwargs):
+               sp_axis: str = "sp",
+               ulysses_inner: str = "reference", **kwargs):
     super().__init__(**kwargs)
-    if attention_backend not in ("reference", "flash", "ring"):
+    if attention_backend not in ("reference", "flash", "ring", "ulysses"):
       raise ValueError(f"Unknown attention_backend {attention_backend!r}")
     self._obs_size = obs_size
     self._action_size = action_size
@@ -96,31 +99,38 @@ class SequenceRegressionModel(abstract_model.T2RModel):
     self._num_heads = num_heads
     self._attention_backend = attention_backend
     self._sp_axis = sp_axis
+    self._ulysses_inner = ulysses_inner
     self._mesh = None
 
   def set_mesh(self, mesh) -> None:
     """Receives the training mesh (train_eval_model / test harness);
-    required before module build for the 'ring' backend."""
+    required before module build for the 'ring' and 'ulysses' backends."""
     if self._module is not None and self._mesh is not mesh:
       raise ValueError("set_mesh must be called before the module is "
                        "built (create_train_state / first forward).")
-    if mesh is not None and self._attention_backend == "ring":
+    if mesh is not None and self._attention_backend in ("ring", "ulysses"):
       sp = mesh.shape.get(self._sp_axis, 0)
       if not sp:
         raise ValueError(
-            f"attention_backend='ring' needs a {self._sp_axis!r} mesh "
-            f"axis; mesh has {dict(mesh.shape)}")
+            f"attention_backend={self._attention_backend!r} needs a "
+            f"{self._sp_axis!r} mesh axis; mesh has {dict(mesh.shape)}")
       if self._sequence_length % sp:
         raise ValueError(
             f"sequence_length {self._sequence_length} not divisible by "
             f"the {sp}-way {self._sp_axis!r} axis")
+      if self._attention_backend == "ulysses" and self._num_heads % sp:
+        raise ValueError(
+            f"num_heads {self._num_heads} not divisible by the {sp}-way "
+            f"{self._sp_axis!r} axis (Ulysses shards head groups)")
     self._mesh = mesh
 
   @property
   def batch_partition_spec(self):
     """Sequence batches are born ('data', 'sp')-sharded at infeed when
-    the ring backend is active (pass to make_train_step's batch_spec)."""
-    if self._attention_backend == "ring" and self._mesh is not None \
+    a sequence-parallel backend (ring/ulysses) is active (pass to
+    make_train_step's batch_spec)."""
+    if self._attention_backend in ("ring", "ulysses") \
+        and self._mesh is not None \
         and self._mesh.shape.get(self._sp_axis, 1) > 1:
       return jax.sharding.PartitionSpec("data", self._sp_axis)
     return None
@@ -141,13 +151,14 @@ class SequenceRegressionModel(abstract_model.T2RModel):
 
   def create_module(self):
     backend = self._attention_backend
-    if backend == "ring" and self._mesh is None:
-      raise ValueError("attention_backend='ring' requires set_mesh() "
-                       "before the module is built.")
+    if backend in ("ring", "ulysses") and self._mesh is None:
+      raise ValueError(f"attention_backend={backend!r} requires "
+                       "set_mesh() before the module is built.")
     return _AttentionTrunk(
         action_size=self._action_size, hidden_size=self._hidden_size,
         num_blocks=self._num_blocks, num_heads=self._num_heads,
         backend=backend, mesh=self._mesh, sp_axis=self._sp_axis,
+        ulysses_inner=self._ulysses_inner,
         dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):

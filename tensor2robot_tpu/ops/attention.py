@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
-__all__ = ["attention", "flash_attention", "ring_attention"]
+__all__ = ["attention", "flash_attention", "ring_attention",
+           "ulysses_attention"]
 
 
 def _mask_value(dtype) -> jnp.ndarray:
@@ -377,6 +378,86 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
   if t_pad != t:
     out = out[:, :t]
   return out.reshape(b, h, t, d)
+
+
+# -- Ulysses attention (all_to_all sequence parallelism) ---------------------
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      mesh: Mesh,
+                      axis_name: str = "sp",
+                      causal: bool = False,
+                      batch_axis: Optional[str] = "data",
+                      inner: str = "reference") -> jnp.ndarray:
+  """Exact attention with the sequence dim sharded via head all_to_all
+  (DeepSpeed-Ulysses style).
+
+  Inputs are global [B, H, T, D] arrays with T sharded over `axis_name`
+  (size S). all_to_alls re-shard q/k/v to [B, H/S, T, D] — each device
+  holds its head group over the FULL sequence — the inner attention runs
+  unchanged (including causal masking), and a transpose all_to_all
+  restores the output's sequence sharding. Communication is 4
+  activation-sized all_to_alls per forward (q, k, v inbound + output; 8
+  with the VJP) in a FIXED number of steps, vs the ring's S-1 sequential
+  K/V hops — at the cost of H % S == 0. The right trade when heads are
+  plentiful and per-hop ring latency would dominate.
+
+  `inner` selects the full-sequence kernel on each device: 'reference'
+  (XLA) or 'flash' (the Pallas kernel).
+  """
+  s = mesh.shape[axis_name]
+  b, h, t, d = q.shape
+  if h % s:
+    raise ValueError(f"num_heads={h} must be divisible by the "
+                     f"'{axis_name}' axis size {s} for Ulysses "
+                     f"(head-group all_to_all)")
+  if k.shape[2] != t:
+    raise ValueError("ulysses_attention assumes self-attention layout "
+                     f"(Tq={t} != Tk={k.shape[2]})")
+  if inner not in ("reference", "flash"):
+    raise ValueError(f"Unknown inner kernel {inner!r}")
+  io_spec = PartitionSpec(batch_axis, None, axis_name, None)
+
+  def local_fn(q_l, k_l, v_l):
+    # Shapes here are LOCAL: [B_l, H, T/S, D]. Both all_to_alls use the
+    # symmetric split_axis == concat_axis == 0 form with explicit
+    # transposes around them: the form with distinct split/concat axes
+    # produced a mis-ordered cotangent under autodiff whenever H/S > 1
+    # (dims swapped in the VJP), while the 0,0 form is self-transpose.
+    b_l, _, t_l, _ = q_l.shape
+
+    def seq_to_heads(x):
+      # [B_l,H,T_l,D] -> [S,B_l,H/S,T_l,D] -(a2a)-> src-major ->
+      # [B_l,H/S,T,D]; source order == sequence order, so the merge
+      # reassembles the global sequence.
+      x = x.reshape(b_l, s, h // s, t_l, d)
+      x = jnp.moveaxis(x, 1, 0)
+      x = jax.lax.all_to_all(x, axis_name, 0, 0)   # [S(src),B_l,H/S,T_l,D]
+      x = x.transpose(1, 2, 0, 3, 4)               # [B_l,H/S,S,T_l,D]
+      return x.reshape(b_l, h // s, s * t_l, d)
+
+    def heads_to_seq(x):
+      # inverse: [B_l,H/S,T,D] -> [S,B_l,H/S,T_l,D] -(a2a)->
+      # head-group-major -> [B_l,H,T_l,D]
+      x = x.reshape(b_l, h // s, s, t_l, d)
+      x = x.transpose(2, 0, 1, 3, 4)               # [S,B_l,H/S,T_l,D]
+      x = jax.lax.all_to_all(x, axis_name, 0, 0)   # [S(grp),B_l,H/S,T_l,D]
+      x = jnp.moveaxis(x, 0, 1)                    # [B_l,S,H/S,T_l,D]
+      return x.reshape(b_l, h, t_l, d)
+
+    q_g, k_g, v_g = seq_to_heads(q_l), seq_to_heads(k_l), seq_to_heads(v_l)
+    if inner == "flash":
+      out = flash_attention(q_g, k_g, v_g, causal=causal)
+    else:
+      out = attention(q_g, k_g, v_g, causal=causal)
+    return heads_to_seq(out)
+
+  sharded = jax.shard_map(
+      local_fn, mesh=mesh,
+      in_specs=(io_spec, io_spec, io_spec),
+      out_specs=io_spec,
+      check_vma=False)
+  return sharded(q, k, v)
 
 
 # -- ring attention (context parallelism) ------------------------------------
