@@ -71,12 +71,7 @@ def print_specification(model) -> None:
 
 
 def _device_batch(mesh, batch, batch_spec=None):
-  features = mesh_lib.put_host_batch(mesh, batch["features"],
-                                     batch_spec=batch_spec)
-  labels = (mesh_lib.put_host_batch(mesh, batch["labels"],
-                                    batch_spec=batch_spec)
-            if "labels" in batch else specs_lib.SpecStruct())
-  return features, labels
+  return mesh_lib.place_batch(mesh, batch, batch_spec=batch_spec)
 
 
 def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
@@ -128,6 +123,7 @@ def train_eval_model(
     continuous_eval_timeout_secs: Optional[float] = None,
     use_ema_for_eval: bool = True,
     log_every_n_steps: int = 100,
+    device_prefetch_depth: int = 2,
 ) -> dict:
   """Runs the requested mode; returns final metrics."""
   if mode not in ("train", "evaluate", "train_and_evaluate",
@@ -293,54 +289,72 @@ def train_eval_model(
                                   use_ema=use_ema_for_eval)
 
   step = int(state.step)
-  batch = first_batch
   last_log = time.time()
   last_eval_time = 0.0
-  while step < max_train_steps:
-    features, labels = _device_batch(mesh, batch, batch_spec)
-    state, metrics = train_step(state, features, labels)
-    step += 1
-    for hook in hooks:
-      hook.after_step(ctx, step, metrics)
-    if step % log_every_n_steps == 0 or step == max_train_steps:
-      scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
-      writer.write_scalars(step, scalars)
-      now = time.time()
-      logging.info("step %d: loss=%.5f (%.1f steps/s)", step,
-                   scalars.get("loss", float("nan")),
-                   log_every_n_steps / max(now - last_log, 1e-6))
-      last_log = now
-      final_metrics = scalars
-    if step % checkpoint_every_n_steps == 0:
-      _checkpoint(step)
-    if manager.reached_preemption(step):
-      logging.warning("Preemption signal at step %d: checkpoint + exit.",
-                      step)
-      _checkpoint(step, force=True)
-      manager.wait_until_finished()
-      raise SystemExit(42)
-    if eval_step is not None and (step % eval_every_n_steps == 0
-                                  or step == max_train_steps):
-      # Wall-clock throttle (reference eval_throttle default 600 s,
-      # /root/reference/utils/train_eval.py:428-431): skip step-triggered
-      # evals that come too soon after the previous one.
-      now = time.time()
-      throttled = (eval_throttle_secs and step != max_train_steps
-                   and now - last_eval_time < eval_throttle_secs)
-      if not throttled:
-        last_eval_time = now
-        eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
-        eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                 eval_steps, batch_spec)
-        writer.write_scalars(step, {f"eval/{k}": v
-                                    for k, v in eval_metrics.items()})
-        for hook in hooks:
-          hook.after_eval(ctx, step, eval_metrics)
-        logging.info("eval @%d: %s", step, eval_metrics)
-        final_metrics.update(
-            {f"eval/{k}": v for k, v in eval_metrics.items()})
-    if step < max_train_steps:
-      batch = next(train_dataset)
+  # Background device infeed: keeps `device_prefetch_depth` batches
+  # already parsed AND placed on device so the loop thread never
+  # serializes host work between dispatches (0 disables). Skipped when
+  # resuming past max_train_steps (zero loop iterations).
+  prefetcher = None
+  if device_prefetch_depth and step < max_train_steps:
+    prefetcher = mesh_lib.DevicePrefetcher(
+        train_dataset, mesh, batch_spec=batch_spec,
+        depth=device_prefetch_depth)
+  if step < max_train_steps:
+    placed = _device_batch(mesh, first_batch, batch_spec)
+  try:
+    while step < max_train_steps:
+      features, labels = placed
+      state, metrics = train_step(state, features, labels)
+      step += 1
+      for hook in hooks:
+        hook.after_step(ctx, step, metrics)
+      if step % log_every_n_steps == 0 or step == max_train_steps:
+        scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        writer.write_scalars(step, scalars)
+        now = time.time()
+        logging.info("step %d: loss=%.5f (%.1f steps/s)", step,
+                     scalars.get("loss", float("nan")),
+                     log_every_n_steps / max(now - last_log, 1e-6))
+        last_log = now
+        final_metrics = scalars
+      if step % checkpoint_every_n_steps == 0:
+        _checkpoint(step)
+      if manager.reached_preemption(step):
+        logging.warning("Preemption signal at step %d: checkpoint + exit.",
+                        step)
+        _checkpoint(step, force=True)
+        manager.wait_until_finished()
+        raise SystemExit(42)
+      if eval_step is not None and (step % eval_every_n_steps == 0
+                                    or step == max_train_steps):
+        # Wall-clock throttle (reference eval_throttle default 600 s,
+        # /root/reference/utils/train_eval.py:428-431): skip step-triggered
+        # evals that come too soon after the previous one.
+        now = time.time()
+        throttled = (eval_throttle_secs and step != max_train_steps
+                     and now - last_eval_time < eval_throttle_secs)
+        if not throttled:
+          last_eval_time = now
+          eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+          eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                                   eval_steps, batch_spec)
+          writer.write_scalars(step, {f"eval/{k}": v
+                                      for k, v in eval_metrics.items()})
+          for hook in hooks:
+            hook.after_eval(ctx, step, eval_metrics)
+          logging.info("eval @%d: %s", step, eval_metrics)
+          final_metrics.update(
+              {f"eval/{k}": v for k, v in eval_metrics.items()})
+      if step < max_train_steps:
+        placed = (next(prefetcher) if prefetcher is not None
+                  else _device_batch(mesh, next(train_dataset), batch_spec))
+  finally:
+    # Runs on SystemExit(42) preemption and any step/hook/eval failure
+    # too: a daemon worker killed at interpreter shutdown mid device_put
+    # is a killed TPU client (the documented tunnel-wedging hazard).
+    if prefetcher is not None:
+      prefetcher.close()
 
   _checkpoint(step, force=True)
   for hook in hooks:

@@ -171,6 +171,24 @@ class TestTrainEval:
         log_every_n_steps=20)
     assert "eval/accuracy" in metrics
 
+  def test_device_prefetch_matches_unprefetched_run(self, tmp_path):
+    """The background device infeed must not change training: same
+    deterministic data stream, same final loss, with and without."""
+    finals = {}
+    for depth in (0, 3):
+      model_dir = str(tmp_path / f"m{depth}")
+      metrics = train_eval.train_eval_model(
+          model=self._model(),
+          model_dir=model_dir,
+          mode="train",
+          max_train_steps=50,
+          checkpoint_every_n_steps=50,
+          input_generator_train=mocks.MockInputGenerator(batch_size=16),
+          device_prefetch_depth=depth,
+          log_every_n_steps=10)
+      finals[depth] = metrics["loss"]
+    assert finals[0] == pytest.approx(finals[3], abs=1e-12), finals
+
   def test_unknown_mode_raises(self, tmp_path):
     with pytest.raises(ValueError, match="Unknown train_eval mode"):
       train_eval.train_eval_model(

@@ -60,6 +60,74 @@ class TestMeshConstruction:
     assert shard_shapes == {(2, 3)}
 
 
+class TestDevicePrefetcher:
+
+  def _batches(self, n):
+    for i in range(n):
+      yield {"features": specs_lib.SpecStruct(
+          {"x": np.full((8, 2), float(i), np.float32)}),
+             "labels": specs_lib.SpecStruct(
+          {"y": np.full((8, 1), float(i), np.float32)})}
+
+  def test_preserves_order_and_placement(self, dp_mesh):
+    pf = mesh_lib.DevicePrefetcher(self._batches(5), dp_mesh, depth=2)
+    seen = []
+    for features, labels in pf:
+      assert features["x"].sharding.spec == PartitionSpec("data")
+      seen.append(float(np.asarray(features["x"])[0, 0]))
+      assert float(np.asarray(labels["y"])[0, 0]) == seen[-1]
+    assert seen == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+  def test_worker_exception_reraises_in_consumer(self, dp_mesh):
+    def bad():
+      yield {"features": specs_lib.SpecStruct(
+          {"x": np.zeros((8, 2), np.float32)})}
+      raise RuntimeError("pipeline broke")
+
+    pf = mesh_lib.DevicePrefetcher(bad(), dp_mesh, depth=1)
+    next(pf)  # first batch ok
+    with pytest.raises(RuntimeError, match="pipeline broke"):
+      next(pf)
+
+  def test_close_stops_worker(self, dp_mesh):
+    import itertools
+    import time
+
+    pulled = [0]
+
+    def infinite():
+      for i in itertools.count():
+        pulled[0] = i
+        yield {"features": specs_lib.SpecStruct(
+            {"x": np.zeros((8, 2), np.float32)})}
+
+    pf = mesh_lib.DevicePrefetcher(infinite(), dp_mesh, depth=1)
+    next(pf)
+    pf.close()
+    time.sleep(0.3)
+    stopped_at = pulled[0]
+    time.sleep(0.3)
+    assert pulled[0] <= stopped_at + 1  # worker stopped pulling
+
+  def test_depth_validation(self, dp_mesh):
+    with pytest.raises(ValueError, match="depth"):
+      mesh_lib.DevicePrefetcher(iter(()), dp_mesh, depth=0)
+
+  def test_exhausted_keeps_raising_stopiteration(self, dp_mesh):
+    pf = mesh_lib.DevicePrefetcher(self._batches(2), dp_mesh, depth=1)
+    assert len(list(pf)) == 2
+    with pytest.raises(StopIteration):  # iterator protocol: stays done
+      next(pf)
+    pf.close()  # idempotent after exhaustion
+
+  def test_next_after_close_raises_stopiteration(self, dp_mesh):
+    pf = mesh_lib.DevicePrefetcher(self._batches(5), dp_mesh, depth=1)
+    next(pf)
+    pf.close()
+    with pytest.raises(StopIteration):
+      next(pf)
+
+
 class TestTrainStep:
 
   def _setup(self, mesh, use_ema=False, use_bfloat16=False, rules=None,
