@@ -169,12 +169,18 @@ class DevicePrefetcher:
   _STOP = object()
 
   def __init__(self, dataset, mesh: Mesh, batch_spec=None,
-               depth: int = 2):
+               depth: int = 2, max_batches: Optional[int] = None):
+    import itertools
     import queue
     import threading
 
     if depth < 1:
       raise ValueError(f"depth must be >= 1, got {depth}")
+    if max_batches is not None:
+      # Bound the worker to what the consumer will actually take —
+      # otherwise it eagerly parses + device-places `depth` extra batches
+      # past the end of a bounded loop, pure waste discarded by close().
+      dataset = itertools.islice(dataset, max_batches)
     self._queue = queue.Queue(maxsize=depth)
     self._stop = threading.Event()
     self._done = False

@@ -75,7 +75,7 @@ def _device_batch(mesh, batch, batch_spec=None):
 
 
 def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
-              batch_spec=None):
+              batch_spec=None, prefetch_depth: int = 2):
   """Runs eval_steps batches, averaging metric scalars.
 
   Accumulation stays ON DEVICE (async dispatch): a per-batch host
@@ -85,16 +85,25 @@ def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
   """
   totals: dict = {}
   count = 0
-  for _ in range(eval_steps):
-    try:
-      batch = next(dataset)
-    except StopIteration:
-      break
-    features, labels = _device_batch(mesh, batch, batch_spec)
-    metrics = eval_step(state, features, labels)
-    for key, value in metrics.items():
-      totals[key] = (totals[key] + value) if key in totals else value
-    count += 1
+  if prefetch_depth:
+    batches = mesh_lib.DevicePrefetcher(
+        dataset, mesh, batch_spec=batch_spec, depth=prefetch_depth,
+        max_batches=eval_steps)
+  else:
+    batches = (_device_batch(mesh, b, batch_spec) for b in dataset)
+  try:
+    for _ in range(eval_steps):
+      try:
+        features, labels = next(batches)
+      except StopIteration:
+        break
+      metrics = eval_step(state, features, labels)
+      for key, value in metrics.items():
+        totals[key] = (totals[key] + value) if key in totals else value
+      count += 1
+  finally:
+    if prefetch_depth:
+      batches.close()
   return {k: float(np.asarray(v)) / max(count, 1)
           for k, v in totals.items()}
 
@@ -296,13 +305,15 @@ def train_eval_model(
   # serializes host work between dispatches (0 disables). Skipped when
   # resuming past max_train_steps (zero loop iterations).
   prefetcher = None
-  if device_prefetch_depth and step < max_train_steps:
-    prefetcher = mesh_lib.DevicePrefetcher(
-        train_dataset, mesh, batch_spec=batch_spec,
-        depth=device_prefetch_depth)
-  if step < max_train_steps:
-    placed = _device_batch(mesh, first_batch, batch_spec)
   try:
+    if step < max_train_steps:
+      # First placement BEFORE the worker starts: if it raises there is
+      # no thread to leak; everything after is covered by the finally.
+      placed = _device_batch(mesh, first_batch, batch_spec)
+      if device_prefetch_depth:
+        prefetcher = mesh_lib.DevicePrefetcher(
+            train_dataset, mesh, batch_spec=batch_spec,
+            depth=device_prefetch_depth)
     while step < max_train_steps:
       features, labels = placed
       state, metrics = train_step(state, features, labels)
@@ -338,7 +349,8 @@ def train_eval_model(
           last_eval_time = now
           eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
           eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                   eval_steps, batch_spec)
+                                   eval_steps, batch_spec,
+                                   prefetch_depth=device_prefetch_depth)
           writer.write_scalars(step, {f"eval/{k}": v
                                       for k, v in eval_metrics.items()})
           for hook in hooks:
