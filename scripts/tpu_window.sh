@@ -1,0 +1,39 @@
+#!/bin/bash
+# Healthy-tunnel capture plan: run EVERYTHING we want from a TPU window,
+# each item a separate short process (tunnel compiles are 20-40 s; a
+# SIGTERM'd long process wedges the tunnel — PERFORMANCE.md incidents).
+# NO shell `timeout` wrappers anywhere. Items probe health themselves
+# and exit 2 when the tunnel is down, so a mid-run wedge stops cleanly.
+#
+# Usage: bash scripts/tpu_window.sh [results_file]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tpu_window_results.txt}"
+
+run() {
+  echo "=== $* ===" | tee -a "$OUT"
+  "$@" 2>&1 | grep -v -E "^WARNING|^I0|^W0|^E0" | tee -a "$OUT"
+  rc=${PIPESTATUS[0]}
+  if [ "$rc" -eq 2 ]; then
+    echo "TUNNEL DOWN — stopping the window plan" | tee -a "$OUT"
+    exit 2
+  fi
+  echo >> "$OUT"
+}
+
+date | tee -a "$OUT"
+# 1. The headline number first — never risk losing it to a later wedge.
+run python bench.py
+# 2. Flash kernels on real hardware (round-1 weakness #2 close-out).
+run python scripts/tpu_flash_validate.py correctness
+run python scripts/tpu_flash_validate.py time 1024
+run python scripts/tpu_flash_validate.py time 4096
+run python scripts/tpu_flash_validate.py time 16384
+# 3. Roofline after the bf16 fix + batch scaling.
+run python scripts/tpu_step_tuning.py roofline
+run python scripts/tpu_step_tuning.py batch 32
+run python scripts/tpu_step_tuning.py batch 128
+# 4. Profiler trace last (largest artifact, least critical).
+run python scripts/tpu_step_tuning.py profile
+date | tee -a "$OUT"
+echo "window complete: results in $OUT"
