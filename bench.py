@@ -37,6 +37,17 @@ BATCH_SIZE = 64
 IMAGE_SIZE = 472
 WARMUP_STEPS = 3
 MEASURE_STEPS = 50
+# Peak dense bf16 FLOP/s per chip for the MFU denominator. v5e public
+# spec: 197 TFLOP/s bf16. Unknown kinds fall back to the v5e figure
+# (this project's only real device) — device_kind lands in the JSON so
+# a mismatch is visible.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v6 lite": 918e12,
+    "default": 197e12,
+}
 
 
 def main() -> None:
@@ -62,7 +73,8 @@ def main() -> None:
                           "vertical_rotation": (3, 2)} if on_tpu else None),
       use_bfloat16=on_tpu, use_ema=True)
 
-  def measure(batch_size: int) -> float:
+  def measure(batch_size: int):
+    """Returns (examples/sec, flops/step, bytes/step) for the train step."""
     features = specs_lib.make_random_numpy(
         model.preprocessor.get_out_feature_specification(modes.TRAIN),
         batch_size=batch_size, seed=0)
@@ -72,7 +84,28 @@ def main() -> None:
     features = jax.device_put(features, device)
     labels = jax.device_put(labels, device)
     state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+    # AOT-compile once: the executable is both the timed step and the
+    # source of the XLA cost analysis (flops + bytes per step) — no
+    # second trace/compile over the tunnel. The bench must emit its
+    # number even when the backend lacks AOT/cost support, so both are
+    # best-effort with the plain jitted step as fallback.
+    flops = bytes_accessed = float("nan")
     step = ts.make_train_step(model)
+    try:
+      step = step.lower(state, features, labels).compile()
+      cost = step.cost_analysis()
+      cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+      flops = float(cost.get("flops", float("nan")))
+      bytes_accessed = float(cost.get("bytes accessed", float("nan")))
+    except Exception as e:  # noqa: BLE001 - efficiency fields are optional
+      import sys
+
+      # If .lower()/.compile() itself failed, `step` is still the plain
+      # jitted fn; if only cost_analysis failed, it is the (callable)
+      # AOT executable. Either way the timing loop below works.
+      print(f"bench: AOT cost analysis unavailable "
+            f"({type(e).__name__}: {e}); efficiency fields will be null",
+            file=sys.stderr)
     # backend_lib.sync (a host fetch) is the completion barrier:
     # block_until_ready returns early over the axon tunnel (backend.py).
     # The barrier leaf is a param (not the loss): the loss does not depend
@@ -88,7 +121,8 @@ def main() -> None:
     for _ in range(measure_steps):
       state, _ = step(state, features, labels)
     barrier(state)
-    return measure_steps * batch_size / (time.perf_counter() - start)
+    return (measure_steps * batch_size / (time.perf_counter() - start),
+            flops, bytes_accessed)
 
   # The bench must emit a number even if the reference-scale config does
   # not fit a particular chip's HBM: halve the batch on RESOURCE_EXHAUSTED
@@ -97,7 +131,7 @@ def main() -> None:
   def measure_with_oom_fallback(batch_size):
     while True:
       try:
-        return measure(batch_size), batch_size
+        return measure(batch_size) + (batch_size,)
       except Exception as e:  # noqa: BLE001 - retry only on OOM
         if "RESOURCE_EXHAUSTED" not in str(e) or batch_size <= 4:
           raise
@@ -107,22 +141,31 @@ def main() -> None:
               f"{batch_size // 2}", file=sys.stderr)
         batch_size //= 2
 
-  examples_per_sec, batch_size = measure_with_oom_fallback(
-      BATCH_SIZE if on_tpu else 16)
+  examples_per_sec, flops, bytes_accessed, batch_size = (
+      measure_with_oom_fallback(BATCH_SIZE if on_tpu else 16))
+  value_batch64 = examples_per_sec if batch_size == BATCH_SIZE else None
   if on_tpu and batch_size == BATCH_SIZE:
     # The step is HBM-bandwidth-bound (PERFORMANCE.md roofline) and the
     # optimizer/EMA traffic is per-STEP: a larger batch amortizes it per
     # example. Try 2x ONCE (no halving loop — 64 is already measured)
     # and keep the better throughput; the batch used lands in the JSON.
     try:
-      bigger = measure(2 * BATCH_SIZE)
+      bigger, flops2, bytes2 = measure(2 * BATCH_SIZE)
       if bigger > examples_per_sec:
         examples_per_sec, batch_size = bigger, 2 * BATCH_SIZE
+        flops, bytes_accessed = flops2, bytes2
     except Exception as e:  # noqa: BLE001 - the batch-64 number stands
       import sys
 
       print(f"bench: 2x-batch probe failed ({type(e).__name__}: {e}); "
             f"keeping batch {BATCH_SIZE}", file=sys.stderr)
+  # Efficiency accounting: achieved model FLOP/s over the device peak
+  # (MFU a.k.a. MXU utilization) and HBM bytes per step, both from the
+  # compiled executable's own XLA cost analysis — so the driver record
+  # tracks efficiency, not just throughput.
+  step_sec = batch_size / examples_per_sec
+  peak = PEAK_BF16_FLOPS.get(device.device_kind, PEAK_BF16_FLOPS["default"])
+  mfu = (flops / step_sec / peak) if np.isfinite(flops) else None
   if on_tpu:
     print(json.dumps({
         "metric": "qtopt_grasps_per_sec_per_chip",
@@ -130,9 +173,16 @@ def main() -> None:
         "unit": "examples/sec",
         "vs_baseline": round(examples_per_sec / BASELINE_PER_CHIP, 3),
         # < BATCH_SIZE: OOM degradation (the reference-scale batch did
-        # not fit); > BATCH_SIZE: the 2x probe won. Either way the number
-        # is only comparable across rounds at equal batch_size.
+        # not fit); > BATCH_SIZE: the 2x probe won. value_batch64 keeps
+        # the fixed-batch number for round-over-round comparison.
         "batch_size": batch_size,
+        "value_batch64": (round(value_batch64, 2)
+                          if value_batch64 is not None else None),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": flops if np.isfinite(flops) else None,
+        "bytes_per_step": (bytes_accessed
+                           if np.isfinite(bytes_accessed) else None),
+        "device_kind": device.device_kind,
     }))
   else:
     # Honest labeling: the CPU smoke config (smaller image/batch) is not

@@ -144,10 +144,17 @@ class DefaultExportGenerator(AbstractExportGenerator):
     feature_spec = self._serving_feature_spec()
     label_spec = specs_lib.flatten_spec_structure(
         model.get_label_specification(modes_lib.PREDICT))
+    assets = specs_lib.Assets(feature_spec=feature_spec,
+                              label_spec=label_spec, global_step=step)
     specs_lib.write_assets(
-        specs_lib.Assets(feature_spec=feature_spec, label_spec=label_spec,
-                         global_step=step),
-        os.path.join(path, specs_lib.ASSET_FILENAME))
+        assets, os.path.join(path, specs_lib.ASSET_FILENAME))
+    # Reference-era robot stacks read `assets.extra/t2r_assets.pbtxt`
+    # (text-format T2RAssets, /root/reference/predictors/
+    # exported_savedmodel_predictor.py:176-241) — write it alongside the
+    # JSON so existing deployments can load this bundle unchanged.
+    specs_lib.write_assets_pbtxt(
+        assets,
+        os.path.join(path, "assets.extra", specs_lib.PBTXT_ASSET_FILENAME))
 
     # Eval-time variables: EMA shadow when enabled (swapping saver).
     variables = {"params": state.eval_params(use_ema=True),
@@ -177,8 +184,13 @@ class DefaultExportGenerator(AbstractExportGenerator):
       # Defense in depth: set_specification_from_model already failed
       # fast at job start; re-check in case the model was swapped.
       self._check_saved_model_compat(model)
-      self._export_saved_model(model, state, feature_spec,
-                               os.path.join(path, SAVED_MODEL_DIRNAME))
+      saved_model_dir = os.path.join(path, SAVED_MODEL_DIRNAME)
+      self._export_saved_model(model, state, feature_spec, saved_model_dir)
+      # The reference predictor resolves assets relative to the
+      # SavedModel dir itself — mirror the sidecar there too.
+      specs_lib.write_assets_pbtxt(
+          assets, os.path.join(saved_model_dir, "assets.extra",
+                               specs_lib.PBTXT_ASSET_FILENAME))
     return path
 
   def set_specification_from_model(self, model) -> None:

@@ -156,8 +156,10 @@ class ExportHook(Hook):
   CheckpointExportListener + LaggedCheckpointListener,
   /root/reference/hooks/checkpoint_hooks.py:51-201; TD3 target networks
   read the lagged dir). With `async_export=True` the export runs on a
-  background thread so the train loop never stalls (the reference's
-  AsyncCheckpointSaverHook listener behavior)."""
+  background thread and `after_checkpoint` NEVER blocks on an in-flight
+  export: the newest snapshot goes into a latest-wins pending slot the
+  worker drains, so a slow filesystem delays exports but not training
+  (the reference's AsyncCheckpointSaverHook listener behavior)."""
 
   def __init__(self,
                export_generator=None,
@@ -165,12 +167,17 @@ class ExportHook(Hook):
                num_versions: int = 3,
                lagged_export_dir_name: Optional[str] = None,
                async_export: bool = False):
+    import threading
+
     self._export_generator = export_generator
     self._export_dir_name = export_dir_name
     self._num_versions = num_versions
     self._lagged_dir_name = lagged_export_dir_name
     self._async = async_export
     self._worker = None
+    self._lock = threading.Lock()
+    self._pending = None
+    self._worker_running = False
 
   def begin(self, ctx: TrainContext) -> None:
     if self._export_generator is not None:
@@ -182,14 +189,55 @@ class ExportHook(Hook):
     if self._async:
       import threading
 
-      if self._worker is not None and self._worker.is_alive():
-        self._worker.join()  # one in-flight export at a time
       state = jax.device_get(ctx.get_state())
-      self._worker = threading.Thread(
-          target=self._do_export, args=(ctx, step, state), daemon=True)
-      self._worker.start()
+      with self._lock:
+        # Latest wins: if an export is in flight, replace any queued
+        # snapshot instead of blocking the train loop behind a join().
+        self._pending = (ctx, step, state)
+        if not self._worker_running:
+          self._worker_running = True
+          self._worker = threading.Thread(target=self._drain, daemon=True)
+          try:
+            self._worker.start()
+          except Exception:
+            self._worker_running = False  # recoverable at next checkpoint
+            raise
       return None
     return self._do_export(ctx, step, ctx.get_state())
+
+  def _drain(self) -> None:
+    import threading
+
+    try:
+      while True:
+        with self._lock:
+          item = self._pending
+          self._pending = None
+          if item is None:
+            # Clearing the running flag and observing an empty slot happen
+            # under one lock, so a concurrent after_checkpoint either hands
+            # this worker its snapshot or starts a fresh worker — never
+            # strands a pending export.
+            self._worker_running = False
+            return
+        ctx, step, state = item
+        try:
+          self._do_export(ctx, step, state)
+        except Exception:  # noqa: BLE001 - keep draining newer snapshots
+          from absl import logging
+
+          logging.exception("ExportHook: async export at step %d failed",
+                            step)
+    finally:
+      # A BaseException (SystemExit/KeyboardInterrupt in _do_export)
+      # escapes the loop above with the running flag still set; clear it
+      # so later checkpoints can start a fresh worker instead of
+      # enqueueing snapshots nothing will ever drain. Guarded so a
+      # clean-exited worker cannot stomp a successor's flag.
+      with self._lock:
+        if (self._worker is threading.current_thread()
+            and self._worker_running):
+          self._worker_running = False
 
   def _do_export(self, ctx: TrainContext, step: int, state) -> Optional[str]:
     base = os.path.join(ctx.model_dir, self._export_dir_name)

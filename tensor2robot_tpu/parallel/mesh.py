@@ -164,6 +164,10 @@ class DevicePrefetcher:
   Iterating yields (features, labels) pairs already placed with
   `put_host_batch`. Exceptions in the worker re-raise in the consumer;
   `close()` (also called on exhaustion) stops the worker promptly.
+  `close()` is MANDATORY for library users — an abandoned prefetcher
+  pins `depth` device-resident batches until its finalizer runs. The
+  context-manager protocol closes on exit; a `weakref.finalize` backstop
+  stops the worker of a collected-but-unclosed instance.
   """
 
   _STOP = object()
@@ -173,6 +177,7 @@ class DevicePrefetcher:
     import itertools
     import queue
     import threading
+    import weakref
 
     if depth < 1:
       raise ValueError(f"depth must be >= 1, got {depth}")
@@ -181,40 +186,65 @@ class DevicePrefetcher:
       # otherwise it eagerly parses + device-places `depth` extra batches
       # past the end of a bounded loop, pure waste discarded by close().
       dataset = itertools.islice(dataset, max_batches)
-    self._queue = queue.Queue(maxsize=depth)
-    self._stop = threading.Event()
+    out_queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    # Worker phase, readable by close(): "source" while blocked in
+    # next(dataset), "transfer" during place_batch (an in-flight TPU
+    # op — NEVER safe to abandon over the axon tunnel), "queue"/"done"
+    # otherwise. A plain one-slot list: writes are atomic under the GIL.
+    phase = ["source"]
+    self._queue = out_queue
+    self._stop = stop
+    self._phase = phase
     self._done = False
+    sentinel = self._STOP
+
+    # The worker closes over locals only — never `self` — so an
+    # abandoned-without-close() prefetcher is actually collectable (the
+    # live thread would otherwise keep `self` reachable forever and the
+    # finalizer below could never fire).
+    def _put_final(item):
+      while not stop.is_set():
+        try:
+          out_queue.put(item, timeout=0.1)
+          return
+        except queue.Full:
+          continue
 
     def _worker():
       try:
         for batch in dataset:
+          if stop.is_set():
+            # Checked between next(dataset) and place_batch so a stop
+            # requested while the source was producing skips the device
+            # transfer and exits without touching the queue.
+            return
+          phase[0] = "transfer"
           features, labels = place_batch(mesh, batch,
                                          batch_spec=batch_spec)
-          while not self._stop.is_set():
+          phase[0] = "queue"
+          while not stop.is_set():
             try:
-              self._queue.put((features, labels), timeout=0.1)
+              out_queue.put((features, labels), timeout=0.1)
               break
             except queue.Full:
               continue
-          if self._stop.is_set():
+          if stop.is_set():
             return
-        self._put_final(self._STOP)
+          phase[0] = "source"
+        _put_final(sentinel)
       except BaseException as e:  # noqa: BLE001 - surfaced to consumer
-        self._put_final(e)
+        _put_final(e)
+      finally:
+        phase[0] = "done"
 
     self._thread = threading.Thread(target=_worker, daemon=True,
                                     name="device-prefetch")
     self._thread.start()
-
-  def _put_final(self, item):
-    import queue
-
-    while not self._stop.is_set():
-      try:
-        self._queue.put(item, timeout=0.1)
-        return
-      except queue.Full:
-        continue
+    # Backstop for abandoned instances: stop (but never join, which is
+    # illegal from a GC callback) the worker so it cannot spin at 10 Hz
+    # holding device batches forever. close() remains the correct path.
+    self._finalizer = weakref.finalize(self, stop.set)
 
   def __iter__(self):
     return self
@@ -231,18 +261,51 @@ class DevicePrefetcher:
       raise item
     return item
 
-  def close(self):
+  def __enter__(self):
+    return self
+
+  def __exit__(self, exc_type, exc_value, traceback):
+    self.close()
+    return False
+
+  def close(self, timeout: float = 60.0):
     """Stops the worker and WAITS for it to finish its in-flight batch.
 
     The join matters on the axon tunnel: a daemon thread killed at
     interpreter shutdown mid device_put is a killed TPU client — the
     documented tunnel-wedging hazard (CLAUDE.md). The worker checks the
-    stop event at least every 0.1 s, so the join is bounded by one
-    in-flight put_host_batch.
+    stop event at least every 0.1 s, so the join is normally bounded by
+    one in-flight put_host_batch. The `timeout` applies ONLY while the
+    worker is blocked inside next(dataset) on a stalled data source
+    (which never sees the stop event): close() then returns, logging
+    loudly, rather than hang — which matters on the preemption
+    save-and-exit path where a timely SystemExit beats a clean thread
+    shutdown. While the worker is mid device transfer ("transfer"
+    phase), close() keeps waiting regardless of `timeout` — abandoning a
+    thread with an in-flight TPU op is the wedging hazard itself.
     """
     self._done = True
     self._stop.set()
-    self._thread.join()
+    deadline = None
+    while True:
+      self._thread.join(timeout=1.0)
+      if not self._thread.is_alive():
+        return
+      if self._phase[0] == "transfer":
+        deadline = None  # device op in flight: wait it out, full stop
+        continue
+      import time
+
+      if deadline is None:
+        deadline = time.monotonic() + timeout
+      elif time.monotonic() >= deadline:
+        break
+    from absl import logging
+
+    logging.error(
+        "DevicePrefetcher.close(): worker still alive after %.0fs in "
+        "phase %r — blocked in next(dataset) on a stalled data source; "
+        "abandoning the daemon thread.", timeout, self._phase[0])
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

@@ -186,7 +186,13 @@ def _valid_export_dirs(export_root: str) -> List[str]:
     name = os.path.basename(path)
     if not name.isdigit():
       continue
-    if (os.path.isfile(os.path.join(path, specs_lib.ASSET_FILENAME))
+    has_assets = (
+        os.path.isfile(os.path.join(path, specs_lib.ASSET_FILENAME))
+        # Reference-era bundles carry only the text-proto sidecar
+        # (load_assets transparently falls back to it).
+        or os.path.isfile(os.path.join(path, "assets.extra",
+                                       specs_lib.PBTXT_ASSET_FILENAME)))
+    if (has_assets
         and os.path.isfile(os.path.join(path, export_lib.SIGNATURE_FILENAME))
         and os.path.isdir(os.path.join(path, export_lib.PARAMS_DIRNAME))):
       out.append(path)

@@ -37,14 +37,34 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
     self._assets: Optional[specs_lib.Assets] = None
     self._input_keys = None
 
+  @staticmethod
+  def _saved_model_root(path: str) -> Optional[str]:
+    """Where the SavedModel lives for a timestamped dir, if anywhere:
+    `<dir>/saved_model/` (native bundle) or `<dir>/` itself holding
+    saved_model.pb (a reference-era export,
+    /root/reference/predictors/exported_savedmodel_predictor.py:176)."""
+    nested = os.path.join(path, export_lib.SAVED_MODEL_DIRNAME)
+    if os.path.isdir(nested):
+      return nested
+    if os.path.isfile(os.path.join(path, "saved_model.pb")):
+      return path
+    return None
+
   def restore(self) -> bool:
+    import glob
     import time
 
     deadline = time.time() + self._timeout_secs
     while True:
+      # Native bundles pass _valid_export_dirs; reference-era dirs are
+      # bare SavedModels with a pbtxt sidecar and no signature.json.
       dirs = [p for p in predictors_lib._valid_export_dirs(self._export_dir)
-              if os.path.isdir(os.path.join(
-                  p, export_lib.SAVED_MODEL_DIRNAME))]
+              if self._saved_model_root(p)]
+      if not dirs:
+        dirs = [p for p in sorted(glob.glob(
+                    os.path.join(self._export_dir, "*")))
+                if os.path.basename(p).isdigit()
+                and os.path.isfile(os.path.join(p, "saved_model.pb"))]
       if dirs:
         break
       if time.time() >= deadline:
@@ -53,8 +73,7 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
     newest = dirs[-1]
     import tensorflow as tf
 
-    self._module = tf.saved_model.load(
-        os.path.join(newest, export_lib.SAVED_MODEL_DIRNAME))
+    self._module = tf.saved_model.load(self._saved_model_root(newest))
     self._assets = specs_lib.load_assets(
         os.path.join(newest, specs_lib.ASSET_FILENAME))
     spec = specs_lib.filter_required(self._assets.feature_spec)
@@ -76,7 +95,19 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
     import tensorflow as tf
 
     flat = specs_lib.flatten_spec_structure(dict(features))
-    args = [tf.convert_to_tensor(np.asarray(flat[k]))
-            for k in self._input_keys]
-    outputs = self._module.fn(*args)
+    if hasattr(self._module, "fn"):  # native jax2tf export
+      args = [tf.convert_to_tensor(np.asarray(flat[k]))
+              for k in self._input_keys]
+      outputs = self._module.fn(*args)
+    else:
+      # Reference-era SavedModel: call the serving signature with
+      # keyword tensors named by the feature specs (the reference's
+      # receiver feed names, exported_savedmodel_predictor.py:260-282).
+      signature = self._module.signatures["serving_default"]
+      kwargs = {}
+      for key in self._input_keys:
+        spec = self._assets.feature_spec[key]
+        name = spec.name or key.rsplit("/", 1)[-1]
+        kwargs[name] = tf.convert_to_tensor(np.asarray(flat[key]))
+      outputs = signature(**kwargs)
     return {k: np.asarray(v) for k, v in outputs.items()}

@@ -36,7 +36,7 @@ import dataclasses
 import json
 import re
 from collections import OrderedDict
-from typing import Any, Callable, Iterator, Mapping, MutableMapping, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Iterator, Mapping, MutableMapping, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -66,6 +66,9 @@ __all__ = [
     "Assets",
     "write_assets",
     "load_assets",
+    "assets_to_pbtxt",
+    "assets_from_pbtxt",
+    "write_assets_pbtxt",
 ]
 
 ShapeLike = Sequence[Optional[int]]
@@ -829,5 +832,210 @@ def write_assets(assets: Assets, path: str) -> None:
 
 
 def load_assets(path: str) -> Assets:
+  """Loads an asset sidecar: JSON (native) or pbtxt (reference format).
+
+  Dispatches on extension; if the named file is absent but the sibling
+  with the other extension exists, loads that instead — so a predictor
+  pointed at either a reference-era or a native export dir works.
+  """
+  import os
+
+  if not os.path.isfile(path):
+    base, ext = os.path.splitext(path)
+    sibling = base + (".json" if ext == ".pbtxt" else ".pbtxt")
+    for candidate in (sibling,
+                      os.path.join(os.path.dirname(path), "assets.extra",
+                                   PBTXT_ASSET_FILENAME)):
+      if os.path.isfile(candidate):
+        path = candidate
+        break
   with open(path) as f:
-    return Assets.from_json(f.read())
+    text = f.read()
+  if path.endswith(".pbtxt"):
+    return assets_from_pbtxt(text)
+  return Assets.from_json(text)
+
+
+# -- reference-compatible text-format proto sidecar -------------------------
+#
+# The reference's robot stacks load `assets.extra/t2r_assets.pbtxt`, a
+# text-format `T2RAssets` proto (/root/reference/proto/t2r.proto:19-43,
+# written by text_format.MessageToString at
+# /root/reference/utils/tensorspec_utils.py:1685-1688). (De)serialization
+# goes through the real google.protobuf runtime (already a dependency via
+# tensorflow) over a programmatically-built descriptor with the same
+# field numbers/types — exact wire/text parity by construction, no
+# protoc-generated file.
+
+PBTXT_ASSET_FILENAME = "t2r_assets.pbtxt"
+
+# tensorflow/core/framework/types.proto DataType enum values — the wire
+# meaning of `ExtendedTensorSpec.dtype` (reference to_proto uses
+# `dtype.as_datatype_enum`, utils/tensorspec_utils.py:196).
+_NP_TO_TF_ENUM = {
+    "float32": 1, "float64": 2, "int32": 3, "uint8": 4, "int16": 5,
+    "int8": 6, "object": 7, "complex64": 8, "int64": 9, "bool": 10,
+    "bfloat16": 14, "uint16": 17, "complex128": 18, "float16": 19,
+    "uint32": 22, "uint64": 23,
+}
+_TF_ENUM_TO_NP = {v: k for k, v in _NP_TO_TF_ENUM.items()}
+
+_T2R_ASSETS_CLASS = None
+
+
+def _t2r_assets_class():
+  """Returns (cached) the dynamically-built T2RAssets message class."""
+  global _T2R_ASSETS_CLASS
+  if _T2R_ASSETS_CLASS is not None:
+    return _T2R_ASSETS_CLASS
+  from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+  fdp = descriptor_pb2.FileDescriptorProto()
+  fdp.name = "tensor2robot_tpu/t2r_assets.proto"
+  fdp.package = "tensor2robot_tpu"
+  fdp.syntax = "proto2"
+  F = descriptor_pb2.FieldDescriptorProto
+
+  spec_msg = fdp.message_type.add()
+  spec_msg.name = "ExtendedTensorSpec"
+  for num, name, ftype, label in [
+      (1, "shape", F.TYPE_INT32, F.LABEL_REPEATED),
+      (2, "dtype", F.TYPE_INT32, F.LABEL_OPTIONAL),
+      (3, "name", F.TYPE_STRING, F.LABEL_OPTIONAL),
+      (4, "is_optional", F.TYPE_BOOL, F.LABEL_OPTIONAL),
+      (5, "is_extracted", F.TYPE_BOOL, F.LABEL_OPTIONAL),
+      (6, "data_format", F.TYPE_STRING, F.LABEL_OPTIONAL),
+      (7, "dataset_key", F.TYPE_STRING, F.LABEL_OPTIONAL),
+      (8, "varlen_default_value", F.TYPE_FLOAT, F.LABEL_OPTIONAL),
+  ]:
+    field = spec_msg.field.add()
+    field.name, field.number, field.type, field.label = name, num, ftype, label
+
+  struct_msg = fdp.message_type.add()
+  struct_msg.name = "TensorSpecStruct"
+  # map<string, ExtendedTensorSpec> lowers to a repeated nested MapEntry.
+  entry = struct_msg.nested_type.add()
+  entry.name = "KeyValueEntry"
+  entry.options.map_entry = True
+  key_field = entry.field.add()
+  key_field.name, key_field.number = "key", 1
+  key_field.type, key_field.label = F.TYPE_STRING, F.LABEL_OPTIONAL
+  value_field = entry.field.add()
+  value_field.name, value_field.number = "value", 2
+  value_field.type, value_field.label = F.TYPE_MESSAGE, F.LABEL_OPTIONAL
+  value_field.type_name = ".tensor2robot_tpu.ExtendedTensorSpec"
+  kv = struct_msg.field.add()
+  kv.name, kv.number, kv.type, kv.label = (
+      "key_value", 1, F.TYPE_MESSAGE, F.LABEL_REPEATED)
+  kv.type_name = ".tensor2robot_tpu.TensorSpecStruct.KeyValueEntry"
+
+  assets_msg = fdp.message_type.add()
+  assets_msg.name = "T2RAssets"
+  for num, name in [(1, "feature_spec"), (2, "label_spec")]:
+    field = assets_msg.field.add()
+    field.name, field.number = name, num
+    field.type, field.label = F.TYPE_MESSAGE, F.LABEL_OPTIONAL
+    field.type_name = ".tensor2robot_tpu.TensorSpecStruct"
+  gs = assets_msg.field.add()
+  gs.name, gs.number, gs.type, gs.label = (
+      "global_step", 3, F.TYPE_INT32, F.LABEL_OPTIONAL)
+
+  pool = descriptor_pool.DescriptorPool()
+  pool.Add(fdp)
+  _T2R_ASSETS_CLASS = message_factory.GetMessageClass(
+      pool.FindMessageTypeByName("tensor2robot_tpu.T2RAssets"))
+  return _T2R_ASSETS_CLASS
+
+
+def _fill_spec_proto(proto, spec: TensorSpec) -> None:
+  for dim in spec.shape:
+    # Unknown dims cannot round-trip through the int32 field; the
+    # reference never has them in serving specs (batch is stripped).
+    proto.shape.append(-1 if dim is None else int(dim))
+  enum = _NP_TO_TF_ENUM.get(_dtype_name(spec.dtype))
+  if enum is None:
+    raise ValueError(
+        f"dtype {spec.dtype} has no TF DataType enum; cannot serialize "
+        f"to {PBTXT_ASSET_FILENAME}")
+  proto.dtype = enum
+  if spec.name is not None:
+    proto.name = spec.name
+  if spec.is_optional:
+    proto.is_optional = True
+  if spec.is_extracted:
+    proto.is_extracted = True
+  if spec.data_format is not None:
+    proto.data_format = spec.data_format
+  if spec.dataset_key:
+    proto.dataset_key = spec.dataset_key
+  if spec.varlen_default_value is not None:
+    proto.varlen_default_value = float(spec.varlen_default_value)
+
+
+def _spec_from_proto(proto) -> TensorSpec:
+  kwargs: Dict[str, Any] = {
+      "shape": tuple(None if d == -1 else int(d) for d in proto.shape),
+  }
+  if proto.HasField("dtype"):
+    dtype_name = _TF_ENUM_TO_NP.get(proto.dtype)
+    if dtype_name is None:
+      # Present-but-unmappable (e.g. DT_QINT8): fail here, not far away
+      # in feed validation against a silently-wrong dtype.
+      raise ValueError(
+          f"{PBTXT_ASSET_FILENAME}: TF DataType enum {proto.dtype} for "
+          f"spec {proto.name!r} has no numpy equivalent")
+  else:
+    dtype_name = "float32"
+  kwargs["dtype"] = (np.dtype(object) if dtype_name == "object"
+                     else np.dtype(dtype_name))
+  for field in ("name", "is_optional", "is_extracted", "data_format",
+                "dataset_key", "varlen_default_value"):
+    if proto.HasField(field):
+      kwargs[field] = getattr(proto, field)
+  return TensorSpec(**kwargs)
+
+
+def assets_to_pbtxt(assets: Assets) -> str:
+  """Renders Assets as reference-parseable text-format `T2RAssets`."""
+  from google.protobuf import text_format
+
+  message = _t2r_assets_class()()
+  for field, struct in (("feature_spec", assets.feature_spec),
+                        ("label_spec", assets.label_spec)):
+    if struct is None:
+      continue
+    key_value = getattr(message, field).key_value
+    for key, spec in flatten_spec_structure(struct).items():
+      _fill_spec_proto(key_value[key], spec)
+  if assets.global_step is not None:
+    message.global_step = int(assets.global_step)
+  return text_format.MessageToString(message)
+
+
+def assets_from_pbtxt(text: str) -> Assets:
+  from google.protobuf import text_format
+
+  message = _t2r_assets_class()()
+  text_format.Parse(text, message)
+
+  def _struct(field) -> Optional[SpecStruct]:
+    if not message.HasField(field):
+      return None
+    out = SpecStruct()
+    for key, proto in getattr(message, field).key_value.items():
+      out[key] = _spec_from_proto(proto)
+    return out
+
+  return Assets(
+      feature_spec=_struct("feature_spec"),
+      label_spec=_struct("label_spec"),
+      global_step=(int(message.global_step)
+                   if message.HasField("global_step") else None))
+
+
+def write_assets_pbtxt(assets: Assets, path: str) -> None:
+  import os
+
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  with open(path, "w") as f:
+    f.write(assets_to_pbtxt(assets))

@@ -237,7 +237,8 @@ def train_eval_model(
                                   use_ema=use_ema_for_eval)
     eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
     final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                              eval_steps, batch_spec)
+                              eval_steps, batch_spec,
+                              prefetch_depth=device_prefetch_depth)
     writer.write_scalars(int(state.step), final_metrics)
     for hook in hooks:
       hook.after_eval(ctx, int(state.step), final_metrics)
@@ -270,7 +271,8 @@ def train_eval_model(
           state = manager.restore(step, abstract_state=abstract)
         eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
         final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                  eval_steps, batch_spec)
+                                  eval_steps, batch_spec,
+                                  prefetch_depth=device_prefetch_depth)
       finally:
         if backup is not None:
           import shutil

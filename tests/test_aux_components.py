@@ -95,6 +95,44 @@ class TestSavedModelPredictor:
     assert out["prediction"].shape == (2, 1)
     assert predictor.global_step == 10
 
+  def test_reference_era_saved_model_dir(self, tmp_path):
+    """A reference-layout export (saved_model.pb at the timestamped root,
+    pbtxt-only assets, serving_default signature) serves unchanged."""
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.predictors import saved_model_predictor
+
+    export_root = str(tmp_path / "export")
+    bundle = os.path.join(export_root, "1234567890")
+
+    class RefModule(tf.Module):
+      @tf.function(input_signature=[
+          tf.TensorSpec((None, 3), tf.float32, name="measured_position")])
+      def serve(self, measured_position):
+        return {"prediction": tf.reduce_sum(measured_position, axis=-1,
+                                            keepdims=True)}
+
+    module = RefModule()
+    tf.saved_model.save(module, bundle,
+                        signatures={"serving_default": module.serve})
+    specs_lib.write_assets_pbtxt(
+        specs_lib.Assets(
+            feature_spec=specs_lib.SpecStruct({
+                "x": specs_lib.TensorSpec(shape=(3,), dtype=np.float32,
+                                          name="measured_position")}),
+            label_spec=specs_lib.SpecStruct({
+                "y": specs_lib.TensorSpec(shape=(1,), dtype=np.float32)}),
+            global_step=42),
+        os.path.join(bundle, "assets.extra",
+                     specs_lib.PBTXT_ASSET_FILENAME))
+
+    predictor = saved_model_predictor.SavedModelPredictor(
+        export_dir=export_root)
+    assert predictor.restore()
+    out = predictor.predict({"x": np.ones((2, 3), np.float32)})
+    np.testing.assert_allclose(out["prediction"], [[3.0], [3.0]])
+    assert predictor.global_step == 42
+
 
 class TestJpegHelpers:
 
@@ -194,6 +232,40 @@ class TestBestAndAsyncExport:
         hook_builders=[Builder()], log_every_n_steps=10)
     exports = glob.glob(os.path.join(model_dir, "export", "*"))
     assert exports, "async export produced no bundles"
+
+  def test_slow_async_export_never_blocks_after_checkpoint(self, tmp_path):
+    import threading
+    import time
+
+    release = threading.Event()
+    started = threading.Event()
+    exported_steps = []
+
+    class SlowGenerator:
+      def set_specification_from_model(self, model):
+        pass
+
+      def export(self, state, base, global_step):
+        started.set()
+        release.wait(timeout=30)
+        exported_steps.append(global_step)
+        return base
+
+    hook = hooks_lib.ExportHook(export_generator=SlowGenerator(),
+                                async_export=True)
+    ctx = hooks_lib.TrainContext(model=None, model_dir=str(tmp_path),
+                                 get_state=lambda: {"w": np.zeros(2)})
+    hook.begin(ctx)
+    hook.after_checkpoint(ctx, 10)  # occupies the worker (blocked on event)
+    assert started.wait(timeout=10), "first export never started"
+    start = time.perf_counter()
+    hook.after_checkpoint(ctx, 20)  # must NOT join the in-flight export
+    hook.after_checkpoint(ctx, 30)  # latest wins over step 20
+    elapsed = time.perf_counter() - start
+    assert elapsed < 5.0, f"after_checkpoint blocked for {elapsed:.1f}s"
+    release.set()
+    hook.end(ctx)  # drains: step 10 finishes, then the pending step 30
+    assert exported_steps == [10, 30]
 
 
 class TestWarmStart:

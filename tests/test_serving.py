@@ -205,6 +205,18 @@ class TestExportedModelPredictor:
     out = predictor.predict({"x": np.zeros((1, 3), np.float32)})
     assert "prediction" in out
 
+  def test_bundle_carries_reference_pbtxt_sidecar(self, tmp_path):
+    from tensor2robot_tpu import specs as specs_lib
+
+    model_dir = _train(tmp_path, export=True)
+    bundles = sorted(os.listdir(os.path.join(model_dir, "export")))
+    pbtxt = os.path.join(model_dir, "export", bundles[-1], "assets.extra",
+                         specs_lib.PBTXT_ASSET_FILENAME)
+    assert os.path.isfile(pbtxt), "bundle missing t2r_assets.pbtxt"
+    loaded = specs_lib.load_assets(pbtxt)
+    assert loaded.global_step == 40
+    assert "x" in loaded.feature_spec
+
   def test_picks_newest_and_skips_invalid(self, tmp_path):
     model_dir = _train(tmp_path, export=True)
     export_root = os.path.join(model_dir, "export")

@@ -6,6 +6,7 @@ to the JAX-native design.
 """
 
 import json
+import os
 
 import jax
 import jax.numpy as jnp
@@ -382,3 +383,119 @@ class TestAssets:
     assert loaded.global_step == 1234
     assert loaded.feature_spec["img"].name == "image/encoded"
     assert loaded.extra == {"model": "mock"}
+
+
+_REFERENCE_PROTO = "/root/reference/proto/t2r.proto"
+
+
+def _make_t2r_proto_messages():
+  """protoc-compiles the ACTUAL reference schema at test time — fully
+  independent of specs.py's hand-built descriptor, so a transcription
+  error there (wrong field number/type) fails these tests instead of
+  being validated against a copy of itself."""
+  import shutil
+  import subprocess
+  import sys
+  import tempfile
+
+  if shutil.which("protoc") is None or not os.path.isfile(_REFERENCE_PROTO):
+    pytest.skip("protoc or reference t2r.proto unavailable")
+  out_dir = tempfile.mkdtemp(prefix="t2r_pb2_")
+  subprocess.run(
+      ["protoc", f"--proto_path={os.path.dirname(_REFERENCE_PROTO)}",
+       f"--python_out={out_dir}", _REFERENCE_PROTO],
+      check=True, capture_output=True)
+  sys.path.insert(0, out_dir)
+  try:
+    import t2r_pb2  # noqa: PLC0415 - generated one line above
+  finally:
+    sys.path.remove(out_dir)
+  return t2r_pb2.T2RAssets
+
+
+class TestAssetsPbtxt:
+
+  def _assets(self):
+    feature_spec = SpecStruct({
+        "img": TensorSpec(shape=(32, 32, 3), dtype=np.uint8,
+                          data_format="jpeg", name="image/encoded"),
+        "state/pose": TensorSpec(shape=(7,), dtype=np.float32,
+                                 name="pose", is_optional=True),
+        "seq": TensorSpec(shape=(10,), dtype=np.int64, name="seq",
+                          varlen_default_value=-1.0),
+    })
+    label_spec = SpecStruct(
+        {"y": TensorSpec(shape=(1,), dtype=np.float32, name="target")})
+    return specs.Assets(feature_spec=feature_spec, label_spec=label_spec,
+                        global_step=77)
+
+  def test_pbtxt_roundtrip_through_own_parser(self, tmp_path):
+    assets = self._assets()
+    path = str(tmp_path / "assets.extra" / specs.PBTXT_ASSET_FILENAME)
+    specs.write_assets_pbtxt(assets, path)
+    loaded = specs.load_assets(path)
+    specs.assert_equal(loaded.feature_spec, assets.feature_spec)
+    specs.assert_equal(loaded.label_spec, assets.label_spec)
+    assert loaded.global_step == 77
+    assert loaded.feature_spec["seq"].varlen_default_value == -1.0
+    assert loaded.feature_spec["state/pose"].is_optional
+
+  def test_pbtxt_parses_under_real_protobuf_text_format(self):
+    """The reference loads this file with text_format.Parse against
+    proto/t2r.proto — verify with the actual protobuf runtime."""
+    from google.protobuf import text_format
+
+    msg_class = _make_t2r_proto_messages()
+    message = msg_class()
+    text_format.Parse(specs.assets_to_pbtxt(self._assets()), message)
+    assert message.global_step == 77
+    img = message.feature_spec.key_value["img"]
+    assert list(img.shape) == [32, 32, 3]
+    assert img.dtype == 4  # DT_UINT8
+    assert img.name == "image/encoded"
+    assert img.data_format == "jpeg"
+    seq = message.feature_spec.key_value["seq"]
+    assert seq.dtype == 9  # DT_INT64
+    assert seq.varlen_default_value == -1.0
+    assert message.label_spec.key_value["y"].name == "target"
+
+  def test_reference_written_pbtxt_loads(self):
+    """Inverse direction: a file produced by protobuf MessageToString
+    (what reference-era tooling writes) loads through assets_from_pbtxt."""
+    from google.protobuf import text_format
+
+    msg_class = _make_t2r_proto_messages()
+    message = msg_class()
+    text_format.Parse(specs.assets_to_pbtxt(self._assets()), message)
+    reference_text = text_format.MessageToString(message)
+    loaded = specs.assets_from_pbtxt(reference_text)
+    specs.assert_equal(loaded.feature_spec, self._assets().feature_spec)
+    assert loaded.global_step == 77
+
+  def test_load_assets_falls_back_to_pbtxt_sidecar(self, tmp_path):
+    assets = self._assets()
+    # Only the reference-layout pbtxt exists; load_assets pointed at the
+    # (missing) JSON finds it.
+    specs.write_assets_pbtxt(
+        assets, str(tmp_path / "assets.extra" / specs.PBTXT_ASSET_FILENAME))
+    loaded = specs.load_assets(str(tmp_path / specs.ASSET_FILENAME))
+    specs.assert_equal(loaded.feature_spec, assets.feature_spec)
+
+  def test_exotic_string_escapes_roundtrip(self):
+    """Names with \\r / high-byte chars must survive the text format
+    (reference files are written by protobuf MessageToString, which
+    escapes them; a naive unescaper corrupts the serving tensor name)."""
+    weird = "line1\rline2\xfftab\there"
+    struct = SpecStruct(
+        {"k": TensorSpec(shape=(1,), dtype=np.float32, name=weird)})
+    text = specs.assets_to_pbtxt(specs.Assets(feature_spec=struct))
+    loaded = specs.assets_from_pbtxt(text)
+    assert loaded.feature_spec["k"].name == weird
+
+  def test_string_dtype_maps_to_dt_string(self):
+    struct = SpecStruct(
+        {"raw": TensorSpec(shape=(), dtype=np.dtype(object), name="raw")})
+    text = specs.assets_to_pbtxt(specs.Assets(feature_spec=struct))
+    assert "dtype: 7" in text  # DT_STRING
+    loaded = specs.assets_from_pbtxt(text)
+    assert loaded.feature_spec["raw"].dtype == np.dtype(object)

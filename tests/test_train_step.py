@@ -127,6 +127,45 @@ class TestDevicePrefetcher:
     with pytest.raises(StopIteration):
       next(pf)
 
+  def test_context_manager_closes(self, dp_mesh):
+    with mesh_lib.DevicePrefetcher(self._batches(3), dp_mesh,
+                                   depth=1) as pf:
+      next(pf)
+    assert not pf._thread.is_alive()
+
+  def test_close_returns_despite_stalled_source(self, dp_mesh):
+    import threading
+    import time
+
+    unblock = threading.Event()
+
+    def stalled():
+      yield {"features": specs_lib.SpecStruct(
+          {"x": np.zeros((8, 2), np.float32)})}
+      unblock.wait(timeout=30)  # worker blocks inside next(dataset)
+
+    pf = mesh_lib.DevicePrefetcher(stalled(), dp_mesh, depth=1)
+    next(pf)
+    start = time.perf_counter()
+    pf.close(timeout=0.5)  # must not hang on the blocked worker
+    assert time.perf_counter() - start < 5.0
+    unblock.set()
+
+  def test_finalizer_stops_abandoned_worker(self, dp_mesh):
+    import gc
+    import time
+
+    pf = mesh_lib.DevicePrefetcher(self._batches(5), dp_mesh, depth=1)
+    next(pf)
+    stop_event = pf._stop
+    del pf  # abandoned without close()
+    gc.collect()
+    for _ in range(50):
+      if stop_event.is_set():
+        break
+      time.sleep(0.1)
+    assert stop_event.is_set()
+
 
 class TestTrainStep:
 
