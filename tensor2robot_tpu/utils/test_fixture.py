@@ -106,7 +106,19 @@ class T2RModelFixture:
         input_generator=input_generators.DefaultRandomInputGenerator(
             batch_size=self._batch_size, seed=123),
         num_batches=1)[0]
-    flat = {k: np.asarray(v) for k, v in outputs.items()}
+    # Outputs may contain non-array leaves (e.g. an MDN head returns a
+    # tuple of differently-shaped parameter arrays): flatten the whole
+    # pytree to path-keyed array leaves so every leaf is pinned.
+    import jax
+
+    def _path_key(path) -> str:
+      return "/".join(
+          str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+    flat = {
+        _path_key(path): np.asarray(leaf)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(dict(outputs))
+    }
     if update or not os.path.isfile(golden_path):
       os.makedirs(os.path.dirname(golden_path) or ".", exist_ok=True)
       np.save(golden_path, flat, allow_pickle=True)

@@ -41,6 +41,54 @@ def _qtopt_model(**kwargs):
       use_bfloat16=False, **kwargs)
 
 
+def _pose_env_model():
+  from tensor2robot_tpu.research.pose_env import models as pose_models
+
+  return pose_models.PoseEnvRegressionModel(device_type="cpu")
+
+
+def _bcz_model():
+  import functools
+
+  from tensor2robot_tpu.research.bcz import models as bcz_models
+
+  # Preprocessor sizes scaled down consistently with image_size=32 (the
+  # two are independent knobs, normally co-configured in gin).
+  return bcz_models.BCZModel(
+      image_size=32, resnet_size=18, num_waypoints=3, device_type="cpu",
+      preprocessor_cls=functools.partial(
+          bcz_models.BCZPreprocessor, input_size=(48, 48),
+          crop_size=(40, 40), model_size=(32, 32)))
+
+
+def _grasp2vec_model():
+  from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+
+  return g2v_models.Grasp2VecModel(image_size=32, device_type="cpu")
+
+
+def _vrgripper_mdn_model():
+  import functools
+
+  from tensor2robot_tpu.research.vrgripper import models as vr_models
+
+  return vr_models.VRGripperRegressionModel(
+      episode_length=3, image_size=32, num_mixture_components=3,
+      device_type="cpu",
+      preprocessor_cls=functools.partial(
+          vr_models.VRGripperPreprocessor, input_size=(40, 40),
+          model_size=(32, 32)))
+
+
+def _maml_model():
+  from tensor2robot_tpu.meta_learning import maml
+
+  base = mocks.MockT2RModel(device_type="cpu", use_batch_norm=False)
+  return maml.MAMLModel(base_model=base,
+                        num_condition_samples_per_task=4,
+                        num_inference_samples_per_task=4)
+
+
 class TestPinnedGoldens:
 
   def test_mock_model_matches_committed_golden(self, tmp_path):
@@ -53,6 +101,37 @@ class TestPinnedGoldens:
     fixture = T2RModelFixture(str(tmp_path / "qtopt"), batch_size=4)
     fixture.train_and_check_golden_predictions(
         _qtopt_model(), os.path.join(GOLDEN_DIR, "qtopt_small.npy"),
+        max_train_steps=3, atol=1e-5, require=True)
+
+  def test_pose_env_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "pose"), batch_size=4)
+    fixture.train_and_check_golden_predictions(
+        _pose_env_model(), os.path.join(GOLDEN_DIR, "pose_env_regression.npy"),
+        max_train_steps=3, atol=1e-5, require=True)
+
+  def test_bcz_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "bcz"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _bcz_model(), os.path.join(GOLDEN_DIR, "bcz_small.npy"),
+        max_train_steps=3, atol=1e-4, require=True)
+
+  def test_grasp2vec_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "g2v"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _grasp2vec_model(), os.path.join(GOLDEN_DIR, "grasp2vec_small.npy"),
+        max_train_steps=3, atol=1e-4, require=True)
+
+  def test_vrgripper_mdn_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "vrg"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _vrgripper_mdn_model(),
+        os.path.join(GOLDEN_DIR, "vrgripper_mdn_small.npy"),
+        max_train_steps=3, atol=1e-4, require=True)
+
+  def test_maml_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "maml"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _maml_model(), os.path.join(GOLDEN_DIR, "maml_mock.npy"),
         max_train_steps=3, atol=1e-5, require=True)
 
   def test_deliberate_lr_change_fails_golden(self, tmp_path):
