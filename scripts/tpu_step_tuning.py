@@ -30,7 +30,7 @@ IMAGE_SIZE = 472
 NUM_CONVS = (7, 6, 3)  # full Grasping44; reduce for small-image sanity runs
 
 
-def _setup(batch_size):
+def _setup(batch_size, remat=False):
   import jax
 
   from tensor2robot_tpu import modes, specs as specs_lib
@@ -43,7 +43,8 @@ def _setup(batch_size):
       network="grasping44", num_convs=NUM_CONVS, action_size=5,
       grasp_param_names={"world_vector": (0, 3),
                          "vertical_rotation": (3, 2)},
-      use_bfloat16=device.platform != "cpu", use_ema=True)
+      use_bfloat16=device.platform != "cpu", use_ema=True,
+      remat=remat)  # parallel/train_step.py:203 wraps the fwd in remat
   features = specs_lib.make_random_numpy(
       model.preprocessor.get_out_feature_specification(modes.TRAIN),
       batch_size=batch_size, seed=0)
@@ -101,6 +102,22 @@ def batch(batch_size):
         f"(vs_baseline {batch_size / sec / 400.0:.3f})")
 
 
+def remat(batch_size):
+  """HBM lever probe: rematerialized forward trades FLOPs (cheap here —
+  the step is ~14% MXU) for activation bytes between fwd and bwd (the
+  bottleneck per the roofline). Compare against `batch` at equal size."""
+  jax, state, step, features, labels = _setup(batch_size, remat=True)
+  compiled = step.lower(state, features, labels).compile()
+  cost = compiled.cost_analysis()
+  cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+  sec, _ = _step_time(jax, state, compiled, features, labels)
+  print(f"remat batch={batch_size}: {sec * 1e3:.1f} ms/step = "
+        f"{batch_size / sec:.1f} examples/sec "
+        f"(vs_baseline {batch_size / sec / 400.0:.3f}) "
+        f"flops={cost.get('flops', float('nan')) / 1e12:.3f} TF "
+        f"bytes={cost.get('bytes accessed', float('nan')) / 1e9:.2f} GB")
+
+
 def profile(batch_size):
   jax, state, step, features, labels = _setup(batch_size)
   # warm up + compile outside the trace window
@@ -122,6 +139,8 @@ def main():
     roofline(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
   elif phase == "batch":
     batch(int(sys.argv[2]))
+  elif phase == "remat":
+    remat(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
   elif phase == "profile":
     profile(int(sys.argv[2]) if len(sys.argv) > 2 else 64)
   else:

@@ -29,11 +29,17 @@ run python scripts/tpu_flash_validate.py correctness
 run python scripts/tpu_flash_validate.py time 1024
 run python scripts/tpu_flash_validate.py time 4096
 run python scripts/tpu_flash_validate.py time 16384
-# 3. Roofline after the bf16 fix + batch scaling.
+# 3. Roofline after the bf16 fix + batch scaling + remat HBM lever.
 run python scripts/tpu_step_tuning.py roofline
 run python scripts/tpu_step_tuning.py batch 32
 run python scripts/tpu_step_tuning.py batch 128
-# 4. Profiler trace last (largest artifact, least critical).
+run python scripts/tpu_step_tuning.py remat 64
+run python scripts/tpu_step_tuning.py remat 128
+# 4. End-to-end input pipeline: TFRecords -> native parse/decode ->
+#    DevicePrefetcher -> train step (gen is CPU-only and idempotent).
+run python scripts/tpu_e2e_pipeline.py gen 512
+run python scripts/tpu_e2e_pipeline.py run 30
+# 5. Profiler trace last (largest artifact, least critical).
 run python scripts/tpu_step_tuning.py profile
 date | tee -a "$OUT"
 echo "window complete: results in $OUT"

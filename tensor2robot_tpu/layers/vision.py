@@ -19,7 +19,7 @@ from tensor2robot_tpu.layers.spatial_softmax import SpatialSoftmax
 from tensor2robot_tpu.ops.image_norm import normalize_image
 
 __all__ = ["FilmParams", "film", "BerkeleyNet", "HighResBerkeleyNet",
-           "PoseHead"]
+           "PipelinedBerkeleyTower", "PoseHead"]
 
 
 class FilmParams(nn.Module):
@@ -79,6 +79,167 @@ class BerkeleyNet(nn.Module):
     if self.use_spatial_softmax:
       return SpatialSoftmax(name="spatial_softmax")(x, train=train)
     return x.reshape(x.shape[0], -1) if self.flatten else x
+
+
+class PipelinedBerkeleyTower(nn.Module):
+  """BerkeleyNet's conv stack as heterogeneous GPipe pipeline stages.
+
+  Semantics match `BerkeleyNet` with `normalizer='layer_norm'`:
+  conv -> LayerNorm -> (FiLM) -> relu per stage, then the caller applies
+  spatial softmax / heads to the returned [B, H', W', C'] feature map.
+  Each conv layer is one pipeline stage with its OWN kernel/LN/FiLM
+  shapes (channel widths and spatial dims change stage to stage — the
+  heterogeneous-PP case `parallel/pipeline_parallel.py` round-2 scoping
+  excluded). All stage params live in a single [S, P_max] leaf named
+  `pp_stages` (zero-padded flat per-stage vectors) so partition rules
+  shard REAL storage over the `pp` mesh axis; activations travel as
+  padded flat buffers with the conditioning vector riding along.
+
+  Without a mesh (single chip, unit tests) the same stacked params run
+  the sequential schedule — identical math, no communication.
+  """
+
+  filters: Sequence[int] = (64, 32, 32)
+  kernel_sizes: Sequence[int] = (7, 3, 3)
+  strides: Sequence[int] = (2, 1, 1)
+  condition_size: int = 0  # conditioning vector width (0 = none)
+  mesh: Optional[Any] = None  # jax.sharding.Mesh with a pp axis
+  axis_name: str = "pp"
+  batch_axis: str = "data"
+  num_microbatches: int = 4
+  dtype: Optional[Any] = None
+
+  def _stage_geometry(self, height: int, width: int, channels: int):
+    """Static per-stage (in_shape, out_shape) under SAME padding."""
+    geometry = []
+    for f, s in zip(self.filters, self.strides):
+      out_h = -(-height // s)  # ceil div: SAME padding output size
+      out_w = -(-width // s)
+      geometry.append(((height, width, channels), (out_h, out_w, f)))
+      height, width, channels = out_h, out_w, f
+    return geometry
+
+  def _stage_param_defs(self, geometry):
+    """Single source of truth for the per-stage param layout: name ->
+    (shape, initializer). Both the unravel templates and the real
+    initialization derive from this — a divergence between the two would
+    silently reshape the wrong bytes into kernels."""
+    defs = []
+    for i, ((_, _, cin), (_, _, cout)) in enumerate(geometry):
+      k = self.kernel_sizes[i]
+      d = {"kernel": ((k, k, cin, cout), nn.initializers.lecun_normal()),
+           "bias": ((cout,), nn.initializers.zeros),
+           "ln_scale": ((cout,), nn.initializers.ones),
+           "ln_bias": ((cout,), nn.initializers.zeros)}
+      if self.condition_size:
+        d["film_kernel"] = ((self.condition_size, 2 * cout),
+                            nn.initializers.lecun_normal())
+        d["film_bias"] = ((2 * cout,), nn.initializers.zeros)
+      defs.append(d)
+    return defs
+
+  def _template_params(self, defs):
+    import numpy as np
+
+    return [{name: np.zeros(shape, np.float32)
+             for name, (shape, _) in stage.items()} for stage in defs]
+
+  @nn.compact
+  def __call__(self, images: jnp.ndarray,
+               conditioning: Optional[jnp.ndarray] = None,
+               train: bool = False) -> jnp.ndarray:
+    import jax
+    import numpy as np
+
+    from tensor2robot_tpu.parallel import pipeline_parallel as pp_lib
+
+    if bool(self.condition_size) != (conditioning is not None):
+      raise ValueError("condition_size and conditioning must agree")
+    x = normalize_image(images, self.dtype)
+    batch, height, width, channels = x.shape
+    geometry = self._stage_geometry(height, width, channels)
+    defs = self._stage_param_defs(geometry)
+    _, unravels, sizes = pp_lib.ravel_stage_stack(
+        self._template_params(defs))
+    num_stages = len(geometry)
+    cond = self.condition_size
+    a_max = max(int(np.prod(shape))
+                for in_out in geometry for shape in in_out) + cond
+
+    def init_stacked(key):
+      stage_params = []
+      for stage in defs:
+        p = {}
+        for name, (shape, initializer) in stage.items():
+          key, subkey = jax.random.split(key)
+          p[name] = initializer(subkey, shape, jnp.float32)
+        stage_params.append(p)
+      stacked, _, _ = pp_lib.ravel_stage_stack(stage_params)
+      return stacked
+
+    stacked = self.param("pp_stages", init_stacked)
+
+    def make_stage_fn(i):
+      (in_h, in_w, cin), (_, _, cout) = geometry[i]
+      stride = self.strides[i]
+      in_size = in_h * in_w * cin
+
+      def stage_fn(p, flat):
+        mb = flat.shape[0]
+        act = flat[:, :in_size].reshape(mb, in_h, in_w, cin)
+        compute = self.dtype or act.dtype
+        act = act.astype(compute)
+        y = jax.lax.conv_general_dilated(
+            act, p["kernel"].astype(compute), (stride, stride), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        y = y + p["bias"].astype(compute)
+        # LayerNorm over the channel axis, stats in f32 (flax semantics).
+        mean = jnp.mean(y.astype(jnp.float32), axis=-1, keepdims=True)
+        var = jnp.var(y.astype(jnp.float32), axis=-1, keepdims=True)
+        y = ((y.astype(jnp.float32) - mean)
+             * jax.lax.rsqrt(var + 1e-6)).astype(compute)
+        y = y * p["ln_scale"].astype(compute) + p["ln_bias"].astype(compute)
+        if cond:
+          cvec = flat[:, in_size:in_size + cond].astype(compute)
+          out_film = cvec @ p["film_kernel"].astype(compute) \
+              + p["film_bias"].astype(compute)
+          gamma, beta = jnp.split(out_film, 2, axis=-1)
+          y = film(y, gamma, beta)
+        y = nn.relu(y)
+        y = y.reshape(mb, -1)
+        if cond:
+          y = jnp.concatenate([y, flat[:, in_size:in_size + cond]], -1)
+        return y
+
+      return stage_fn
+
+    stage_fns = [make_stage_fn(i) for i in range(num_stages)]
+
+    flat_in = x.reshape(batch, -1)
+    if cond:
+      flat_in = jnp.concatenate(
+          [flat_in, conditioning.astype(flat_in.dtype)], -1)
+    flat_in = jnp.pad(flat_in, ((0, 0), (0, a_max - flat_in.shape[-1])))
+
+    use_pp = (self.mesh is not None
+              and self.mesh.shape.get(self.axis_name, 1) > 1)
+    if use_pp:
+      m = self.num_microbatches
+      if batch % m:
+        raise ValueError(
+            f"batch size {batch} not divisible into {m} microbatches")
+      micro = flat_in.reshape(m, batch // m, a_max)
+      out = pp_lib.pipelined_apply_heterogeneous(
+          stage_fns, unravels, sizes, stacked, micro, self.mesh,
+          axis_name=self.axis_name, batch_axis=self.batch_axis)
+    else:
+      micro = flat_in[None]  # one "microbatch": plain sequential apply
+      out = pp_lib.sequential_apply_heterogeneous(
+          stage_fns, unravels, sizes, stacked, micro)
+    out_h, out_w, out_c = geometry[-1][1]
+    features = out.reshape(batch, a_max)[:, :out_h * out_w * out_c]
+    compute = self.dtype or features.dtype
+    return features.reshape(batch, out_h, out_w, out_c).astype(compute)
 
 
 class HighResBerkeleyNet(nn.Module):

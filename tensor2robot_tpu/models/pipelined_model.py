@@ -36,9 +36,13 @@ __all__ = ["PipelinedRegressionModel", "pipeline_parallel_rules"]
 
 @config.configurable
 def pipeline_parallel_rules(axis: str = "pp", extra_rules=()):
-  """Partition rules sharding the stacked stage params over `axis`."""
+  """Partition rules sharding the stacked stage params over `axis` —
+  covers both the homogeneous trunk here (stages_*) and the
+  heterogeneous [S, P_max] stack (pp_stages) used by
+  `layers/vision.py PipelinedBerkeleyTower`."""
   return ((r"stages_w", (axis, None, None)),
-          (r"stages_b", (axis, None))) + tuple(extra_rules)
+          (r"stages_b", (axis, None)),
+          (r"pp_stages", (axis, None))) + tuple(extra_rules)
 
 
 class _PipelinedTrunk(nn.Module):

@@ -7,21 +7,37 @@ stage-to-stage over ICI while every stage works on a different
 microbatch (the classic bubble is (S-1)/(M+S-1)). Differentiable: the
 scan/ppermute pair transposes cleanly, so the same function trains.
 
-The stage function must be shape-preserving stage-to-stage (classic
-homogeneous-block pipelining, e.g. transformer/MLP block stacks).
+Two schedules share that skeleton:
+
+* `pipelined_apply` — homogeneous: one shape-preserving stage function,
+  stage params stacked with a leading [S] dim (transformer/MLP blocks).
+* `pipelined_apply_heterogeneous` — per-stage DIFFERENT functions,
+  param pytrees, and activation shapes (e.g. a conv tower whose spatial
+  dims and channel counts change every stage). Each stage's params are
+  raveled to a flat vector, zero-padded to the widest stage, and stacked
+  into one [S, P_max] leaf sharded over `pp`; activations travel as
+  zero-padded flat [mb, A_max] buffers so every ppermute hop moves a
+  same-shape array. Inside the SPMD program a `lax.switch` on
+  `axis_index` dispatches each rank to its own stage's computation —
+  XLA compiles all S branches everywhere (static shapes, MXU-friendly:
+  the branch unravels to the TRUE shapes before any matmul/conv), each
+  rank executes one.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
+from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["pipelined_apply", "stack_stage_params",
-           "shard_pipeline_tree", "make_pipelined_train_step"]
+           "shard_pipeline_tree", "make_pipelined_train_step",
+           "ravel_stage_stack", "pipelined_apply_heterogeneous",
+           "sequential_apply_heterogeneous"]
 
 
 def stack_stage_params(params_list):
@@ -136,6 +152,126 @@ def make_pipelined_train_step(
     return new_params, new_opt_state, loss
 
   return jax.jit(step)
+
+
+def ravel_stage_stack(stage_params_list: Sequence[Any]):
+  """Packs heterogeneous per-stage param pytrees into one [S, P_max] leaf.
+
+  Each stage's pytree is raveled (jax.flatten_util) to a flat vector,
+  zero-padded to the widest stage, and the vectors stacked. Returns
+  (stacked [S, P_max] array, unravel_fns, sizes): `unravel_fns[s]`
+  rebuilds stage s's pytree from `stacked[s, :sizes[s]]`.
+  """
+  flats, unravels = [], []
+  for params in stage_params_list:
+    flat, unravel = ravel_pytree(params)
+    flats.append(flat)
+    unravels.append(unravel)
+  sizes = [int(f.size) for f in flats]
+  p_max = max(sizes)
+  stacked = jnp.stack(
+      [jnp.pad(f, (0, p_max - f.size)) for f in flats])
+  return stacked, unravels, sizes
+
+
+def pipelined_apply_heterogeneous(
+    stage_fns: Sequence[Callable[[Any, jnp.ndarray], jnp.ndarray]],
+    unravel_fns: Sequence[Callable[[jnp.ndarray], Any]],
+    param_sizes: Sequence[int],
+    stacked_params: jnp.ndarray,
+    microbatches: jnp.ndarray,
+    mesh: Mesh,
+    axis_name: str = "pp",
+    batch_axis: str = None) -> jnp.ndarray:
+  """GPipe over stages with DIFFERENT functions/params/activation shapes.
+
+  Args:
+    stage_fns: per-stage (stage params pytree, flat activation
+      [mb, A_max]) -> flat activation [mb, out_size_s] with
+      out_size_s <= A_max. Each stage slices/reshapes what it consumes
+      from the padded buffer and returns its (unpadded) flat output;
+      zero-padding back to A_max happens here.
+    unravel_fns / param_sizes: from `ravel_stage_stack`.
+    stacked_params: [S, P_max], sharded over `axis_name`.
+    microbatches: [num_micro, mb, A_max] — stage 0's inputs, already
+      flat-padded to the common buffer width.
+    mesh: mesh whose `axis_name` has size == len(stage_fns).
+    batch_axis: optional mesh axis the mb dim stays sharded over (PP x DP
+      composition, as in `pipelined_apply`).
+
+  Returns:
+    [num_micro, mb, A_max] final-stage outputs (zero-padded), replicated
+    over the pp axis.
+  """
+  num_stages = len(stage_fns)
+  if mesh.shape[axis_name] != num_stages:
+    raise ValueError(
+        f"mesh axis {axis_name!r} has size {mesh.shape[axis_name]} but "
+        f"{num_stages} stage functions were given")
+  num_micro, _, a_max = microbatches.shape
+  total_ticks = num_micro + num_stages - 1
+
+  params_spec = PartitionSpec(axis_name)
+  if batch_axis is not None and mesh.shape.get(batch_axis, 1) > 1:
+    replicated = PartitionSpec(None, batch_axis)
+  else:
+    replicated = PartitionSpec()
+
+  def local_fn(local_params, micro):
+    pvec = local_params[0]  # [P_max]: this device's stage, padded
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def branch(s):
+      def run(operands):
+        vec, x = operands
+        params = unravel_fns[s](vec[:param_sizes[s]])
+        y = stage_fns[s](params, x)
+        return jnp.pad(y, ((0, 0), (0, a_max - y.shape[-1])))
+      return run
+
+    branches = [branch(s) for s in range(num_stages)]
+
+    def tick(carry, t):
+      incoming = carry
+      inject = micro[jnp.clip(t, 0, num_micro - 1)]
+      x = jnp.where(idx == 0, inject, incoming)
+      y = jax.lax.switch(idx, branches, (pvec, x))
+      shifted = jax.lax.ppermute(y, axis_name, perm)
+      return shifted, y
+
+    zeros = jnp.zeros_like(micro[0])
+    _, ys = jax.lax.scan(tick, zeros, jnp.arange(total_ticks))
+    outs = ys[num_stages - 1:]
+    outs = jnp.where(idx == num_stages - 1, outs, jnp.zeros_like(outs))
+    return jax.lax.psum(outs, axis_name)
+
+  return jax.shard_map(
+      local_fn, mesh=mesh,
+      in_specs=(params_spec, replicated),
+      out_specs=replicated,
+      check_vma=False)(stacked_params, microbatches)
+
+
+def sequential_apply_heterogeneous(
+    stage_fns: Sequence[Callable[[Any, jnp.ndarray], jnp.ndarray]],
+    unravel_fns: Sequence[Callable[[jnp.ndarray], Any]],
+    param_sizes: Sequence[int],
+    stacked_params: jnp.ndarray,
+    microbatches: jnp.ndarray) -> jnp.ndarray:
+  """The mathematically identical no-mesh schedule: every microbatch
+  through every stage in order (GPipe is an execution schedule, not a
+  different function). Used on a single chip and as the equivalence
+  reference in tests."""
+  num_micro, _, a_max = microbatches.shape
+  outs = []
+  for m in range(num_micro):
+    x = microbatches[m]
+    for s, fn in enumerate(stage_fns):
+      y = fn(unravel_fns[s](stacked_params[s, :param_sizes[s]]), x)
+      x = jnp.pad(y, ((0, 0), (0, a_max - y.shape[-1])))
+    outs.append(x)
+  return jnp.stack(outs)
 
 
 def shard_pipeline_tree(tree: Any, mesh: Mesh,

@@ -26,6 +26,7 @@ import numpy as np
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.layers import bcz_networks, film_resnet, vision
+from tensor2robot_tpu.layers import spatial_softmax as spatial_softmax_lib
 from tensor2robot_tpu.models import abstract as abstract_model
 from tensor2robot_tpu.ops.image_norm import normalize_image
 from tensor2robot_tpu.preprocessors import base as preprocessors_lib
@@ -201,6 +202,13 @@ class _BCZNetwork(nn.Module):
   predict_stop_state: bool = False  # 3-class continue/fail/success head
   dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
+  # network == 'pipelined_berkeley' only: heterogeneous-GPipe trunk knobs.
+  pp_mesh: Optional[Any] = None
+  pp_num_microbatches: int = 4
+  pp_filters: Tuple[int, ...] = (64, 32, 32, 32)
+  pp_kernel_sizes: Tuple[int, ...] = (7, 3, 3, 3)
+  pp_strides: Tuple[int, ...] = (2, 1, 1, 1)
+
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
@@ -238,6 +246,20 @@ class _BCZNetwork(nn.Module):
           resnet_size=self.resnet_size, version=self.resnet_version,
           dtype=self.dtype, name="resnet")(
               image, conditioning, train=train)
+    elif self.network == "pipelined_berkeley":
+      # Heterogeneous GPipe over the conv tower: each conv stage (its own
+      # kernel/LN/FiLM shapes) on one `pp` rank; spatial softmax + heads
+      # run data-parallel after the pipeline (parallel/
+      # pipeline_parallel.py pipelined_apply_heterogeneous).
+      fmap = vision.PipelinedBerkeleyTower(
+          filters=self.pp_filters, kernel_sizes=self.pp_kernel_sizes,
+          strides=self.pp_strides,
+          condition_size=(0 if conditioning is None
+                          else int(conditioning.shape[-1])),
+          mesh=self.pp_mesh, num_microbatches=self.pp_num_microbatches,
+          dtype=self.dtype, name="tower")(image, conditioning, train=train)
+      feats = spatial_softmax_lib.SpatialSoftmax(name="tower_ssm")(
+          fmap, train=train)
     else:
       feats = vision.BerkeleyNet(dtype=self.dtype, name="tower")(
           image, conditioning, train=train)
@@ -324,6 +346,11 @@ class BCZModel(abstract_model.T2RModel):
                loss_clip_slope: float = 0.001,
                stop_loss_weight: float = 0.1,
                gripper_metrics_component: Optional[str] = None,
+               pipeline_microbatches: int = 4,
+               pipeline_filters: Sequence[int] = (64, 32, 32, 32),
+               pipeline_kernel_sizes: Sequence[int] = (7, 3, 3, 3),
+               pipeline_strides: Sequence[int] = (2, 1, 1, 1),
+               pp_axis: str = "pp",
                **kwargs):
     kwargs.setdefault("preprocessor_cls", BCZPreprocessor)
     super().__init__(**kwargs)
@@ -355,6 +382,29 @@ class BCZModel(abstract_model.T2RModel):
     self._loss_clip_slope = loss_clip_slope
     self._stop_loss_weight = stop_loss_weight
     self._gripper_metrics_component = gripper_metrics_component
+    self._pipeline_microbatches = pipeline_microbatches
+    self._pipeline_filters = tuple(pipeline_filters)
+    self._pipeline_kernel_sizes = tuple(pipeline_kernel_sizes)
+    self._pipeline_strides = tuple(pipeline_strides)
+    self._pp_axis = pp_axis
+    self._mesh = None
+
+  def set_mesh(self, mesh) -> None:
+    """Receives the training mesh from train_eval_model. With
+    network='pipelined_berkeley' and a >1 `pp` axis, the conv trunk runs
+    the heterogeneous GPipe schedule; otherwise it runs sequentially
+    (identical math)."""
+    if self._module is not None and self._mesh is not mesh:
+      raise ValueError("set_mesh must be called before the module is "
+                       "built (create_train_state / first forward).")
+    if (mesh is not None and self._network == "pipelined_berkeley"
+        and self._pp_axis in mesh.shape and mesh.shape[self._pp_axis] > 1
+        and mesh.shape[self._pp_axis] != len(self._pipeline_filters)):
+      raise ValueError(
+          f"mesh axis {self._pp_axis!r} has size "
+          f"{mesh.shape[self._pp_axis]} but the pipelined trunk has "
+          f"{len(self._pipeline_filters)} conv stages; they must match.")
+    self._mesh = mesh
 
   def get_feature_specification(self, mode):
     out = SpecStruct({
@@ -411,11 +461,20 @@ class BCZModel(abstract_model.T2RModel):
     return out
 
   def create_module(self):
+    mesh = self._mesh
+    use_pp = (mesh is not None and self._network == "pipelined_berkeley"
+              and self._pp_axis in mesh.shape
+              and mesh.shape[self._pp_axis] > 1)
     return _BCZNetwork(
         dtype=self.compute_dtype if self.use_bfloat16 else None,
         components=self._components, num_waypoints=self._num_waypoints,
         network=self._network, resnet_size=self._resnet_size,
         resnet_version=self._resnet_version,
+        pp_mesh=mesh if use_pp else None,
+        pp_num_microbatches=self._pipeline_microbatches,
+        pp_filters=self._pipeline_filters,
+        pp_kernel_sizes=self._pipeline_kernel_sizes,
+        pp_strides=self._pipeline_strides,
         condition_mode=self._condition_mode,
         condition_size=self._condition_size,
         num_subtasks=self._num_subtasks,

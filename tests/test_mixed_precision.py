@@ -96,6 +96,19 @@ def test_bcz_resnet_film_bf16_end_to_end():
   _assert_all_bf16(_conv_dot_dtypes(model))
 
 
+def test_bcz_pipelined_trunk_bf16_end_to_end():
+  """The heterogeneous-PP trunk (sequential schedule on one chip) keeps
+  its convs bf16 — the raveled f32 param stack must be cast INSIDE the
+  stage functions, not win the flax promotion."""
+  from tensor2robot_tpu.research.bcz import models as bcz_models
+
+  model = bcz_models.BCZModel(
+      image_size=32, device_type="tpu", network="pipelined_berkeley",
+      num_waypoints=3, use_bfloat16=True,
+      condition_mode="language", condition_size=8)
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
 def test_vrgripper_regression_bf16_end_to_end():
   from tensor2robot_tpu.research.vrgripper import models as vr_models
 
