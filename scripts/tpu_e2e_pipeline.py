@@ -28,7 +28,14 @@ sys.path.insert(0, ".")  # run from the repo root
 
 from tensor2robot_tpu.utils import backend
 
-DATA_DIR = os.environ.get("T2R_E2E_DATA_DIR", "/tmp/t2r_e2e_qtopt")
+# T2R_E2E_FORMAT=jpeg (default) stores jpeg-encoded images (decode on
+# the host, smallest records); =raw stores pre-extracted uint8 planes
+# (`is_extracted` specs — no decode, the reference's pod-scale feed
+# option). On a 1-core host the jpeg path is decode-bound; raw shows the
+# pipeline's rate without that single-core floor.
+FORMAT = os.environ.get("T2R_E2E_FORMAT", "jpeg")
+DATA_DIR = os.environ.get("T2R_E2E_DATA_DIR",
+                          f"/tmp/t2r_e2e_qtopt_{FORMAT}")
 IMAGE_SIZE = 472
 BATCH_SIZE = 64
 NUM_SHARDS = 4
@@ -45,16 +52,35 @@ def _model(device_platform: str):
       use_bfloat16=device_platform != "cpu", use_ema=True)
 
 
+def _wire_specs(model):
+  """The generator/writer wire specs for the chosen FORMAT."""
+  from tensor2robot_tpu import modes, specs as specs_lib
+
+  features = specs_lib.flatten_spec_structure(
+      model.preprocessor.get_in_feature_specification(modes.TRAIN))
+  labels = specs_lib.flatten_spec_structure(
+      model.preprocessor.get_in_label_specification(modes.TRAIN))
+  if FORMAT == "raw":
+    out = specs_lib.SpecStruct()
+    for key, spec in features.items():
+      out[key] = (spec.replace(is_extracted=True)
+                  if spec.is_image else spec)
+    features = out
+  return features, labels
+
+
 def gen(num_examples: int = 512) -> None:
   """Writes `num_examples` wire-format records (no TPU, no jax devices)."""
   import numpy as np
 
-  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu import specs as specs_lib
   from tensor2robot_tpu.data import codec, tfrecord
 
   model = _model("cpu")
-  in_features = model.preprocessor.get_in_feature_specification(modes.TRAIN)
-  in_labels = model.preprocessor.get_in_label_specification(modes.TRAIN)
+  in_features, in_labels = _wire_specs(model)
+  # _wire_specs returns flat SpecStructs; merge once outside the loop.
+  all_specs = specs_lib.SpecStruct(
+      {**dict(in_features.items()), **dict(in_labels.items())})
   os.makedirs(DATA_DIR, exist_ok=True)
   rng = np.random.RandomState(0)
   per_shard = -(-num_examples // NUM_SHARDS)
@@ -68,27 +94,31 @@ def gen(num_examples: int = 512) -> None:
                                                seed=seed)
         labels = specs_lib.make_random_numpy(in_labels, batch_size=None,
                                              seed=seed + 1)
-        record = codec.encode_example(
-            {**dict(specs_lib.flatten_spec_structure(features).items()),
-             **dict(specs_lib.flatten_spec_structure(labels).items())},
-            specs_lib.SpecStruct(
-                {**dict(specs_lib.flatten_spec_structure(in_features)),
-                 **dict(specs_lib.flatten_spec_structure(in_labels))}))
+        values = {**dict(specs_lib.flatten_spec_structure(features).items()),
+                  **dict(specs_lib.flatten_spec_structure(labels).items())}
+        # codec routes is_extracted specs to raw bytes automatically.
+        record = codec.encode_example(values, all_specs)
         writer.write(record)
         written += 1
-  print(f"gen: wrote {written} examples ({IMAGE_SIZE}x{IMAGE_SIZE} jpeg) "
-        f"to {DATA_DIR}/train-*")
+  print(f"gen: wrote {written} examples ({IMAGE_SIZE}x{IMAGE_SIZE} "
+        f"{FORMAT}) to {DATA_DIR}/train-*")
 
 
 def _pipeline_iter(model, batch_size: int):
-  from tensor2robot_tpu import modes, train_eval
+  from tensor2robot_tpu import modes
   from tensor2robot_tpu.data import input_generators
+
+  import jax
 
   generator = input_generators.DefaultRecordInputGenerator(
       file_patterns=os.path.join(DATA_DIR, "train-*"),
       batch_size=batch_size, shuffle_buffer_size=128, seed=0)
-  train_eval.provide_input_generator_with_model_information(
-      generator, model, modes.TRAIN)
+  features, labels = _wire_specs(model)
+  generator.set_specification(features, labels)
+  generator.set_preprocess_fn(model.preprocessor.preprocess)
+  # Per-host file sharding, as train_eval.py wires it: a no-op on this
+  # single-host window, load-bearing the day this runs on a pod.
+  generator.set_process_info(jax.process_index(), jax.process_count())
   return generator.create_dataset(modes.TRAIN)
 
 

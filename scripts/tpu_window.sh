@@ -37,8 +37,12 @@ run python scripts/tpu_step_tuning.py remat 64
 run python scripts/tpu_step_tuning.py remat 128
 # 4. End-to-end input pipeline: TFRecords -> native parse/decode ->
 #    DevicePrefetcher -> train step (gen is CPU-only and idempotent).
+#    jpeg = decode-bound on this 1-core host; raw = is_extracted planes
+#    (the pod-scale feed option, no decode).
 run python scripts/tpu_e2e_pipeline.py gen 512
 run python scripts/tpu_e2e_pipeline.py run 30
+run env T2R_E2E_FORMAT=raw python scripts/tpu_e2e_pipeline.py gen 256
+run env T2R_E2E_FORMAT=raw python scripts/tpu_e2e_pipeline.py run 30
 # 5. Profiler trace last (largest artifact, least critical).
 run python scripts/tpu_step_tuning.py profile
 date | tee -a "$OUT"

@@ -54,6 +54,36 @@ def decode_image(data: bytes, channels: Optional[int] = None) -> np.ndarray:
 def set_feature(feature: "example_pb2.Feature", value: Any,
                 spec: Optional[specs_lib.TensorSpec] = None) -> None:
   """Fills one Feature message from a numpy value according to its spec."""
+  if spec is not None and spec.is_extracted:
+    # Pre-extracted planes ship as raw bytes (np.frombuffer on parse) —
+    # never re-encoded, whatever data_format says about the origin. The
+    # wire dtype must match what the parser will frombuffer with: the
+    # spec dtype, except bfloat16 which rides the wire as float32
+    # (parsing._plan_for's TPU infeed dtype policy).
+    if isinstance(value, bytes):
+      feature.bytes_list.value.append(value)
+    else:
+      wire_dtype = spec.dtype
+      if wire_dtype == specs_lib._canonical_dtype("bfloat16"):
+        wire_dtype = np.dtype(np.float32)
+      if np.dtype(wire_dtype).kind in "SUO" or np.dtype(wire_dtype).itemsize == 0:
+        # String/object planes: one bytes value per item, payloads
+        # untouched (a numpy unicode cast would put UTF-32 on the wire;
+        # null-padded 'S' arrays would corrupt ragged payloads).
+        if isinstance(value, np.ndarray):
+          items = value.reshape(-1).tolist()
+        elif isinstance(value, (list, tuple)):
+          items = value
+        else:
+          items = [value]
+        for item in items:
+          feature.bytes_list.value.append(
+              item.encode("utf-8") if isinstance(item, str) else bytes(item))
+        return
+      feature.bytes_list.value.append(
+          np.ascontiguousarray(np.asarray(value, dtype=wire_dtype))
+          .tobytes())
+    return
   if spec is not None and spec.is_image:
     if isinstance(value, bytes):
       feature.bytes_list.value.append(value)

@@ -22,6 +22,7 @@ overlaps with TPU compute (SURVEY.md §7 hard parts).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -30,6 +31,18 @@ from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import codec, example_pb2
 
 __all__ = ["create_parse_fn", "ParseFn"]
+
+# Native-path bytes-value capacity for is_extracted raw planes: planes
+# split across more values than this re-parse on the Python path (the
+# native parser stores at most `cap` values per feature), with a logged
+# warning when that permanently disables the fast path for the stream.
+_EXTRACTED_VALUE_CAP = 4
+
+
+class _NativeFormatMismatch(Exception):
+  """Wire data the native columnar parser cannot surface (e.g. a raw
+  plane stored as float_list by legacy writers): retry on the Python
+  path, which parses any wire kind."""
 
 
 @dataclasses.dataclass
@@ -135,6 +148,17 @@ def _decode_image_feature(values: Sequence[bytes], plan: _LeafPlan
   return np.stack(imgs).astype(plan.parse_dtype)
 
 
+def _plane_from_values(values: Sequence[bytes],
+                       plan: _LeafPlan) -> np.ndarray:
+  """Raw-bytes tensor payload (e.g. pre-extracted uint8 image planes) —
+  shared by the Python and native paths so value-join semantics cannot
+  diverge. The common single-element case reads zero-copy from the
+  proto bytes; joining would duplicate the whole plane."""
+  buffer = values[0] if len(values) == 1 else b"".join(values)
+  array = np.frombuffer(buffer, dtype=plan.parse_dtype)
+  return _shaped(array, plan, plan.spec.shape)
+
+
 def _parse_leaf_from_feature(feature, plan: _LeafPlan) -> np.ndarray:
   spec = plan.spec
   kind, values = _feature_values(feature)
@@ -155,9 +179,7 @@ def _parse_leaf_from_feature(feature, plan: _LeafPlan) -> np.ndarray:
     array = np.asarray(list(values), dtype=object)
     return array if array.size != 1 else array.reshape(spec.shape or (1,))
   if kind == "bytes_list":
-    # Raw-bytes tensor payload (e.g. pre-extracted uint8 image planes).
-    array = np.frombuffer(b"".join(values), dtype=plan.parse_dtype)
-    return _shaped(array, plan, spec.shape)
+    return _plane_from_values(values, plan)
   return _shaped(values, plan, spec.shape)
 
 
@@ -226,9 +248,10 @@ class ParseFn:
     """Builds the C++ columnar parser when every leaf fits its profile:
     fixed-shape float/int features (context or fixed-T sequence),
     bytes/image features with a static value capacity (single images,
-    multi-image lists, fixed-T image sequences). Optionals, varlen,
-    dynamic time dims, raw-bytes planes and string dtypes take the
-    Python path."""
+    multi-image lists, fixed-T image sequences), fixed-shape
+    `is_extracted` raw planes (one contiguous single-copy batch
+    buffer). Optionals, varlen, dynamic time dims, sequence/string
+    extracted planes and string dtypes take the Python path."""
     if len({p.feature_name for p in plans}) != len(plans):
       # Duplicate wire names (e.g. MAML split subtrees): the native
       # name index is one-to-one, so take the Python path.
@@ -239,7 +262,23 @@ class ParseFn:
       if spec.is_optional or spec.varlen_default_value is not None:
         return None
       if spec.is_extracted:
-        return None  # raw-bytes tensor planes: python path
+        # Pre-extracted raw planes: the wire value is a bytes blob. The
+        # declared byte size makes the wrapper return the whole batch as
+        # one contiguous buffer (single memmove per record) when every
+        # record carries exactly one full-size value; planes split
+        # across a few bytes values (cap 4, Python-path value-joining
+        # parity) take the per-value path. Sequences, dynamic shapes and
+        # non-numeric dtypes keep the Python path (frombuffer cannot
+        # read strings/objects).
+        if (spec.is_sequence or any(d is None for d in spec.shape)
+            or plan.parse_dtype.kind in "SUO"
+            or plan.parse_dtype.itemsize == 0):
+          return None
+        nbytes = (int(np.prod(spec.shape, dtype=np.int64))
+                  * plan.parse_dtype.itemsize)
+        native_plan.append(
+            (plan.feature_name, 2, nbytes, False, 0, _EXTRACTED_VALUE_CAP))
+        continue
       if spec.is_image:
         # Only the dims that size native buffers must be concrete: the
         # time dim for sequences and the leading N of multi-image lists.
@@ -295,6 +334,32 @@ class ParseFn:
     out: Dict[str, np.ndarray] = {}
     for i, plan in enumerate(plans):
       spec = plan.spec
+      if spec.is_extracted:
+        planes_buf = parsed["bytes_planes"].get(i)
+        if planes_buf is not None:
+          # Contiguous single-copy path: the wrapper already memmoved
+          # each full-size plane into one [batch, nbytes] buffer —
+          # viewing/reshaping here costs nothing further.
+          out[plan.out_key] = planes_buf.view(plan.parse_dtype).reshape(
+              (batch,) + tuple(spec.shape))
+          continue
+        counts = parsed["bytes_counts"][i]
+        if int(counts.max(initial=0)) > _EXTRACTED_VALUE_CAP:
+          # The native parser stored only the first CAP values; the
+          # Python path joins any number, so re-parse there.
+          raise _NativeFormatMismatch(plan.feature_name)
+        planes = []
+        for values in parsed["bytes"][i]:
+          if not values:
+            # No bytes_list on the wire: legacy writers stored numeric
+            # planes as float_list/int64_list, which the columnar
+            # parser cannot surface — re-parse on the Python path.
+            raise _NativeFormatMismatch(plan.feature_name)
+          # Python-path parity via the shared helper (multiple values
+          # concatenate; single values read without a join copy).
+          planes.append(_plane_from_values(values, plan))
+        out[plan.out_key] = np.stack(planes)
+        continue
       if spec.is_image and not spec.is_extracted:
         if spec.is_sequence:
           step_plan = _LeafPlan(plan.out_key, plan.feature_name,
@@ -386,8 +451,24 @@ class ParseFn:
       raise ValueError(f"Dataset batch sizes differ: {batch_sizes}")
     for dkey, serialized_list in records.items():
       if self._native_parsers.get(dkey) is not None:
-        batched.update(self._parse_batch_native(dkey, serialized_list))
-        continue
+        try:
+          batched.update(self._parse_batch_native(dkey, serialized_list))
+          continue
+        except _NativeFormatMismatch as mismatch:
+          # Legacy wire kind (e.g. float_list plane) or over-cap value
+          # splits: the Python path parses any wire format. The dataset
+          # evidently carries that format throughout — disable the
+          # native parser so later batches skip the wasted native pass.
+          # Loud: the Python path is orders of magnitude slower, and a
+          # silent downgrade would be undiagnosable.
+          logging.warning(
+              "Native columnar parser disabled for dataset %r: feature "
+              "%s uses a wire format it cannot surface (legacy "
+              "float_list/int64_list plane, or a plane split across >%d "
+              "bytes values). Falling back to the Python parser for the "
+              "rest of this stream — expect much lower host throughput.",
+              dkey, mismatch, _EXTRACTED_VALUE_CAP)
+          self._native_parsers[dkey] = None
       plans = self._plans[dkey]
       is_sequence = self._sequence_datasets[dkey]
       for serialized in serialized_list:

@@ -188,10 +188,18 @@ class BatchExampleParser:
   SequenceExample feature lists (short sequences zero-pad, long ones
   clip); `cap` is the stored value capacity for bytes features (1 for a
   single image, N for multi-image lists, == seq_len for image sequences).
+  For context bytes, `size` > 0 declares a fixed-size raw plane: when
+  every record carries exactly one value of that byte length, the batch
+  is returned as ONE contiguous [batch, size] uint8 buffer filled by a
+  single memmove per record straight from the parser's slices (the
+  per-record bytes-object path would copy twice); otherwise the entry
+  falls back to the per-record value lists.
 
   `parse` returns a dict:
     float/int: {plan index: np array [batch, size] or [batch, T, size]},
-    bytes:     {plan index: per-record lists of bytes values},
+    bytes:     {plan index: per-record lists of bytes values, or None
+                when bytes_planes took the entry},
+    bytes_planes: {plan index: contiguous uint8 [batch, size] or None},
     bytes_counts / step_counts: {plan index: np.int64 [batch]}.
   """
 
@@ -255,8 +263,8 @@ class BatchExampleParser:
     len_array = (ctypes.c_int64 * batch)(*[len(r) for r in records])
     float_outs = (ctypes.c_void_p * n)()
     int_outs = (ctypes.c_void_p * n)()
-    out = {"float": {}, "int": {}, "bytes": {}, "bytes_counts": {},
-           "step_counts": {}}
+    out = {"float": {}, "int": {}, "bytes": {}, "bytes_planes": {},
+           "bytes_counts": {}, "step_counts": {}}
     for i, (name, kind, size, _, seq_len, _) in enumerate(self._plan):
       shape = (batch, seq_len, size) if seq_len > 0 else (batch, size)
       if kind == KIND_FLOAT:
@@ -279,10 +287,30 @@ class BatchExampleParser:
       lens = self._lib.t2r_parser_bytes_lens(self._handle)
       counts = self._lib.t2r_parser_bytes_counts(self._handle)
       slot = 0
-      for i, (name, kind, _, _, seq_len, _) in enumerate(self._plan):
+      for i, (name, kind, size, _, seq_len, _) in enumerate(self._plan):
         if kind != KIND_BYTES:
           continue
         cap, offset = self._caps[i], self._caps_offset[i]
+        if size > 0 and seq_len == 0:
+          # Raw-plane single-copy path: every record has exactly one
+          # value of the declared byte length -> one contiguous buffer,
+          # one memmove per record from the parse slices (still under
+          # the lock, before the next parse invalidates them).
+          contiguous = all(
+              counts[r * self._num_bytes + slot] == 1
+              and lens[r * self._total_caps + offset] == size
+              for r in range(batch))
+          if contiguous:
+            dest = np.empty((batch, size), np.uint8)
+            base = dest.ctypes.data
+            for r in range(batch):
+              ctypes.memmove(base + r * size,
+                             ptrs[r * self._total_caps + offset], size)
+            out["bytes_planes"][i] = dest
+            out["bytes"][i] = None
+            out["bytes_counts"][i] = np.ones((batch,), np.int64)
+            slot += 1
+            continue
         per_record = []
         count_arr = np.zeros((batch,), np.int64)
         for r in range(batch):

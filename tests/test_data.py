@@ -134,6 +134,32 @@ class TestCodecAndParsing:
     with pytest.raises(ValueError, match="present in only 1/2"):
       parsing.create_parse_fn(spec).parse_batch([with_opt, without_opt])
 
+  def test_extracted_plane_wire_dtype_normalized(self):
+    """The writer casts extracted values to the parser's wire dtype —
+    an int array fed to a float32 extracted spec must round-trip as
+    VALUES, never a bit-reinterpretation."""
+    spec = SpecStruct({
+        "plane": TensorSpec(shape=(3,), dtype=np.float32, name="plane",
+                            data_format="jpeg", is_extracted=True)})
+    record = codec.encode_example(
+        {"plane": np.array([1, 2, 3], np.int32)}, spec)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    np.testing.assert_allclose(out["features/plane"][0], [1.0, 2.0, 3.0])
+
+  def test_extracted_plane_bfloat16_roundtrip(self):
+    """bfloat16 extracted specs ride the wire as float32 (the parser's
+    infeed dtype policy) and cast at the end — the writer must match."""
+    import ml_dtypes
+    spec = SpecStruct({
+        "plane": TensorSpec(shape=(2, 2), dtype="bfloat16", name="plane",
+                            data_format="jpeg", is_extracted=True)})
+    values = np.array([[0.5, 1.5], [-2.0, 4.0]], np.float32)
+    record = codec.encode_example({"plane": values}, spec)
+    out = parsing.create_parse_fn(spec).parse_batch([record])
+    assert out["features/plane"].dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_allclose(
+        np.asarray(out["features/plane"][0], np.float32), values)
+
   def test_bfloat16_spec_parses_and_casts(self):
     import ml_dtypes
     spec = SpecStruct({"x": TensorSpec(shape=(2,), dtype="bfloat16")})
