@@ -11,12 +11,22 @@ cd "$(dirname "$0")/.."
 OUT="${1:-tpu_window_results.txt}"
 
 run() {
+  # Resume support: items that already completed in an earlier (partial)
+  # window are skipped, so a re-run after a mid-plan wedge finishes the
+  # REMAINING items instead of re-exposing the tunnel to captured ones.
+  if [ -f "$OUT" ] && grep -qxF "=== DONE: $* ===" "$OUT"; then
+    echo "skip (already captured): $*"
+    return 0
+  fi
   echo "=== $* ===" | tee -a "$OUT"
   "$@" 2>&1 | grep -v -E "^WARNING|^I0|^W0|^E0" | tee -a "$OUT"
   rc=${PIPESTATUS[0]}
   if [ "$rc" -eq 2 ]; then
     echo "TUNNEL DOWN — stopping the window plan" | tee -a "$OUT"
     exit 2
+  fi
+  if [ "$rc" -eq 0 ]; then
+    echo "=== DONE: $* ===" >> "$OUT"
   fi
   echo >> "$OUT"
 }
