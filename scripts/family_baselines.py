@@ -1,0 +1,128 @@
+"""Measured train-step baselines for the five driver research configs.
+
+BASELINE.md requires the framework to establish and COMMIT its own
+measured per-chip baselines (steps/sec, examples/sec) for: pose_env,
+QT-Opt critic, BC-Z, Grasp2Vec, VRGripper MDN — plus the MAML config
+(inner+outer step). Models are built FROM the shipped gin configs
+(train_eval_model.model resolved by the config engine), so the numbers
+measure exactly what `bin/run_t2r_trainer.py --config_files <gin>`
+trains.
+
+Usage (each a separate short process; see PERFORMANCE.md tunnel rules):
+
+  python scripts/family_baselines.py cpu            # f32 CPU smoke
+  python scripts/family_baselines.py tpu            # all families
+  python scripts/family_baselines.py tpu bcz_resnet_film  # one family
+                                   # (short single-purpose process, the
+                                   # tunnel-friendly shape tpu_window.sh
+                                   # uses — one compile per process)
+
+`tpu` probes tunnel health first and exits 2 when down (tpu_window.sh
+stops cleanly). Results: one JSON line per family on stdout.
+"""
+
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from tensor2robot_tpu.utils import backend
+
+CONFIG_ROOT = "tensor2robot_tpu/research"
+
+# (name, config file, extra CPU-mode bindings: f32 + cpu device — the
+# configs themselves are written for the TPU target). Batch size comes
+# from the config's own DefaultRandomInputGenerator.batch_size binding
+# so the measurement cannot drift from what the trainer trains.
+FAMILIES = [
+    ("pose_env", "pose_env/configs/train_pose_regression.gin", []),
+    ("qtopt_grasping44", "qtopt/configs/train_qtopt.gin", [
+        "QTOptModel.device_type = 'cpu'",
+        "QTOptModel.use_bfloat16 = False",
+    ]),
+    ("bcz_resnet_film", "bcz/configs/train_bcz.gin", [
+        "BCZModel.device_type = 'cpu'",
+        "BCZModel.use_bfloat16 = False",
+    ]),
+    ("grasp2vec", "grasp2vec/configs/train_grasp2vec.gin", [
+        "Grasp2VecModel.device_type = 'cpu'",
+    ]),
+    ("vrgripper_mdn", "vrgripper/configs/train_vrgripper_mdn.gin", [
+        "VRGripperRegressionModel.device_type = 'cpu'",
+    ]),
+    ("maml_pose_env", "pose_env/configs/train_pose_maml.gin", []),
+]
+
+
+def measure_family(name, config_file, overrides, on_tpu, steps):
+  import jax
+
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.utils import config
+
+  config.clear_config()
+  config.parse_config_file(f"{CONFIG_ROOT}/{config_file}")
+  if not on_tpu:
+    config.parse_config("\n".join(overrides))
+  model = config.query_parameter("train_eval_model.model")
+  batch_size = int(config.query_parameter(
+      "DefaultRandomInputGenerator.batch_size"))
+  device = jax.devices()[0]
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  features = jax.device_put(features, device)
+  labels = jax.device_put(labels, device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  step = ts.make_train_step(model)
+  sec, _ = backend.time_train_steps(step, state, features, labels,
+                                    iters=steps, warmup=2)
+  print(json.dumps({
+      "family": name,
+      "config": config_file,
+      "device": device.device_kind if on_tpu else "cpu_smoke_f32",
+      "batch_size": batch_size,
+      "ms_per_step": round(sec * 1e3, 2),
+      "steps_per_sec": round(1.0 / sec, 2),
+      "examples_per_sec": round(batch_size / sec, 2),
+  }), flush=True)
+
+
+def main():
+  mode = sys.argv[1] if len(sys.argv) > 1 else "cpu"
+  only = sys.argv[2] if len(sys.argv) > 2 else None
+  families = [f for f in FAMILIES if only is None or f[0] == only]
+  if not families:
+    raise SystemExit(f"unknown family {only!r}; "
+                     f"choose from {[f[0] for f in FAMILIES]}")
+  if mode == "tpu":
+    if not backend.accelerator_healthy(timeout=90):
+      print("tunnel unhealthy; refusing to run (would hang)", flush=True)
+      sys.exit(2)
+    if only is None:
+      # Tunnel discipline: one compile per short process. Fan each
+      # family out as its own subprocess instead of holding one TPU
+      # client across six compiles (a mid-way wedge would lose the
+      # remaining families; see PERFORMANCE.md incident rules).
+      import subprocess
+
+      for family in FAMILIES:
+        rc = subprocess.call(
+            [sys.executable, __file__, "tpu", family[0]])
+        if rc == 2:
+          sys.exit(2)
+      return
+    on_tpu, steps = True, 20
+  else:
+    backend.pin_cpu()
+    on_tpu, steps = False, 5
+  for name, config_file, overrides in families:
+    measure_family(name, config_file, overrides, on_tpu, steps)
+
+
+if __name__ == "__main__":
+  main()

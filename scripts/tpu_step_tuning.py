@@ -19,7 +19,6 @@ Phases:
              (inspect with tensorboard --logdir profiles/).
 """
 import sys
-import time
 
 sys.path.insert(0, ".")  # run from the repo root
 
@@ -58,20 +57,10 @@ def _setup(batch_size, remat=False):
   return jax, state, step, features, labels
 
 
-def _barrier(jax, state):
-  return backend.sync(
-      min(jax.tree_util.tree_leaves(state.params), key=lambda a: a.size))
-
-
 def _step_time(jax, state, step, features, labels, iters=20):
-  for _ in range(3):
-    state, _ = step(state, features, labels)
-  _barrier(jax, state)
-  t0 = time.perf_counter()
-  for _ in range(iters):
-    state, _ = step(state, features, labels)
-  _barrier(jax, state)
-  return (time.perf_counter() - t0) / iters, state
+  del jax  # kept for call-site signature stability
+  return backend.time_train_steps(step, state, features, labels,
+                                  iters=iters)
 
 
 def roofline(batch_size=64):
@@ -125,7 +114,7 @@ def profile(batch_size):
   with jax.profiler.trace("profiles"):
     for _ in range(5):
       state, _ = step(state, features, labels)
-    _barrier(jax, state)
+    backend.state_barrier(state)
   print(f"trace written to profiles/ (step ~{sec * 1e3:.1f} ms); view "
         f"with: tensorboard --logdir profiles")
 

@@ -53,7 +53,15 @@ run python scripts/tpu_e2e_pipeline.py gen 512
 run python scripts/tpu_e2e_pipeline.py run 30
 run env T2R_E2E_FORMAT=raw python scripts/tpu_e2e_pipeline.py gen 256
 run env T2R_E2E_FORMAT=raw python scripts/tpu_e2e_pipeline.py run 30
-# 5. Profiler trace last (largest artifact, least critical).
+# 5. Committed per-family baselines (BASELINE.md: steps/sec per chip
+#    for the five driver configs + MAML), one short process each.
+run python scripts/family_baselines.py tpu pose_env
+run python scripts/family_baselines.py tpu qtopt_grasping44
+run python scripts/family_baselines.py tpu bcz_resnet_film
+run python scripts/family_baselines.py tpu grasp2vec
+run python scripts/family_baselines.py tpu vrgripper_mdn
+run python scripts/family_baselines.py tpu maml_pose_env
+# 6. Profiler trace last (largest artifact, least critical).
 run python scripts/tpu_step_tuning.py profile
 date | tee -a "$OUT"
 echo "window complete: results in $OUT"

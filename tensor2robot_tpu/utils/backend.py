@@ -81,6 +81,37 @@ def sync(x):
   return np.asarray(x)
 
 
+def state_barrier(state):
+  """Tunnel-safe completion barrier for a TrainState: host-fetches the
+  smallest param leaf (cheapest transfer; params depend on the full
+  forward+backward+update, unlike the loss, which does not depend on the
+  final step's optimizer/EMA update). See ``sync`` for why
+  ``block_until_ready`` is not sufficient here."""
+  import jax
+
+  return sync(min(jax.tree_util.tree_leaves(state.params),
+                  key=lambda a: a.size))
+
+
+def time_train_steps(step, state, features, labels, iters,
+                     warmup: int = 3):
+  """Times ``step(state, features, labels)`` with the tunnel-safe
+  barrier discipline (warmup → barrier → timed loop → barrier); returns
+  ``(seconds_per_step, final_state)``. The one shared implementation for
+  bench/tuning/baseline scripts, so a future change to the barrier
+  recipe lands everywhere at once."""
+  import time
+
+  for _ in range(warmup):
+    state, _ = step(state, features, labels)
+  state_barrier(state)
+  start = time.perf_counter()
+  for _ in range(iters):
+    state, _ = step(state, features, labels)
+  state_barrier(state)
+  return (time.perf_counter() - start) / iters, state
+
+
 def accelerator_healthy(timeout: float = 120.0) -> bool:
   """True iff a non-CPU backend initializes in a fresh subprocess.
 

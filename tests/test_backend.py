@@ -91,3 +91,36 @@ def test_accelerator_healthy_timeout_never_sigkills(monkeypatch):
 def test_assert_cpu_backend_passes_here():
   # conftest pinned this process to CPU, so the live backend is CPU.
   backend.assert_cpu_backend()
+
+
+def test_time_train_steps_runs_warmup_plus_iters_with_barriers():
+  """The shared timing helper executes warmup+iters steps and fetches a
+  param leaf as the barrier (the tunnel-safe discipline every bench/
+  tuning script must share)."""
+  import numpy as np
+
+  calls = []
+
+  class _State:
+    params = {"w": np.zeros(3), "b": np.zeros(1)}
+
+  def step(state, features, labels):
+    calls.append((features, labels))
+    return state, {}
+
+  sec, out = backend.time_train_steps(step, _State(), "f", "l",
+                                      iters=4, warmup=2)
+  assert len(calls) == 6
+  assert calls[0] == ("f", "l")
+  assert sec >= 0
+  assert isinstance(out, _State)
+
+
+def test_state_barrier_fetches_smallest_param_leaf():
+  import numpy as np
+
+  class _State:
+    params = {"big": np.arange(8.0), "small": np.array([7.0])}
+
+  fetched = backend.state_barrier(_State())
+  np.testing.assert_array_equal(fetched, [7.0])
