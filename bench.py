@@ -29,7 +29,6 @@ the TPU number, only to itself across rounds.
 from __future__ import annotations
 
 import json
-import time
 
 import numpy as np
 
@@ -37,7 +36,8 @@ from tensor2robot_tpu.utils import backend as backend_lib
 
 BASELINE_PER_CHIP = 400.0  # est. V100-class grasps/sec/device (see docstring)
 BATCH_SIZE = 64
-IMAGE_SIZE = 472
+# Network/image-size config lives in research/qtopt/flagship.py (shared
+# with the tuning/latency scripts so all measurements time one network).
 WARMUP_STEPS = 3
 MEASURE_STEPS = 50
 # Peak dense bf16 FLOP/s per chip for the MFU denominator. v5e public
@@ -62,21 +62,16 @@ def main() -> None:
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts
-  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+  from tensor2robot_tpu.research.qtopt import flagship
 
   device = jax.devices()[0]
   on_tpu = device.platform != "cpu"
   measure_steps = MEASURE_STEPS if on_tpu else 5
-  image_size = IMAGE_SIZE if on_tpu else 32  # CPU smoke only
 
   def make_model(remat: bool = False):
-    return qtopt_models.QTOptModel(
-        image_size=image_size, device_type=device.platform,
-        network="grasping44" if on_tpu else "small",
-        action_size=5 if on_tpu else 4,
-        grasp_param_names=({"world_vector": (0, 3),
-                            "vertical_rotation": (3, 2)} if on_tpu else None),
-        use_bfloat16=on_tpu, use_ema=True, remat=remat)
+    # The one shared flagship config (research/qtopt/flagship.py) so the
+    # bench, tuning and latency scripts all time the SAME network.
+    return flagship.make_flagship_model(device.platform, remat=remat)
 
   def measure(batch_size: int, remat: bool = False):
     """Returns (examples/sec, flops/step, bytes/step) for the train step."""
@@ -112,23 +107,16 @@ def main() -> None:
       print(f"bench: AOT cost analysis unavailable "
             f"({type(e).__name__}: {e}); efficiency fields will be null",
             file=sys.stderr)
-    # backend_lib.sync (a host fetch) is the completion barrier:
-    # block_until_ready returns early over the axon tunnel (backend.py).
-    # The barrier leaf is a param (not the loss): the loss does not depend
-    # on the final step's backward/optimizer/EMA update. Smallest leaf =
-    # cheapest transfer; the ~0.1 s fetch round-trip is amortized over
-    # measure_steps and biases throughput slightly LOW (conservative).
-    barrier = lambda s: backend_lib.sync(
-        min(jax.tree_util.tree_leaves(s.params), key=lambda a: a.size))
-    for _ in range(WARMUP_STEPS):
-      state, _ = step(state, features, labels)
-    barrier(state)
-    start = time.perf_counter()
-    for _ in range(measure_steps):
-      state, _ = step(state, features, labels)
-    barrier(state)
-    return (measure_steps * batch_size / (time.perf_counter() - start),
-            flops, bytes_accessed)
+    # backend_lib.time_train_steps is the one shared tunnel-safe timing
+    # recipe: warmup -> host-fetch barrier on the smallest param leaf
+    # (block_until_ready returns early over the axon tunnel; the loss
+    # does not depend on the final step's optimizer/EMA update) ->
+    # timed loop -> barrier. The ~0.1 s fetch round-trip is amortized
+    # over measure_steps and biases throughput slightly LOW.
+    sec, _ = backend_lib.time_train_steps(
+        step, state, features, labels, iters=measure_steps,
+        warmup=WARMUP_STEPS)
+    return batch_size / sec, flops, bytes_accessed
 
   # The bench must emit a number even if the reference-scale config does
   # not fit a particular chip's HBM: halve the batch on RESOURCE_EXHAUSTED

@@ -25,25 +25,17 @@ sys.path.insert(0, ".")  # run from the repo root
 from tensor2robot_tpu.utils import backend
 
 
-IMAGE_SIZE = 472
-NUM_CONVS = (7, 6, 3)  # full Grasping44; reduce for small-image sanity runs
-
-
 def _setup(batch_size, remat=False):
   import jax
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts
-  from tensor2robot_tpu.research.qtopt import models as qtopt_models
+  from tensor2robot_tpu.research.qtopt import flagship
 
   device = jax.devices()[0]
-  model = qtopt_models.QTOptModel(
-      image_size=IMAGE_SIZE, device_type=device.platform,
-      network="grasping44", num_convs=NUM_CONVS, action_size=5,
-      grasp_param_names={"world_vector": (0, 3),
-                         "vertical_rotation": (3, 2)},
-      use_bfloat16=device.platform != "cpu", use_ema=True,
-      remat=remat)  # parallel/train_step.py:203 wraps the fwd in remat
+  # The shared flagship config (research/qtopt/flagship.py) — the same
+  # network bench.py times, so probe numbers compare apples-to-apples.
+  model = flagship.make_flagship_model(device.platform, remat=remat)
   features = specs_lib.make_random_numpy(
       model.preprocessor.get_out_feature_specification(modes.TRAIN),
       batch_size=batch_size, seed=0)

@@ -61,7 +61,10 @@ run python scripts/family_baselines.py tpu bcz_resnet_film
 run python scripts/family_baselines.py tpu grasp2vec
 run python scripts/family_baselines.py tpu vrgripper_mdn
 run python scripts/family_baselines.py tpu maml_pose_env
-# 6. Profiler trace last (largest artifact, least critical).
+# 6. Serving-side: on-device CEM action rate at the reference cost
+#    (64x3, 10 elites) on the reference-scale critic.
+run python scripts/policy_latency.py tpu
+# 7. Profiler trace last (largest artifact, least critical).
 run python scripts/tpu_step_tuning.py profile
 date | tee -a "$OUT"
 echo "window complete: results in $OUT"

@@ -1,0 +1,32 @@
+"""The flagship measurement configuration of the QT-Opt grasping critic.
+
+One shared constructor so every measurement surface (bench.py and the
+TPU window tuning/latency scripts) times the SAME network:
+reference-scale Grasping44 — the 16-conv BN tower (stem + 6+6+3,
+reference /root/reference/research/qtopt/networks.py:299-615) at
+472x472x3 with named grasp-param blocks, bfloat16 compute and EMA —
+exactly what `research/qtopt/configs/train_qtopt.gin` trains. On a CPU
+platform (wedged/absent tunnel) this degrades to the small smoke critic
+with its own honest labeling at the call sites.
+"""
+
+from __future__ import annotations
+
+from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+IMAGE_SIZE = 472
+ACTION_SIZE = 5
+GRASP_PARAM_NAMES = {"world_vector": (0, 3), "vertical_rotation": (3, 2)}
+
+
+def make_flagship_model(device_platform: str, remat: bool = False):
+  """Reference-scale Grasping44 critic on accelerators; small smoke
+  critic on 'cpu'."""
+  on_tpu = device_platform != "cpu"
+  return qtopt_models.QTOptModel(
+      image_size=IMAGE_SIZE if on_tpu else 32,
+      device_type=device_platform,
+      network="grasping44" if on_tpu else "small",
+      action_size=ACTION_SIZE if on_tpu else 4,
+      grasp_param_names=GRASP_PARAM_NAMES if on_tpu else None,
+      use_bfloat16=on_tpu, use_ema=True, remat=remat)
