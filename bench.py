@@ -29,6 +29,7 @@ the TPU number, only to itself across rounds.
 from __future__ import annotations
 
 import json
+import sys
 
 import numpy as np
 
@@ -99,8 +100,6 @@ def main() -> None:
       flops = float(cost.get("flops", float("nan")))
       bytes_accessed = float(cost.get("bytes accessed", float("nan")))
     except Exception as e:  # noqa: BLE001 - efficiency fields are optional
-      import sys
-
       # If .lower()/.compile() itself failed, `step` is still the plain
       # jitted fn; if only cost_analysis failed, it is the (callable)
       # AOT executable. Either way the timing loop below works.
@@ -116,6 +115,12 @@ def main() -> None:
     sec, _ = backend_lib.time_train_steps(
         step, state, features, labels, iters=measure_steps,
         warmup=WARMUP_STEPS)
+    # Per-probe trace on stderr (the JSON contract line stays single):
+    # the window/driver logs then record the whole tuning curve, not
+    # just the winner.
+    print(f"bench: probe batch={batch_size} remat={remat} -> "
+          f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step)",
+          file=sys.stderr)
     return batch_size / sec, flops, bytes_accessed
 
   # The bench must emit a number even if the reference-scale config does
@@ -129,8 +134,6 @@ def main() -> None:
       except Exception as e:  # noqa: BLE001 - retry only on OOM
         if "RESOURCE_EXHAUSTED" not in str(e) or batch_size <= 4:
           raise
-        import sys
-
         print(f"bench: batch {batch_size} OOM; retrying at "
               f"{batch_size // 2}", file=sys.stderr)
         batch_size //= 2
@@ -158,8 +161,6 @@ def main() -> None:
       try:
         bigger, flops2, bytes2 = measure(probe)
       except Exception as e:  # noqa: BLE001 - the last number stands
-        import sys
-
         print(f"bench: batch-{probe} probe failed "
               f"({type(e).__name__}: {e}); keeping batch {batch_size}",
               file=sys.stderr)
@@ -180,8 +181,6 @@ def main() -> None:
         examples_per_sec, use_remat = r_eps, True
         flops, bytes_accessed = r_flops, r_bytes
     except Exception as e:  # noqa: BLE001 - the non-remat number stands
-      import sys
-
       print(f"bench: remat probe failed ({type(e).__name__}: {e}); "
             f"keeping remat=False", file=sys.stderr)
   # Efficiency accounting: achieved model FLOP/s over the device peak
