@@ -77,6 +77,12 @@ class MAMLModel(abstract_model.T2RModel):
     if base_model is None:
       raise ValueError("base_model is required.")
     kwargs.setdefault("device_type", base_model.device_type)
+    # The outer loop owns the real optimizer, so framework optimizer
+    # knobs configured on the base model (e.g. gin binding
+    # gradient_accumulation_steps on it) must carry over — MAML's
+    # create_optimizer delegates to the base's UNwrapped factory.
+    kwargs.setdefault("gradient_accumulation_steps",
+                      base_model.gradient_accumulation_steps)
     super().__init__(**kwargs)
     self._base_model = base_model
     self._num_inner_loop_steps = num_inner_loop_steps

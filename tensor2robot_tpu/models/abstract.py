@@ -78,6 +78,7 @@ class T2RModel(ModelInterface):
                use_ema: bool = False,
                ema_decay: float = 0.9999,
                remat: bool = False,
+               gradient_accumulation_steps: int = 1,
                init_checkpoint: Optional[str] = None,
                init_checkpoint_filter: Optional[Callable[[str], bool]] = None,
                use_summaries: bool = True):
@@ -91,6 +92,13 @@ class T2RModel(ModelInterface):
     # instead of keeping activations live — trades MXU FLOPs for HBM,
     # the standard fit-bigger-batches knob on TPU (jax.checkpoint).
     self._remat = remat
+    # Gradient accumulation: average grads over k micro-batches and
+    # apply every k-th step (optax.MultiSteps) — the other
+    # fit-bigger-effective-batches knob; composes with remat.
+    if gradient_accumulation_steps < 1:
+      raise ValueError("gradient_accumulation_steps must be >= 1, got "
+                       f"{gradient_accumulation_steps}")
+    self._gradient_accumulation_steps = int(gradient_accumulation_steps)
     self._init_checkpoint = init_checkpoint
     self._init_checkpoint_filter = init_checkpoint_filter
     self._use_summaries = use_summaries and device_type != "tpu"
@@ -181,9 +189,30 @@ class T2RModel(ModelInterface):
 
   def create_optimizer(self) -> optax.GradientTransformation:
     """Optax chain; gin-injected factory wins (reference create_optimizer +
-    MovingAverage wrapping, abstract_model.py:836-871)."""
+    MovingAverage wrapping, abstract_model.py:836-871). Subclasses may
+    override; the train-step factories consume `build_optimizer`, which
+    applies framework wrappers on top of whatever this returns."""
     fn = self._optimizer_fn or optimizers_lib.create_adam_optimizer
     return fn()
+
+  def build_optimizer(self) -> optax.GradientTransformation:
+    """`create_optimizer` plus framework wrappers — the method the step
+    factories call. Do NOT override this one (override create_optimizer
+    instead), or subclass optimizer choices would silently drop the
+    wrappers. With `gradient_accumulation_steps=k`, gradients average
+    over k micro-batch steps and apply on every k-th
+    (optax.MultiSteps): k steps at batch B train exactly like one step
+    at batch k*B for linear-in-grad optimizers, without holding k*B
+    activations."""
+    optimizer = self.create_optimizer()
+    if self._gradient_accumulation_steps > 1:
+      optimizer = optax.MultiSteps(
+          optimizer, every_k_schedule=self._gradient_accumulation_steps)
+    return optimizer
+
+  @property
+  def gradient_accumulation_steps(self) -> int:
+    return self._gradient_accumulation_steps
 
   # -- functional init / apply ---------------------------------------------
 

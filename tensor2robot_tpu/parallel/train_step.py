@@ -64,6 +64,15 @@ def _split_variables(variables: Mapping) -> Tuple[Any, Dict]:
   return params, mutable
 
 
+def _optimizer_for(model):
+  """The optimizer the step actually uses: `build_optimizer` (framework
+  wrappers, e.g. gradient accumulation) when the model provides it —
+  subclasses override `create_optimizer`, so calling that directly here
+  would silently drop the wrappers."""
+  builder = getattr(model, "build_optimizer", None)
+  return builder() if builder is not None else model.create_optimizer()
+
+
 def fsdp_rules(axis: str = "fsdp") -> PartitionRules:
   """Default FSDP rules: shard the largest dim of every >=2D param over
   the fsdp axis (applied only where divisible)."""
@@ -132,7 +141,7 @@ def create_train_state(model,
   With a mesh, init runs under jit with out_shardings so large params are
   *born sharded* — never materialized replicated on one device.
   """
-  optimizer = model.create_optimizer()
+  optimizer = _optimizer_for(model)
 
   def _init(rng, features):
     init_rng, state_rng = jax.random.split(rng)
@@ -177,7 +186,8 @@ def make_train_step(model,
   features/labels — e.g. PartitionSpec('data', 'sp') commits sequence
   batches [B, T, ...] sharded over BOTH the data and sequence-parallel
   axes at infeed (models expose it via `batch_partition_spec`)."""
-  optimizer = model.create_optimizer()
+  optimizer = _optimizer_for(model)
+  accum_steps = int(getattr(model, "gradient_accumulation_steps", 1) or 1)
   ema_decay = model.ema_decay
   # Multi-task gradient surgery (QT-Opt PCGrad,
   # /root/reference/research/qtopt/pcgrad.py): when the model exposes
@@ -247,9 +257,21 @@ def make_train_step(model,
     new_params = optax.apply_updates(state.params, updates)
     new_ema = state.ema_params
     if new_ema is not None:
-      new_ema = jax.tree_util.tree_map(
-          lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
-          new_ema, new_params)
+      if accum_steps > 1:
+        # Under gradient accumulation the EMA must move once per APPLIED
+        # update, not per micro-step — otherwise the effective decay is
+        # decay^k and eval/export EMA params diverge from an equivalent
+        # large-batch run. MultiSteps resets mini_step to 0 on apply.
+        applied = new_opt_state.mini_step == 0
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: jnp.where(applied,
+                                   e * ema_decay + (1.0 - ema_decay) * p,
+                                   e),
+            new_ema, new_params)
+      else:
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
+            new_ema, new_params)
     new_state = state.replace(
         step=state.step + 1,
         params=new_params,

@@ -334,3 +334,141 @@ class TestMixedPrecision:
       state, metrics = step(state, b["features"], b["labels"])
       first = first if first is not None else float(metrics["loss"])
     assert float(metrics["loss"]) < first * 0.5
+
+
+class TestGradientAccumulation:
+  """`gradient_accumulation_steps=k` (optax.MultiSteps, applied by
+  `build_optimizer` so subclass `create_optimizer` overrides keep it):
+  k micro-batch steps at batch B must train exactly like one step at
+  batch k*B — the fit-bigger-effective-batches knob that does not hold
+  k*B activations."""
+
+  def _params(self, state):
+    return jax.device_get(state.params)
+
+  def test_two_micro_steps_match_one_large_batch_step(self):
+    import optax
+
+    def make(accum):
+      # No batch norm: BN stats are per-micro-batch by construction and
+      # would (correctly) differ from the large-batch stats.
+      return mocks.MockT2RModel(
+          use_batch_norm=False, device_type="cpu",
+          optimizer_fn=lambda: optax.sgd(0.1),
+          gradient_accumulation_steps=accum)
+
+    gen = mocks.MockInputGenerator(batch_size=16)
+    gen.set_specification_from_model(make(1), modes.TRAIN)
+    batch = next(gen.create_dataset(modes.TRAIN))
+    features, labels = batch["features"], batch["labels"]
+    half = lambda tree, s: jax.tree_util.tree_map(lambda x: x[s], tree)
+
+    accum_model = make(2)
+    a_state, _ = ts.create_train_state(
+        accum_model, jax.random.PRNGKey(0), half(features, slice(0, 8)))
+    a_step = ts.make_train_step(accum_model, donate=False)
+    before = self._params(a_state)
+    a_state, _ = a_step(a_state, half(features, slice(0, 8)),
+                        half(labels, slice(0, 8)))
+    # First micro-step only accumulates: params must be untouched.
+    for p0, p1 in zip(jax.tree_util.tree_leaves(before),
+                      jax.tree_util.tree_leaves(self._params(a_state))):
+      np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+    a_state, _ = a_step(a_state, half(features, slice(8, 16)),
+                        half(labels, slice(8, 16)))
+
+    big_model = make(1)
+    b_state, _ = ts.create_train_state(
+        big_model, jax.random.PRNGKey(0), features)
+    b_step = ts.make_train_step(big_model, donate=False)
+    b_state, _ = b_step(b_state, features, labels)
+
+    for pa, pb in zip(jax.tree_util.tree_leaves(self._params(a_state)),
+                      jax.tree_util.tree_leaves(self._params(b_state))):
+      np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                 atol=1e-6)
+
+  def test_invalid_accumulation_raises(self):
+    with pytest.raises(ValueError, match="gradient_accumulation_steps"):
+      mocks.MockT2RModel(device_type="cpu",
+                         gradient_accumulation_steps=0)
+
+  def test_accumulation_applies_through_subclass_optimizer_override(self):
+    """Models that override create_optimizer (QTOpt, MAML, Mock without
+    an injected optimizer_fn) must still get the MultiSteps wrapper —
+    the step factories consume build_optimizer, not create_optimizer."""
+    model = mocks.MockT2RModel(  # no optimizer_fn: Mock's own override
+        use_batch_norm=False, device_type="cpu",
+        gradient_accumulation_steps=2)
+    gen = mocks.MockInputGenerator(batch_size=8)
+    gen.set_specification_from_model(model, modes.TRAIN)
+    batch = next(gen.create_dataset(modes.TRAIN))
+    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                     batch["features"])
+    step = ts.make_train_step(model, donate=False)
+    before = jax.device_get(state.params)
+    state, _ = step(state, batch["features"], batch["labels"])
+    # First micro-step only accumulates; without the wrapper this
+    # would be a full optimizer step and params would move.
+    for p0, p1 in zip(jax.tree_util.tree_leaves(before),
+                      jax.tree_util.tree_leaves(
+                          jax.device_get(state.params))):
+      np.testing.assert_array_equal(np.asarray(p0), np.asarray(p1))
+
+  def test_ema_moves_once_per_applied_update(self):
+    """EMA must track APPLIED updates, not micro-steps: with k=2 the
+    accumulated run's EMA matches the equivalent large-batch step's
+    EMA exactly (same single decay application)."""
+    import optax
+
+    def make(accum):
+      return mocks.MockT2RModel(
+          use_batch_norm=False, device_type="cpu", use_ema=True,
+          ema_decay=0.5, optimizer_fn=lambda: optax.sgd(0.1),
+          gradient_accumulation_steps=accum)
+
+    gen = mocks.MockInputGenerator(batch_size=16)
+    gen.set_specification_from_model(make(1), modes.TRAIN)
+    batch = next(gen.create_dataset(modes.TRAIN))
+    features, labels = batch["features"], batch["labels"]
+    half = lambda tree, s: jax.tree_util.tree_map(lambda x: x[s], tree)
+
+    accum_model = make(2)
+    a_state, _ = ts.create_train_state(
+        accum_model, jax.random.PRNGKey(0), half(features, slice(0, 8)))
+    a_step = ts.make_train_step(accum_model, donate=False)
+    ema_before = jax.device_get(a_state.ema_params)
+    a_state, _ = a_step(a_state, half(features, slice(0, 8)),
+                        half(labels, slice(0, 8)))
+    # Accumulation-only micro-step: EMA untouched.
+    for e0, e1 in zip(jax.tree_util.tree_leaves(ema_before),
+                      jax.tree_util.tree_leaves(
+                          jax.device_get(a_state.ema_params))):
+      np.testing.assert_array_equal(np.asarray(e0), np.asarray(e1))
+    a_state, _ = a_step(a_state, half(features, slice(8, 16)),
+                        half(labels, slice(8, 16)))
+
+    big_model = make(1)
+    b_state, _ = ts.create_train_state(
+        big_model, jax.random.PRNGKey(0), features)
+    b_step = ts.make_train_step(big_model, donate=False)
+    b_state, _ = b_step(b_state, features, labels)
+
+    for ea, eb in zip(jax.tree_util.tree_leaves(
+                          jax.device_get(a_state.ema_params)),
+                      jax.tree_util.tree_leaves(
+                          jax.device_get(b_state.ema_params))):
+      np.testing.assert_allclose(np.asarray(ea), np.asarray(eb),
+                                 atol=1e-6)
+
+  def test_maml_inherits_base_model_accumulation(self):
+    from tensor2robot_tpu.meta_learning import maml
+
+    base = mocks.MockT2RModel(device_type="cpu",
+                              gradient_accumulation_steps=4)
+    wrapper = maml.MAMLModel(base_model=base)
+    assert wrapper.gradient_accumulation_steps == 4
+    # Explicit knob on the wrapper wins.
+    assert maml.MAMLModel(
+        base_model=base,
+        gradient_accumulation_steps=1).gradient_accumulation_steps == 1
