@@ -93,3 +93,43 @@ class TestCodecProperties:
     image = rng.randint(0, 255, (h, w, 3), np.uint8)
     decoded = codec.decode_image(codec.encode_image(image, fmt), channels=3)
     np.testing.assert_array_equal(decoded, image)
+
+
+class TestExtractedPlaneProperties:
+  """Wire-dtype policy fuzz for `is_extracted` raw planes: whatever the
+  dtype/shape, values (not bit patterns) round-trip on BOTH parser
+  paths, and the two paths agree exactly."""
+
+  @settings(max_examples=40, deadline=None)
+  @given(st.sampled_from(["uint8", "int32", "int64", "float32",
+                          "bfloat16"]),
+         st.lists(st.integers(1, 6), min_size=1, max_size=3),
+         st.integers(0, 2**31 - 1))
+  def test_roundtrip_both_paths_any_dtype(self, dtype, shape, seed):
+    import ml_dtypes
+
+    rng = np.random.RandomState(seed)
+    shape = tuple(shape)
+    if dtype == "uint8":
+      value = rng.randint(0, 255, shape).astype(np.uint8)
+    elif dtype in ("int32", "int64"):
+      value = rng.randint(-1000, 1000, shape).astype(dtype)
+    elif dtype == "float32":
+      value = rng.randn(*shape).astype(np.float32)
+    else:  # bfloat16: generate representable values
+      value = rng.randn(*shape).astype(np.float32).astype(
+          ml_dtypes.bfloat16)
+    spec = SpecStruct({
+        "plane": TensorSpec(shape=shape, dtype=dtype, name="plane",
+                            data_format="png", is_extracted=True)})
+    record = codec.encode_example({"plane": value}, spec)
+    fast = parsing.create_parse_fn(spec)
+    assert fast._native_parsers[""] is not None, \
+        "extracted plane spec fell off the native path"
+    slow = parsing.create_parse_fn(spec)
+    slow._native_parsers[""] = None
+    out_fast = np.asarray(fast.parse_batch([record])["features/plane"][0])
+    out_slow = np.asarray(slow.parse_batch([record])["features/plane"][0])
+    np.testing.assert_array_equal(out_fast, out_slow)
+    np.testing.assert_array_equal(
+        out_fast.astype(np.float64), np.asarray(value, np.float64))
