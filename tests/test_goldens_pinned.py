@@ -89,6 +89,24 @@ def _maml_model():
                         num_inference_samples_per_task=4)
 
 
+def _sequence_model():
+  from tensor2robot_tpu.models import sequence_model
+
+  return sequence_model.SequenceRegressionModel(
+      obs_size=4, action_size=2, sequence_length=8, hidden_size=8,
+      num_blocks=1, num_heads=2, attention_backend="reference",
+      device_type="cpu", optimizer_fn=lambda: optax.adam(1e-3))
+
+
+def _moe_model():
+  from tensor2robot_tpu.models import moe_model
+
+  return moe_model.MoERegressionModel(
+      obs_size=4, action_size=2, num_experts=2, hidden_size=8,
+      dispatch="dense", device_type="cpu",
+      optimizer_fn=lambda: optax.adam(1e-3))
+
+
 class TestPinnedGoldens:
 
   def test_mock_model_matches_committed_golden(self, tmp_path):
@@ -132,6 +150,18 @@ class TestPinnedGoldens:
     fixture = T2RModelFixture(str(tmp_path / "maml"), batch_size=2)
     fixture.train_and_check_golden_predictions(
         _maml_model(), os.path.join(GOLDEN_DIR, "maml_mock.npy"),
+        max_train_steps=3, atol=1e-5, require=True)
+
+  def test_sequence_model_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "seq"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _sequence_model(), os.path.join(GOLDEN_DIR, "sequence_small.npy"),
+        max_train_steps=3, atol=1e-5, require=True)
+
+  def test_moe_model_matches_committed_golden(self, tmp_path):
+    fixture = T2RModelFixture(str(tmp_path / "moe"), batch_size=2)
+    fixture.train_and_check_golden_predictions(
+        _moe_model(), os.path.join(GOLDEN_DIR, "moe_small.npy"),
         max_train_steps=3, atol=1e-5, require=True)
 
   def test_deliberate_lr_change_fails_golden(self, tmp_path):
