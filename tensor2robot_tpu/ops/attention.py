@@ -62,7 +62,8 @@ def _online_block_update(q, k_blk, v_blk, m_prev, l_prev, o_prev,
   numerator). Returns updated (m, l, o).
   """
   scale = 1.0 / math.sqrt(q.shape[-1])
-  s = jnp.einsum("...qd,...kd->...qk", q, k_blk).astype(jnp.float32) * scale
+  s = jnp.einsum("...qd,...kd->...qk", q, k_blk,
+                 preferred_element_type=jnp.float32) * scale
   if score_mask is not None:
     s = jnp.where(score_mask, s, _mask_value(s.dtype))
   m_new = jnp.maximum(m_prev, s.max(axis=-1))
@@ -71,7 +72,7 @@ def _online_block_update(q, k_blk, v_blk, m_prev, l_prev, o_prev,
   l_new = l_prev * alpha + p.sum(axis=-1)
   o_new = (o_prev * alpha[..., None]
            + jnp.einsum("...qk,...kd->...qd", p.astype(v_blk.dtype),
-                        v_blk).astype(jnp.float32))
+                        v_blk, preferred_element_type=jnp.float32))
   return m_new, l_new, o_new
 
 
@@ -139,7 +140,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
   # those rows to 0 (their p is masked to 0 in the backward anyway).
   # Validity is positional: a row is real iff its query index < valid_len
   # (for causal rows the diagonal entry is always unmasked, so l > 0).
-  q_pos = tq_idx * q_block + jax.lax.iota(jnp.int32, q_block)
+  # broadcasted_iota, not 1D lax.iota: Mosaic rejects 1D iota at compile
+  # time (TPU vectors are 2D sublane x lane; interpret mode hides this).
+  q_pos = tq_idx * q_block + jax.lax.broadcasted_iota(
+      jnp.int32, (q_block, 1), 0).squeeze(-1)
   row_valid = q_pos < valid_len
   lse_ref[:] = jnp.where(row_valid,
                          m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
@@ -165,15 +169,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   def body(kb, dq):
     k_blk = k_ref[pl.ds(kb * block_k, block_k), :]
     v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
-    s = (q @ k_blk.T).astype(jnp.float32) * scale
+    s = jnp.matmul(q, k_blk.T,
+                   preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse[:, None])
     mask = _valid_mask(tq_idx * q_block, kb * block_k, q_block, block_k,
                        causal, valid_len, seq_len)
     if mask is not None:
       p = jnp.where(mask, p, 0.0)
-    dp = do @ v_blk.T.astype(jnp.float32)
+    dp = jnp.matmul(do, v_blk.T, preferred_element_type=jnp.float32)
     ds = p * (dp - delta[:, None]) * scale
-    return dq + ds @ k_blk.astype(jnp.float32)
+    return dq + jnp.matmul(ds, k_blk,
+                           preferred_element_type=jnp.float32)
 
   dq0 = jnp.zeros((q_block, q.shape[-1]), jnp.float32)
   dq_ref[:] = jax.lax.fori_loop(0, num_k_blocks, body, dq0).astype(
@@ -201,16 +207,20 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
     lse_blk = lse_ref[pl.ds(qb * block_q, block_q)]
     delta_blk = delta_ref[pl.ds(qb * block_q, block_q)]
-    s = (q_blk @ k_blk.T).astype(jnp.float32) * scale
+    s = jnp.matmul(q_blk, k_blk.T,
+                   preferred_element_type=jnp.float32) * scale
     p = jnp.exp(s - lse_blk[:, None])
     mask = _valid_mask(qb * block_q, tk_idx * k_block, block_q, k_block,
                        causal, valid_len, seq_len)
     if mask is not None:
       p = jnp.where(mask, p, 0.0)
-    dv = dv + p.T @ do_blk
-    dp = do_blk @ v_blk.T.astype(jnp.float32)
+    dv = dv + jnp.matmul(p.T, do_blk,
+                         preferred_element_type=jnp.float32)
+    dp = jnp.matmul(do_blk, v_blk.T,
+                    preferred_element_type=jnp.float32)
     ds = p * (dp - delta_blk[:, None]) * scale
-    dk = dk + ds.T @ q_blk.astype(jnp.float32)
+    dk = dk + jnp.matmul(ds.T, q_blk,
+                         preferred_element_type=jnp.float32)
     return dk, dv
 
   dk0 = jnp.zeros((k_block, k_blk.shape[-1]), jnp.float32)
