@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tensor2robot_tpu import native
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import codec, example_pb2
 
@@ -35,8 +36,19 @@ __all__ = ["create_parse_fn", "ParseFn"]
 # Native-path bytes-value capacity for is_extracted raw planes: planes
 # split across more values than this re-parse on the Python path (the
 # native parser stores at most `cap` values per feature), with a logged
-# warning when that permanently disables the fast path for the stream.
+# warning when mismatches disable the fast path for the stream.
 _EXTRACTED_VALUE_CAP = 4
+
+# Consecutive mismatched batches before the native parser is disabled
+# for a stream. A single anomalous record only downgrades ITS batch;
+# a stream that is legacy-format throughout stops paying for the wasted
+# native pass after this many batches in a row fall back.
+_NATIVE_DISABLE_STREAK = 3
+# Total (non-consecutive) mismatch budget: a shuffle-merge of legacy and
+# new-format shards interleaves mismatches with good batches, so the
+# streak alone would never trip — stop paying for wasted native passes
+# once this many batches of a stream have fallen back overall.
+_NATIVE_DISABLE_TOTAL = 20
 
 
 class _NativeFormatMismatch(Exception):
@@ -218,6 +230,8 @@ class ParseFn:
     self._plans: Dict[str, List[_LeafPlan]] = {}
     self._sequence_datasets: Dict[str, bool] = {}
     self._native_parsers: Dict[str, Any] = {}
+    self._native_mismatch_streak: Dict[str, int] = {}
+    self._native_mismatch_total: Dict[str, int] = {}
     for dkey in self._dataset_keys:
       subset = specs_lib.filter_by_dataset(merged, dkey)
       self._plans[dkey] = _plan_for(subset)
@@ -243,6 +257,8 @@ class ParseFn:
           spec.is_sequence for spec in subset.values())
       self._native_parsers[dkey] = self._maybe_native_parser(
           self._plans[dkey])
+      self._native_mismatch_streak[dkey] = 0
+      self._native_mismatch_total[dkey] = 0
 
   def _maybe_native_parser(self, plans: List[_LeafPlan]):
     """Builds the C++ columnar parser when every leaf fits its profile:
@@ -277,7 +293,8 @@ class ParseFn:
         nbytes = (int(np.prod(spec.shape, dtype=np.int64))
                   * plan.parse_dtype.itemsize)
         native_plan.append(
-            (plan.feature_name, 2, nbytes, False, 0, _EXTRACTED_VALUE_CAP))
+            (plan.feature_name, native.KIND_BYTES, nbytes, False, 0,
+             _EXTRACTED_VALUE_CAP))
         continue
       if spec.is_image:
         # Only the dims that size native buffers must be concrete: the
@@ -298,7 +315,8 @@ class ParseFn:
         # missing sequence features are an error on both paths.
         missing_ok = not spec.is_sequence
         native_plan.append(
-            (plan.feature_name, 2, 0, missing_ok, seq_len, cap))
+            (plan.feature_name, native.KIND_BYTES, 0, missing_ok, seq_len,
+             cap))
         continue
       if any(d is None for d in spec.shape):
         return None  # dynamic dims (incl. dynamic time): python path
@@ -308,15 +326,13 @@ class ParseFn:
               if step_shape else 1)
       if plan.parse_dtype == np.float32:
         native_plan.append(
-            (plan.feature_name, 0, size, False, seq_len, 0))
+            (plan.feature_name, native.KIND_FLOAT, size, False, seq_len, 0))
       elif np.issubdtype(plan.parse_dtype, np.integer):
         native_plan.append(
-            (plan.feature_name, 1, size, False, seq_len, 0))
+            (plan.feature_name, native.KIND_INT64, size, False, seq_len, 0))
       else:
         return None
     try:
-      from tensor2robot_tpu import native
-
       if not native.available():
         return None
       return native.BatchExampleParser(native_plan)
@@ -453,22 +469,49 @@ class ParseFn:
       if self._native_parsers.get(dkey) is not None:
         try:
           batched.update(self._parse_batch_native(dkey, serialized_list))
+          self._native_mismatch_streak[dkey] = 0
           continue
         except _NativeFormatMismatch as mismatch:
           # Legacy wire kind (e.g. float_list plane) or over-cap value
-          # splits: the Python path parses any wire format. The dataset
-          # evidently carries that format throughout — disable the
-          # native parser so later batches skip the wasted native pass.
-          # Loud: the Python path is orders of magnitude slower, and a
-          # silent downgrade would be undiagnosable.
-          logging.warning(
-              "Native columnar parser disabled for dataset %r: feature "
-              "%s uses a wire format it cannot surface (legacy "
-              "float_list/int64_list plane, or a plane split across >%d "
-              "bytes values). Falling back to the Python parser for the "
-              "rest of this stream — expect much lower host throughput.",
-              dkey, mismatch, _EXTRACTED_VALUE_CAP)
-          self._native_parsers[dkey] = None
+          # splits: the Python path parses any wire format. Only THIS
+          # batch falls back — one anomalous record must not downgrade
+          # the whole stream. Two disable triggers bound the wasted
+          # native passes: _NATIVE_DISABLE_STREAK mismatches in a row
+          # (the stream carries that format throughout) and
+          # _NATIVE_DISABLE_TOTAL overall (legacy shards shuffle-merged
+          # with new-format ones, where good batches keep resetting the
+          # streak). Loud on first fallback and on disable, debug in
+          # between: the Python path is orders of magnitude slower, and
+          # a silent downgrade would be undiagnosable — but one warning
+          # per mismatched batch would spam a multi-hour run.
+          streak = self._native_mismatch_streak.get(dkey, 0) + 1
+          self._native_mismatch_streak[dkey] = streak
+          total = self._native_mismatch_total.get(dkey, 0) + 1
+          self._native_mismatch_total[dkey] = total
+          detail = (
+              f"feature {mismatch} uses a wire format it cannot surface "
+              "(legacy float_list/int64_list plane, or a plane split "
+              f"across >{_EXTRACTED_VALUE_CAP} bytes values)")
+          if (streak >= _NATIVE_DISABLE_STREAK
+              or total >= _NATIVE_DISABLE_TOTAL):
+            logging.warning(
+                "Native columnar parser disabled for dataset %r: %s in "
+                "%d consecutive / %d total batches. Falling back to the "
+                "Python parser for the rest of this stream — expect much "
+                "lower host throughput.", dkey, detail, streak, total)
+            self._native_parsers[dkey] = None
+          elif total == 1:
+            logging.warning(
+                "Native columnar parser fell back to the Python path for "
+                "one batch of dataset %r: %s. The native path stays "
+                "enabled; %d consecutive or %d total mismatched batches "
+                "disable it (further per-batch fallbacks log at debug).",
+                dkey, detail, _NATIVE_DISABLE_STREAK,
+                _NATIVE_DISABLE_TOTAL)
+          else:
+            logging.debug(
+                "Native parser per-batch fallback for dataset %r: %s "
+                "(streak %d, total %d).", dkey, detail, streak, total)
       plans = self._plans[dkey]
       is_sequence = self._sequence_datasets[dkey]
       for serialized in serialized_list:

@@ -30,6 +30,16 @@ RESNET_BLOCK_SIZES: Dict[int, Sequence[int]] = {
 }
 _BOTTLENECK_FROM = 50
 
+# TF1 parity pins (reference film_resnet_model.py:39-40; flax's
+# BatchNorm default momentum is 0.99, which would drift the running
+# statistics' horizon ~3x from the reference's 0.997). The conv kernel
+# initializer needs no pin: the reference's
+# `tf.variance_scaling_initializer()` (film_resnet_model.py:103)
+# defaults to scale=1.0 / fan_in / truncated_normal — exactly flax's
+# default `lecun_normal()`.
+_BATCH_NORM_DECAY = 0.997
+_BATCH_NORM_EPSILON = 1e-5
+
 
 class LinearFilmGenerator(nn.Module):
   """Conditioning vector -> per-block (gamma, beta) lists (reference
@@ -68,6 +78,8 @@ class _BasicBlock(nn.Module):
     # output to f32 (f32 stats win the promotion), silently turning the
     # rest of a bf16 tower into f32.
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                      dtype=self.dtype, name=name)
     shortcut = x
     y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
@@ -94,6 +106,8 @@ class _BottleneckBlock(nn.Module):
   @nn.compact
   def __call__(self, x, film_params=None, train: bool = False):
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                      dtype=self.dtype, name=name)
     shortcut = x
     y = nn.Conv(self.filters, (1, 1), use_bias=False, name="conv1")(x)
@@ -127,6 +141,8 @@ class _BasicBlockV2(nn.Module):
   @nn.compact
   def __call__(self, x, film_params=None, train: bool = False):
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                      dtype=self.dtype, name=name)
     preact = nn.relu(norm("bn1")(x))
     needs_proj = (x.shape[-1] != self.filters) or self.strides != 1
@@ -156,6 +172,8 @@ class _BottleneckBlockV2(nn.Module):
   @nn.compact
   def __call__(self, x, film_params=None, train: bool = False):
     norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                      dtype=self.dtype, name=name)
     preact = nn.relu(norm("bn1")(x))
     needs_proj = (x.shape[-1] != 4 * self.filters) or self.strides != 1
@@ -229,6 +247,8 @@ class ResNet(nn.Module):
     if self.version == 1:
       # v2 defers normalization to the first block's pre-activation.
       x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                dtype=self.dtype, name="bn_stem")(x))
     x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 
@@ -247,6 +267,8 @@ class ResNet(nn.Module):
     if self.version == 2:
       # v2 closes with a final normalization + activation before pooling.
       x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                     momentum=_BATCH_NORM_DECAY,
+                                     epsilon=_BATCH_NORM_EPSILON,
                                dtype=self.dtype, name="bn_final")(x))
     x = x.mean(axis=(1, 2))  # global average pool
     endpoints["final_reduce_mean"] = x

@@ -21,6 +21,33 @@ from tensor2robot_tpu.ops.image_norm import normalize_image
 __all__ = ["FilmParams", "film", "BerkeleyNet", "HighResBerkeleyNet",
            "PipelinedBerkeleyTower", "PoseHead"]
 
+# TF1 parity pins (VERDICT r3 item 8 — initializer/norm defaults differ
+# between flax and the reference's slim arg scopes, which matters for
+# train-from-scratch parity). Each constant is pinned to the specific
+# reference function whose arg scope sets it:
+# - BuildImagesToFeaturesModel (the BerkeleyNet tower): slim.batch_norm
+#   decay=0.99, epsilon=1e-4, scale=False (vision_layers.py:72-77); conv
+#   weights slim.xavier_initializer() with constant 0.01 biases
+#   (vision_layers.py:123-126).
+# - BuildImagesToFeaturesModelHighRes: its OWN conv arg scope uses
+#   truncated_normal(stddev=0.1) with default zero biases
+#   (vision_layers.py:236-241).
+# - BuildImageFeaturesToPoseModel (the pose head): FC weights
+#   truncated_normal(stddev=0.01) with constant 0.01 biases, and the
+#   bias-transform variable itself initializes at 0.01
+#   (vision_layers.py:317-328).
+# - tf.contrib.layers.layer_norm normalizes with variance_epsilon=1e-12
+#   (its hardcoded default); flax LayerNorm defaults to 1e-6. Stats run
+#   in f32 on both sides, so 1e-12 is safe to match.
+_BATCH_NORM_DECAY = 0.99
+_BATCH_NORM_EPSILON = 1e-4
+_LAYER_NORM_EPSILON = 1e-12
+_CONV_KERNEL_INIT = nn.initializers.xavier_uniform()
+_CONV_BIAS_INIT = nn.initializers.constant(0.01)
+_HIGH_RES_CONV_KERNEL_INIT = nn.initializers.truncated_normal(stddev=0.1)
+_FC_KERNEL_INIT = nn.initializers.truncated_normal(stddev=0.01)
+_FC_BIAS_INIT = nn.initializers.constant(0.01)
+
 
 class FilmParams(nn.Module):
   """Generates per-channel (gamma, beta) from a conditioning vector
@@ -56,6 +83,10 @@ class BerkeleyNet(nn.Module):
   flatten: bool = True  # no-spatial-softmax path: flatten vs keep [H,W,C]
   normalizer: str = "layer_norm"  # 'batch_norm'|'layer_norm'|'none'
   dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
+  # Conv inits default to the BuildImagesToFeaturesModel pins; the
+  # high-res variant overrides them with ITS reference scope's.
+  conv_kernel_init: Any = _CONV_KERNEL_INIT
+  conv_bias_init: Any = _CONV_BIAS_INIT
 
   @nn.compact
   def __call__(self, images: jnp.ndarray,
@@ -64,14 +95,22 @@ class BerkeleyNet(nn.Module):
     x = normalize_image(images, self.dtype)
     for i, (f, k, s) in enumerate(zip(self.filters, self.kernel_sizes,
                                       self.strides)):
-      x = nn.Conv(f, (k, k), strides=(s, s), name=f"conv_{i}")(x)
+      x = nn.Conv(f, (k, k), strides=(s, s),
+                  kernel_init=self.conv_kernel_init,
+                  bias_init=self.conv_bias_init, name=f"conv_{i}")(x)
       # Explicit norm dtype: with dtype=None the f32 stats/params win the
       # flax promotion and the rest of a bf16 tower silently runs f32.
       if self.normalizer == "batch_norm":
-        x = nn.BatchNorm(use_running_average=not train, dtype=self.dtype,
-                         name=f"norm_{i}")(x)
+        # use_scale=False: the reference's batch_norm params only enable
+        # scale in the separate with-scaling variant (vision_layers.py
+        # :72-86), which our geometry has no analogue of.
+        x = nn.BatchNorm(use_running_average=not train,
+                         momentum=_BATCH_NORM_DECAY,
+                         epsilon=_BATCH_NORM_EPSILON, use_scale=False,
+                         dtype=self.dtype, name=f"norm_{i}")(x)
       elif self.normalizer == "layer_norm":
-        x = nn.LayerNorm(dtype=self.dtype, name=f"norm_{i}")(x)
+        x = nn.LayerNorm(epsilon=_LAYER_NORM_EPSILON, dtype=self.dtype,
+                         name=f"norm_{i}")(x)
       if conditioning is not None:
         gamma, beta = FilmParams(f, name=f"film_{i}")(conditioning)
         x = film(x, gamma.astype(x.dtype), beta.astype(x.dtype))
@@ -127,8 +166,8 @@ class PipelinedBerkeleyTower(nn.Module):
     defs = []
     for i, ((_, _, cin), (_, _, cout)) in enumerate(geometry):
       k = self.kernel_sizes[i]
-      d = {"kernel": ((k, k, cin, cout), nn.initializers.lecun_normal()),
-           "bias": ((cout,), nn.initializers.zeros),
+      d = {"kernel": ((k, k, cin, cout), _CONV_KERNEL_INIT),
+           "bias": ((cout,), _CONV_BIAS_INIT),
            "ln_scale": ((cout,), nn.initializers.ones),
            "ln_bias": ((cout,), nn.initializers.zeros)}
       if self.condition_size:
@@ -193,11 +232,13 @@ class PipelinedBerkeleyTower(nn.Module):
             act, p["kernel"].astype(compute), (stride, stride), "SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
         y = y + p["bias"].astype(compute)
-        # LayerNorm over the channel axis, stats in f32 (flax semantics).
+        # LayerNorm over the channel axis, stats in f32 (flax semantics);
+        # epsilon pinned to BerkeleyNet's (the parity test in
+        # tests/test_layers.py compares the two with shared weights).
         mean = jnp.mean(y.astype(jnp.float32), axis=-1, keepdims=True)
         var = jnp.var(y.astype(jnp.float32), axis=-1, keepdims=True)
         y = ((y.astype(jnp.float32) - mean)
-             * jax.lax.rsqrt(var + 1e-6)).astype(compute)
+             * jax.lax.rsqrt(var + _LAYER_NORM_EPSILON)).astype(compute)
         y = y * p["ln_scale"].astype(compute) + p["ln_bias"].astype(compute)
         if cond:
           cvec = flat[:, in_size:in_size + cond].astype(compute)
@@ -257,9 +298,16 @@ class HighResBerkeleyNet(nn.Module):
     # Normalize once so both branches see the same scale and dtype
     # (BerkeleyNet's internal normalize_image is a no-op on the result).
     images = normalize_image(images, self.dtype)
+    # The high-res reference function's own conv arg scope initializes
+    # with truncated_normal(0.1) and zero biases (vision_layers.py
+    # :236-241) — NOT the base tower's xavier/0.01 pins.
     points = BerkeleyNet(filters=self.filters, dtype=self.dtype,
+                         conv_kernel_init=_HIGH_RES_CONV_KERNEL_INIT,
+                         conv_bias_init=nn.initializers.zeros,
                          name="main")(images, conditioning, train=train)
-    hi = nn.Conv(self.high_res_filters, (3, 3), name="high_res_conv")(images)
+    hi = nn.Conv(self.high_res_filters, (3, 3),
+                 kernel_init=_HIGH_RES_CONV_KERNEL_INIT,
+                 name="high_res_conv")(images)
     hi = nn.relu(hi)
     hi_points = SpatialSoftmax(name="high_res_ssm")(hi, train=train)
     return jnp.concatenate([points, hi_points], axis=-1)
@@ -279,12 +327,16 @@ class PoseHead(nn.Module):
                train: bool = False) -> jnp.ndarray:
     x = features
     if self.bias_transform_size:
+      # The reference initializes the bias-transform variable at 0.01
+      # (slim.bias_add with the head's bias_init, vision_layers.py:328).
       bias_transform = self.param(
-          "bias_transform", nn.initializers.zeros,
+          "bias_transform", nn.initializers.constant(0.01),
           (self.bias_transform_size,))
       tiled = jnp.tile(bias_transform[None].astype(x.dtype),
                        (x.shape[0], 1))
       x = jnp.concatenate([x, tiled], axis=-1)
     for i, size in enumerate(self.hidden_sizes):
-      x = nn.relu(nn.Dense(size, name=f"fc_{i}")(x))
-    return nn.Dense(self.output_size, name="pose")(x)
+      x = nn.relu(nn.Dense(size, kernel_init=_FC_KERNEL_INIT,
+                           bias_init=_FC_BIAS_INIT, name=f"fc_{i}")(x))
+    return nn.Dense(self.output_size, kernel_init=_FC_KERNEL_INIT,
+                    bias_init=_FC_BIAS_INIT, name="pose")(x)

@@ -159,6 +159,34 @@ class T2RModel(ModelInterface):
       self._module = self.create_module()
     return self._module
 
+  # -- mesh plumbing (models that specialize their module on the mesh) ------
+
+  def _set_mesh_guarded(self, mesh, validate=None) -> None:
+    """Shared `set_mesh` plumbing: enforces the call-before-build
+    contract (the module is specialized on the mesh at create_module
+    time, so changing it afterwards would silently be ignored), runs the
+    model's extra `validate(mesh)` checks, then stores the mesh on
+    `self._mesh`. One implementation for every mesh-aware model
+    (pipelined/sequence/BCZ/Grasp2Vec) so a change to the staleness rule
+    lands everywhere at once."""
+    if self._module is not None and getattr(self, "_mesh", None) is not mesh:
+      raise ValueError("set_mesh must be called before the module is "
+                       "built (create_train_state / first forward).")
+    if mesh is not None and validate is not None:
+      validate(mesh)
+    self._mesh = mesh
+
+  @staticmethod
+  def _validate_pp_stage_count(mesh, pp_axis: str, num_stages: int,
+                               what: str = "trunk") -> None:
+    """A >1 `pp_axis` must match the pipelined trunk's stage count —
+    the GPipe schedule places exactly one stage per pp rank."""
+    if pp_axis in mesh.shape and mesh.shape[pp_axis] > 1 \
+        and mesh.shape[pp_axis] != num_stages:
+      raise ValueError(
+          f"mesh axis {pp_axis!r} has size {mesh.shape[pp_axis]} but "
+          f"the {what} has {num_stages} stages; they must match.")
+
   # -- abstract model surface ----------------------------------------------
 
   @abc.abstractmethod

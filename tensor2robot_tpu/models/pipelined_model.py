@@ -146,17 +146,9 @@ class PipelinedRegressionModel(abstract_model.T2RModel):
     """Receives the training mesh (train_eval_model / test harness). The
     pipelined schedule activates only when the mesh has a >1 `pp_axis`;
     otherwise the trunk runs the sequential schedule."""
-    if self._module is not None and self._mesh is not mesh:
-      raise ValueError("set_mesh must be called before the module is "
-                       "built (create_train_state / first forward).")
-    if mesh is not None and self._pp_axis in mesh.shape \
-        and mesh.shape[self._pp_axis] > 1 \
-        and mesh.shape[self._pp_axis] != self._num_stages:
-      raise ValueError(
-          f"mesh axis {self._pp_axis!r} has size "
-          f"{mesh.shape[self._pp_axis]} but the trunk has "
-          f"{self._num_stages} stages; they must match.")
-    self._mesh = mesh
+    self._set_mesh_guarded(
+        mesh, lambda m: self._validate_pp_stage_count(
+            m, self._pp_axis, self._num_stages))
 
   def get_feature_specification(self, mode):
     return SpecStruct({

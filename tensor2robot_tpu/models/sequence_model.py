@@ -105,15 +105,14 @@ class SequenceRegressionModel(abstract_model.T2RModel):
   def set_mesh(self, mesh) -> None:
     """Receives the training mesh (train_eval_model / test harness);
     required before module build for the 'ring' and 'ulysses' backends."""
-    if self._module is not None and self._mesh is not mesh:
-      raise ValueError("set_mesh must be called before the module is "
-                       "built (create_train_state / first forward).")
-    if mesh is not None and self._attention_backend in ("ring", "ulysses"):
-      sp = mesh.shape.get(self._sp_axis, 0)
+    def validate(m):
+      if self._attention_backend not in ("ring", "ulysses"):
+        return
+      sp = m.shape.get(self._sp_axis, 0)
       if not sp:
         raise ValueError(
             f"attention_backend={self._attention_backend!r} needs a "
-            f"{self._sp_axis!r} mesh axis; mesh has {dict(mesh.shape)}")
+            f"{self._sp_axis!r} mesh axis; mesh has {dict(m.shape)}")
       if self._sequence_length % sp:
         raise ValueError(
             f"sequence_length {self._sequence_length} not divisible by "
@@ -122,7 +121,8 @@ class SequenceRegressionModel(abstract_model.T2RModel):
         raise ValueError(
             f"num_heads {self._num_heads} not divisible by the {sp}-way "
             f"{self._sp_axis!r} axis (Ulysses shards head groups)")
-    self._mesh = mesh
+
+    self._set_mesh_guarded(mesh, validate)
 
   @property
   def batch_partition_spec(self):

@@ -36,6 +36,7 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
     self._module = None
     self._assets: Optional[specs_lib.Assets] = None
     self._input_keys = None
+    self._signature_feeds: Dict[str, str] = {}
 
   @staticmethod
   def _saved_model_root(path: str) -> Optional[str]:
@@ -78,7 +79,36 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
         os.path.join(newest, specs_lib.ASSET_FILENAME))
     spec = specs_lib.filter_required(self._assets.feature_spec)
     self._input_keys = list(spec.keys())
+    if not hasattr(self._module, "fn"):
+      self._signature_feeds = self._validated_signature_feeds()
     return True
+
+  def _validated_signature_feeds(self) -> Dict[str, str]:
+    """Maps serving-signature kwarg name -> feature key, validated.
+
+    Two specs sharing a wire name would silently overwrite each other in
+    the kwarg dict, and a name mismatch vs the signature's declared
+    inputs surfaces as an opaque TF shape/arg error far from the cause —
+    so both are loud errors here, at restore time (ADVICE r3)."""
+    feeds: Dict[str, str] = {}
+    for key in self._input_keys:
+      spec = self._assets.feature_spec[key]
+      name = spec.name or key.rsplit("/", 1)[-1]
+      if name in feeds:
+        raise ValueError(
+            f"Feature specs {feeds[name]!r} and {key!r} both feed serving "
+            f"signature input {name!r}; give them distinct spec names.")
+      feeds[name] = key
+    signature = self._module.signatures["serving_default"]
+    _, sig_kwargs = signature.structured_input_signature
+    declared = set(sig_kwargs)
+    if declared and set(feeds) != declared:
+      raise ValueError(
+          "Feature spec names do not match the serving_default signature "
+          f"inputs. Signature declares {sorted(declared)}; specs feed "
+          f"{sorted(feeds)} (missing: {sorted(declared - set(feeds))}, "
+          f"unexpected: {sorted(set(feeds) - declared)}).")
+    return feeds
 
   def get_feature_specification(self) -> specs_lib.SpecStruct:
     self.assert_is_loaded()
@@ -103,11 +133,10 @@ class SavedModelPredictor(predictors_lib.AbstractPredictor):
       # Reference-era SavedModel: call the serving signature with
       # keyword tensors named by the feature specs (the reference's
       # receiver feed names, exported_savedmodel_predictor.py:260-282).
+      # The name->key map was collision-checked and validated against
+      # the signature's declared inputs at restore time.
       signature = self._module.signatures["serving_default"]
-      kwargs = {}
-      for key in self._input_keys:
-        spec = self._assets.feature_spec[key]
-        name = spec.name or key.rsplit("/", 1)[-1]
-        kwargs[name] = tf.convert_to_tensor(np.asarray(flat[key]))
+      kwargs = {name: tf.convert_to_tensor(np.asarray(flat[key]))
+                for name, key in self._signature_feeds.items()}
       outputs = signature(**kwargs)
     return {k: np.asarray(v) for k, v in outputs.items()}

@@ -394,17 +394,13 @@ class BCZModel(abstract_model.T2RModel):
     network='pipelined_berkeley' and a >1 `pp` axis, the conv trunk runs
     the heterogeneous GPipe schedule; otherwise it runs sequentially
     (identical math)."""
-    if self._module is not None and self._mesh is not mesh:
-      raise ValueError("set_mesh must be called before the module is "
-                       "built (create_train_state / first forward).")
-    if (mesh is not None and self._network == "pipelined_berkeley"
-        and self._pp_axis in mesh.shape and mesh.shape[self._pp_axis] > 1
-        and mesh.shape[self._pp_axis] != len(self._pipeline_filters)):
-      raise ValueError(
-          f"mesh axis {self._pp_axis!r} has size "
-          f"{mesh.shape[self._pp_axis]} but the pipelined trunk has "
-          f"{len(self._pipeline_filters)} conv stages; they must match.")
-    self._mesh = mesh
+    def validate(m):
+      if self._network == "pipelined_berkeley":
+        self._validate_pp_stage_count(m, self._pp_axis,
+                                      len(self._pipeline_filters),
+                                      what="pipelined trunk")
+
+    self._set_mesh_guarded(mesh, validate)
 
   def get_feature_specification(self, mode):
     out = SpecStruct({

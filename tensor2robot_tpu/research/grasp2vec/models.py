@@ -33,19 +33,25 @@ __all__ = ["SceneEmbedding", "GoalEmbedding", "Grasp2VecModel",
            "keypoint_heatmap"]
 
 
-TOWERS = ("conv", "resnet")
+TOWERS = ("conv", "resnet", "pipelined_conv")
 
 
 def _tower_spatial_features(image: jnp.ndarray, tower: str,
                             filters: Tuple[int, ...], resnet_size: int,
                             train: bool,
-                            dtype: Optional[Any] = None) -> jnp.ndarray:
+                            dtype: Optional[Any] = None,
+                            pp_mesh: Optional[Any] = None,
+                            pp_num_microbatches: int = 4) -> jnp.ndarray:
   """Shared tower dispatch -> [B, H', W', C] spatial features.
 
   'conv' is a small stride-2 stack; 'resnet' is the shared FiLM-ResNet
   backbone's last spatial block, the analogue of the reference's
-  vendored Keras-style ResNet (grasp2vec/resnet.py:333-539). Must be
-  called inside an @nn.compact scope (creates submodules)."""
+  vendored Keras-style ResNet (grasp2vec/resnet.py:333-539);
+  'pipelined_conv' is the same stride-2 conv/LN/relu stack run as
+  heterogeneous GPipe stages over a `pp` mesh axis (the second research
+  family on `pipelined_apply_heterogeneous` after BC-Z) — without a
+  mesh it runs the sequential schedule, identical math. Must be called
+  inside an @nn.compact scope (creates submodules)."""
   if tower == "resnet":
     from tensor2robot_tpu.layers import film_resnet
 
@@ -53,6 +59,14 @@ def _tower_spatial_features(image: jnp.ndarray, tower: str,
         resnet_size=resnet_size, dtype=dtype, name="resnet")(
             image, train=train)
     return endpoints["block_layer4"]
+  if tower == "pipelined_conv":
+    from tensor2robot_tpu.layers import vision
+
+    return vision.PipelinedBerkeleyTower(
+        filters=filters, kernel_sizes=(3,) * len(filters),
+        strides=(2,) * len(filters), condition_size=0, mesh=pp_mesh,
+        num_microbatches=pp_num_microbatches, dtype=dtype,
+        name="tower")(image, train=train)
   if tower != "conv":
     raise ValueError(f"tower must be one of {TOWERS}, got {tower!r}")
   x = image
@@ -69,14 +83,17 @@ class SceneEmbedding(nn.Module):
 
   embedding_size: int = 64
   filters: Tuple[int, ...] = (32, 64, 64)
-  tower: str = "conv"  # 'conv' | 'resnet'
+  tower: str = "conv"  # 'conv' | 'resnet' | 'pipelined_conv'
   resnet_size: int = 18
   dtype: Optional[Any] = None
+  pp_mesh: Optional[Any] = None
+  pp_num_microbatches: int = 4
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
     x = _tower_spatial_features(image, self.tower, self.filters,
-                                self.resnet_size, train, self.dtype)
+                                self.resnet_size, train, self.dtype,
+                                self.pp_mesh, self.pp_num_microbatches)
     spatial = nn.Conv(self.embedding_size, (1, 1), name="proj")(x)
     pooled = spatial.mean(axis=(1, 2))
     return pooled, spatial
@@ -85,14 +102,17 @@ class SceneEmbedding(nn.Module):
 class GoalEmbedding(nn.Module):
   embedding_size: int = 64
   filters: Tuple[int, ...] = (32, 64, 64)
-  tower: str = "conv"  # 'conv' | 'resnet'
+  tower: str = "conv"  # 'conv' | 'resnet' | 'pipelined_conv'
   resnet_size: int = 18
   dtype: Optional[Any] = None
+  pp_mesh: Optional[Any] = None
+  pp_num_microbatches: int = 4
 
   @nn.compact
   def __call__(self, image: jnp.ndarray, train: bool = False):
     x = _tower_spatial_features(image, self.tower, self.filters,
-                                self.resnet_size, train, self.dtype)
+                                self.resnet_size, train, self.dtype,
+                                self.pp_mesh, self.pp_num_microbatches)
     x = x.mean(axis=(1, 2))
     return nn.Dense(self.embedding_size, name="proj")(x)
 
@@ -107,8 +127,11 @@ def keypoint_heatmap(spatial_features: jnp.ndarray,
 class _Grasp2VecNetwork(nn.Module):
   embedding_size: int = 64
   tower: str = "conv"
+  filters: Tuple[int, ...] = (32, 64, 64)
   resnet_size: int = 18
   dtype: Optional[Any] = None
+  pp_mesh: Optional[Any] = None
+  pp_num_microbatches: int = 4
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
@@ -116,10 +139,16 @@ class _Grasp2VecNetwork(nn.Module):
     _norm = lambda img: normalize_image(img, self.dtype)
 
     scene = SceneEmbedding(self.embedding_size, tower=self.tower,
+                           filters=self.filters,
                            resnet_size=self.resnet_size, dtype=self.dtype,
+                           pp_mesh=self.pp_mesh,
+                           pp_num_microbatches=self.pp_num_microbatches,
                            name="scene")
     goal = GoalEmbedding(self.embedding_size, tower=self.tower,
+                         filters=self.filters,
                          resnet_size=self.resnet_size, dtype=self.dtype,
+                         pp_mesh=self.pp_mesh,
+                         pp_num_microbatches=self.pp_num_microbatches,
                          name="goal")
     pregrasp, pregrasp_spatial = scene(_norm(features["pregrasp_image"]),
                                        train=train)
@@ -149,10 +178,13 @@ class Grasp2VecModel(abstract_model.T2RModel):
 
   def __init__(self, image_size: int = 48, embedding_size: int = 64,
                tower: str = "conv", resnet_size: int = 18,
+               filters: Tuple[int, ...] = (32, 64, 64),
                loss_type: str = "npairs",
                non_negativity_constraint: bool = False,
                triplet_margin: float = 3.0,
                ty_loss_weight: float = 0.0,
+               pipeline_microbatches: int = 4,
+               pp_axis: str = "pp",
                **kwargs):
     super().__init__(**kwargs)
     if loss_type not in self.LOSS_TYPES:
@@ -164,10 +196,27 @@ class Grasp2VecModel(abstract_model.T2RModel):
     self._embedding_size = embedding_size
     self._tower = tower
     self._resnet_size = resnet_size
+    self._filters = tuple(filters)
     self._loss_type = loss_type
     self._non_negativity_constraint = non_negativity_constraint
     self._triplet_margin = triplet_margin
     self._ty_loss_weight = ty_loss_weight
+    self._pipeline_microbatches = pipeline_microbatches
+    self._pp_axis = pp_axis
+    self._mesh = None
+
+  def set_mesh(self, mesh) -> None:
+    """Receives the training mesh from train_eval_model. With
+    tower='pipelined_conv' and a >1 `pp` axis, both embedding towers run
+    their conv stacks as heterogeneous GPipe stages; otherwise the
+    sequential schedule (identical math)."""
+    def validate(m):
+      if self._tower == "pipelined_conv":
+        self._validate_pp_stage_count(m, self._pp_axis,
+                                      len(self._filters),
+                                      what="pipelined tower")
+
+    self._set_mesh_guarded(mesh, validate)
 
   def get_feature_specification(self, mode):
     image = lambda name: TensorSpec(
@@ -194,9 +243,15 @@ class Grasp2VecModel(abstract_model.T2RModel):
     })
 
   def create_module(self):
+    mesh = self._mesh
+    use_pp = (mesh is not None and self._tower == "pipelined_conv"
+              and self._pp_axis in mesh.shape
+              and mesh.shape[self._pp_axis] > 1)
     return _Grasp2VecNetwork(
         embedding_size=self._embedding_size, tower=self._tower,
-        resnet_size=self._resnet_size,
+        filters=self._filters, resnet_size=self._resnet_size,
+        pp_mesh=mesh if use_pp else None,
+        pp_num_microbatches=self._pipeline_microbatches,
         dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def _grasp_success(self, labels):

@@ -37,6 +37,12 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["GraspingCNN", "Grasping44", "QTOptModel"]
 
+# TF1 parity pin (VERDICT r3 item 8): the reference puts
+# `weights_initializer=tf.truncated_normal_initializer(stddev=0.01)` on
+# EVERY Grasping44 conv and fully-connected layer (networks.py:430-435);
+# flax's default is lecun_normal, a much wider fan-in-scaled init.
+_TRUNC_NORMAL_001 = nn.initializers.truncated_normal(stddev=0.01)
+
 
 class GraspingCNN(nn.Module):
   """Grasping Q-network: conv tower + mid-tower action merge -> scalar Q."""
@@ -137,14 +143,14 @@ class Grasping44(nn.Module):
 
     # Stem (reference conv1_1 + pool1).
     net = nn.Conv(self.filters, (6, 6), strides=(2, 2), use_bias=False,
-                  name="conv1_1")(image)
+                  kernel_init=_TRUNC_NORMAL_001, name="conv1_1")(image)
     net = nn.relu(self._bn("conv1_bn")(net, use_running_average=use_ra))
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
 
     conv_id = 2
     for _ in range(self.num_convs[0]):
       net = nn.Conv(self.filters, (5, 5), use_bias=False,
-                    name=f"conv{conv_id}")(net)
+                    kernel_init=_TRUNC_NORMAL_001, name=f"conv{conv_id}")(net)
       net = nn.relu(self._bn(f"conv{conv_id}_bn")(
           net, use_running_average=use_ra))
       conv_id += 1
@@ -179,15 +185,16 @@ class Grasping44(nn.Module):
     else:
       blocks = [("fcgrasp", grasp_params)]
     fcgrasp = sum(
-        nn.Dense(256, name=name)(block) for name, block in blocks)
+        nn.Dense(256, kernel_init=_TRUNC_NORMAL_001, name=name)(block) for name, block in blocks)
     fcgrasp = nn.relu(self._bn("fcgrasp_bn")(
         fcgrasp, use_running_average=use_ra))
     fcgrasp = nn.Dense(self.grasp_context_size, use_bias=False,
-                       name="fcgrasp2")(fcgrasp)
+                       kernel_init=_TRUNC_NORMAL_001, name="fcgrasp2")(fcgrasp)
     fcgrasp = nn.relu(self._bn("fcgrasp2_bn")(
         fcgrasp, use_running_average=use_ra))
     if fcgrasp.shape[-1] != net.shape[-1]:
-      fcgrasp = nn.Dense(net.shape[-1], name="fcgrasp_proj")(fcgrasp)
+      fcgrasp = nn.Dense(net.shape[-1], kernel_init=_TRUNC_NORMAL_001,
+                          name="fcgrasp_proj")(fcgrasp)
     context = fcgrasp[:, None, None, :]
 
     if action_batch is not None:
@@ -198,7 +205,7 @@ class Grasping44(nn.Module):
 
     for _ in range(self.num_convs[1]):
       net = nn.Conv(self.filters, (3, 3), use_bias=False,
-                    name=f"conv{conv_id}")(net)
+                    kernel_init=_TRUNC_NORMAL_001, name=f"conv{conv_id}")(net)
       net = nn.relu(self._bn(f"conv{conv_id}_bn")(
           net, use_running_average=use_ra))
       conv_id += 1
@@ -206,7 +213,7 @@ class Grasping44(nn.Module):
 
     for _ in range(self.num_convs[2]):
       net = nn.Conv(self.filters, (3, 3), padding="VALID", use_bias=False,
-                    name=f"conv{conv_id}")(net)
+                    kernel_init=_TRUNC_NORMAL_001, name=f"conv{conv_id}")(net)
       net = nn.relu(self._bn(f"conv{conv_id}_bn")(
           net, use_running_average=use_ra))
       conv_id += 1
@@ -224,9 +231,10 @@ class Grasping44(nn.Module):
 
     for i in range(self.hid_layers):
       net = nn.Dense(self.fc_hidden_size, use_bias=False,
-                     name=f"fc{i}")(net)
+                     kernel_init=_TRUNC_NORMAL_001, name=f"fc{i}")(net)
       net = nn.relu(self._bn(f"fc{i}_bn")(net, use_running_average=use_ra))
-    logits = nn.Dense(self.num_classes, name="logit")(net)
+    logits = nn.Dense(self.num_classes, kernel_init=_TRUNC_NORMAL_001,
+                      name="logit")(net)
     if self.softmax:
       predictions = jax.nn.softmax(logits)
     else:

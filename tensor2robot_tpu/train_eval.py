@@ -70,6 +70,22 @@ def print_specification(model) -> None:
         logging.info("  %s: %r", key, spec)
 
 
+def _maybe_pin_cpu(model) -> None:
+  """Pins jax to the CPU platform when the model asks for CPU.
+
+  A `device_type='cpu'` config run must never touch accelerator
+  hardware — under the axon environment the register hook initializes
+  the TPU tunnel on ANY first backend use, so without an explicit pin a
+  CPU-config `run_t2r_trainer` invocation would hang on a wedged tunnel
+  (or occupy a healthy one). No-op (with a pin attempt that callers can
+  verify via backend.assert_cpu_backend) if the backend is already up.
+  """
+  if getattr(model, "device_type", None) == "cpu":
+    from tensor2robot_tpu.utils import backend
+
+    backend.pin_cpu()
+
+
 def _device_batch(mesh, batch, batch_spec=None):
   return mesh_lib.place_batch(mesh, batch, batch_spec=batch_spec)
 
@@ -138,6 +154,7 @@ def train_eval_model(
   if mode not in ("train", "evaluate", "train_and_evaluate",
                   "continuous_eval"):
     raise ValueError(f"Unknown train_eval mode {mode!r}")
+  _maybe_pin_cpu(model)
   os.makedirs(model_dir, exist_ok=True)
   if mesh is None:
     kwargs = {"axis_names": tuple(mesh_axis_names)} if mesh_axis_names \
@@ -391,6 +408,7 @@ def predict_from_model(
   (reference predict_from_model, :389-420)."""
   if input_generator is None:
     raise ValueError("input_generator is required.")
+  _maybe_pin_cpu(model)
   provide_input_generator_with_model_information(
       input_generator, model, modes_lib.PREDICT)
   dataset = input_generator.create_dataset(modes_lib.PREDICT)

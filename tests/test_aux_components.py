@@ -133,6 +133,70 @@ class TestSavedModelPredictor:
     np.testing.assert_allclose(out["prediction"], [[3.0], [3.0]])
     assert predictor.global_step == 42
 
+  def _write_reference_era_bundle(self, tmp_path, feature_spec):
+    """Bare reference-layout SavedModel (one `measured_position` input)
+    with the given pbtxt feature specs; returns the export root."""
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu import specs as specs_lib
+
+    export_root = str(tmp_path / "export")
+    bundle = os.path.join(export_root, "1234567890")
+
+    class RefModule(tf.Module):
+      @tf.function(input_signature=[
+          tf.TensorSpec((None, 3), tf.float32, name="measured_position")])
+      def serve(self, measured_position):
+        return {"prediction": tf.reduce_sum(measured_position, axis=-1,
+                                            keepdims=True)}
+
+    module = RefModule()
+    tf.saved_model.save(module, bundle,
+                        signatures={"serving_default": module.serve})
+    specs_lib.write_assets_pbtxt(
+        specs_lib.Assets(feature_spec=feature_spec,
+                         label_spec=specs_lib.SpecStruct({
+                             "y": specs_lib.TensorSpec(
+                                 shape=(1,), dtype=np.float32)}),
+                         global_step=1),
+        os.path.join(bundle, "assets.extra",
+                     specs_lib.PBTXT_ASSET_FILENAME))
+    return export_root
+
+  def test_reference_era_duplicate_feed_names_raise(self, tmp_path):
+    """Two specs sharing a wire name would silently overwrite each other
+    in the signature kwargs — must be a loud restore-time error
+    (ADVICE r3)."""
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.predictors import saved_model_predictor
+
+    export_root = self._write_reference_era_bundle(
+        tmp_path, specs_lib.SpecStruct({
+            "a/x": specs_lib.TensorSpec(shape=(3,), dtype=np.float32,
+                                        name="measured_position"),
+            "b/x": specs_lib.TensorSpec(shape=(3,), dtype=np.float32,
+                                        name="measured_position")}))
+    predictor = saved_model_predictor.SavedModelPredictor(
+        export_dir=export_root)
+    with pytest.raises(ValueError, match="both feed serving"):
+      predictor.restore()
+
+  def test_reference_era_feed_name_mismatch_raises(self, tmp_path):
+    """A spec name absent from the signature's declared inputs surfaces
+    as a clear restore-time error naming the missing/unexpected feeds,
+    not an opaque TF call error (ADVICE r3)."""
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.predictors import saved_model_predictor
+
+    export_root = self._write_reference_era_bundle(
+        tmp_path, specs_lib.SpecStruct({
+            "x": specs_lib.TensorSpec(shape=(3,), dtype=np.float32,
+                                      name="misnamed_position")}))
+    predictor = saved_model_predictor.SavedModelPredictor(
+        export_dir=export_root)
+    with pytest.raises(ValueError,
+                       match="do not match the serving_default"):
+      predictor.restore()
+
 
 class TestJpegHelpers:
 

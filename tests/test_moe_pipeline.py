@@ -704,3 +704,108 @@ class TestBCZPipelined:
         image_size=32, network="pipelined_berkeley", device_type="cpu")
     with pytest.raises(ValueError, match="must match"):
       model.set_mesh(mesh)
+
+
+class TestGrasp2VecPipelined:
+  """Second research family on heterogeneous PP: Grasp2Vec's scene and
+  goal conv towers as GPipe stages (configs/train_grasp2vec_pp.gin)."""
+
+  @pytest.fixture(scope="class")
+  def pp_mesh(self):
+    return mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+
+  def _model(self, mesh):
+    from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+
+    model = g2v_models.Grasp2VecModel(
+        image_size=32, tower="pipelined_conv",
+        filters=(16, 32, 32, 32), device_type="cpu",
+        pipeline_microbatches=4)
+    model.set_mesh(mesh)
+    return model
+
+  def _batch(self, model, batch_size=8):
+    from tensor2robot_tpu import modes, specs as specs_lib
+
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification(modes.TRAIN),
+        batch_size=batch_size, seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification(modes.TRAIN),
+        batch_size=batch_size, seed=1)
+    return features, labels
+
+  def test_forward_and_grads_match_sequential(self, pp_mesh):
+    """Same params through the pipelined and sequential schedules give
+    identical embeddings AND parameter gradients for BOTH towers."""
+    from tensor2robot_tpu import modes
+
+    model_pp = self._model(pp_mesh)
+    model_seq = self._model(None)
+    features, labels = self._batch(model_pp)
+    variables = model_seq.module.init(jax.random.PRNGKey(0), features,
+                                      train=False)
+
+    out_seq = model_seq.module.apply(variables, features, train=False)
+    with pp_mesh:
+      out_pp = model_pp.module.apply(variables, features, train=False)
+    for key in ("pregrasp_embedding", "postgrasp_embedding",
+                "goal_embedding", "arithmetic_embedding", "heatmap"):
+      np.testing.assert_allclose(np.asarray(out_seq[key]),
+                                 np.asarray(out_pp[key]),
+                                 rtol=2e-5, atol=1e-5, err_msg=key)
+
+    def loss(params, model):
+      out = model.module.apply({"params": params}, features, train=False)
+      value, _ = model.model_train_fn(features, labels, out, modes.TRAIN)
+      return value
+
+    g_seq = jax.grad(lambda p: loss(p, model_seq))(variables["params"])
+    with pp_mesh:
+      g_pp = jax.jit(jax.grad(lambda p: loss(p, model_pp)))(
+          variables["params"])
+    flat_pp = dict(jax.tree_util.tree_leaves_with_path(g_pp))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g_seq):
+      np.testing.assert_allclose(np.asarray(leaf),
+                                 np.asarray(flat_pp[path]),
+                                 rtol=1e-4, atol=1e-5,
+                                 err_msg=str(path))
+
+  def test_trains_with_stage_params_sharded(self, pp_mesh):
+    """Through the step factory: BOTH towers' pp_stages leaves land
+    sharded over 'pp' and the npairs loss decreases."""
+    from tensor2robot_tpu.models import pipelined_model
+
+    model = self._model(pp_mesh)
+    features, labels = self._batch(model, batch_size=16)
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(0), features, mesh=pp_mesh,
+        rules=pipelined_model.pipeline_parallel_rules())
+    flat = {"/".join(str(getattr(p, "key", p)) for p in path): leaf
+            for path, leaf in
+            jax.tree_util.tree_leaves_with_path(state.params)}
+    stage_leaves = {k: v for k, v in flat.items() if "pp_stages" in k}
+    assert len(stage_leaves) == 2, list(flat)  # scene + goal towers
+    for key, leaf in stage_leaves.items():
+      assert leaf.sharding.spec == PartitionSpec("pp", None), (key,
+                                                               leaf.sharding)
+    step = ts.make_train_step(model, mesh=pp_mesh, shardings=shardings)
+    f = mesh_lib.put_host_batch(pp_mesh, features)
+    l = mesh_lib.put_host_batch(pp_mesh, labels)
+    first = None
+    for _ in range(15):
+      state, metrics = step(state, f, l)
+      first = first if first is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
+
+  def test_set_mesh_rejects_stage_mismatch(self):
+    from tensor2robot_tpu.research.grasp2vec import models as g2v_models
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(1, 8, 1),
+                                axis_names=("data", "pp", "model"))
+    model = g2v_models.Grasp2VecModel(
+        image_size=32, tower="pipelined_conv", device_type="cpu")
+    with pytest.raises(ValueError, match="must match"):
+      model.set_mesh(mesh)

@@ -220,11 +220,48 @@ class TestNativeExampleParser:
     out = fast.parse_batch([record])
     np.testing.assert_allclose(out["features/plane"][0], values)
     np.testing.assert_allclose(out["features/pose"][0], pose)
-    # The dataset evidently carries the legacy format throughout: the
-    # native parser is disabled so later batches skip the wasted pass.
+    # One mismatched batch falls back alone; only a run of
+    # _NATIVE_DISABLE_STREAK consecutive mismatches means the stream
+    # carries the legacy format throughout and disables the fast path.
+    assert fast._native_parsers[""] is not None
+    for _ in range(parsing._NATIVE_DISABLE_STREAK - 1):
+      out2 = fast.parse_batch([record])
+      np.testing.assert_allclose(out2["features/plane"][0], values)
     assert fast._native_parsers[""] is None
-    out2 = fast.parse_batch([record])
-    np.testing.assert_allclose(out2["features/plane"][0], values)
+    out3 = fast.parse_batch([record])
+    np.testing.assert_allclose(out3["features/plane"][0], values)
+
+  def test_native_mismatch_streak_resets_on_good_batch(self, lib):
+    """A single anomalous record must not march the stream toward
+    disablement: a well-formed batch resets the consecutive-mismatch
+    counter (ADVICE r3: per-batch fallback, not permanent disable)."""
+    from tensor2robot_tpu.data import codec, parsing
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        "plane": TensorSpec(shape=(2, 3), dtype=np.float32, name="plane",
+                            data_format="png", is_extracted=True),
+    })
+    values = np.arange(6, dtype=np.float32).reshape(2, 3)
+    legacy = codec.encode_example({"plane": values}, None)  # float_list
+    good = codec.encode_example({"plane": values}, spec)    # bytes plane
+    fast = parsing.create_parse_fn(spec)
+    assert fast._native_parsers[""] is not None
+    for _ in range(2 * parsing._NATIVE_DISABLE_STREAK):
+      for record in ((legacy,) * (parsing._NATIVE_DISABLE_STREAK - 1)
+                     + (good,)):
+        out = fast.parse_batch([record])
+        np.testing.assert_allclose(out["features/plane"][0], values)
+    assert fast._native_parsers[""] is not None, \
+        "interleaved good batches must keep the native path enabled"
+    # ...but not forever: a shuffle-merged legacy/new stream trips the
+    # TOTAL mismatch budget even though good batches keep resetting the
+    # streak, bounding the wasted native passes.
+    while fast._native_mismatch_total[""] < parsing._NATIVE_DISABLE_TOTAL:
+      fast.parse_batch([legacy])
+      fast.parse_batch([good])
+    assert fast._native_parsers[""] is None, \
+        "total mismatch budget must disable the native path"
 
   def test_extracted_plane_over_cap_split_falls_back(self, lib):
     """A plane split across more bytes values than the native cap joins
@@ -243,9 +280,14 @@ class TestNativeExampleParser:
         [raw[i:i + 5] for i in range(0, 30, 5)])  # 6 values > cap of 4
     fast = parsing.create_parse_fn(spec)
     assert fast._native_parsers[""] is not None
-    out = fast.parse_batch([example.SerializeToString()])
+    record = example.SerializeToString()
+    out = fast.parse_batch([record])
     np.testing.assert_array_equal(out["features/image"][0], plane)
-    assert fast._native_parsers[""] is None  # disabled after mismatch
+    # Per-batch fallback: still enabled until the mismatch streak runs.
+    assert fast._native_parsers[""] is not None
+    for _ in range(parsing._NATIVE_DISABLE_STREAK - 1):
+      fast.parse_batch([record])
+    assert fast._native_parsers[""] is None  # disabled after the streak
 
   def test_extracted_plane_contiguous_single_copy_path(self, lib):
     """Well-formed batches take the wrapper's contiguous buffer (one
