@@ -47,6 +47,9 @@ def cross_entropy_method(
   Returns:
     (best_action [action_dim], best_score [], final_mean [action_dim]).
   """
+  if num_elites < 2:
+    # The Bessel-corrected (ddof=1) stddev update is 0/0 on one elite.
+    raise ValueError("num_elites must be >= 2 for the stddev update.")
   action_dim = mean.shape[-1]
 
   def body(i, carry):
@@ -60,7 +63,9 @@ def cross_entropy_method(
     elite_idx = jax.lax.top_k(scores, num_elites)[1]
     elites = samples[elite_idx]
     new_mean = elites.mean(0)
-    new_stddev = elites.std(0) + 1e-6
+    # ddof=1 (Bessel): the reference's normal-CEM update uses the sample
+    # stddev of the elites (cross_entropy.py:141-143).
+    new_stddev = elites.std(0, ddof=1) + 1e-6
     top_idx = elite_idx[0]
     better = scores[top_idx] > best_score
     best_action = jnp.where(better, samples[top_idx], best_action)
@@ -85,6 +90,10 @@ class CrossEntropyMethod:
                seed: Optional[int] = None):
     if num_elites > num_samples:
       raise ValueError("num_elites must be <= num_samples.")
+    if num_elites < 2:
+      # The Bessel-corrected (ddof=1) stddev update is 0/0 on one elite
+      # (the reference's np.std(..., ddof=1) NaNs there too).
+      raise ValueError("num_elites must be >= 2 for the stddev update.")
     self._num_samples = num_samples
     self._num_iterations = num_iterations
     self._num_elites = num_elites
@@ -111,10 +120,18 @@ class CrossEntropyMethod:
       elite_idx = np.argsort(scores)[-self._num_elites:]
       elites = samples[elite_idx]
       mean = elites.mean(0)
-      stddev = elites.std(0)
+      # ddof=1 (Bessel): matches the reference normal-CEM update
+      # (cross_entropy.py:141-143) — pinned by the executed-parity test
+      # that runs the reference implementation on the same draws.
+      stddev = elites.std(0, ddof=1)
       if scores[elite_idx[-1]] > best_score:
         best_score = float(scores[elite_idx[-1]])
         best_action = samples[elite_idx[-1]]
       if self._early_stddev and float(stddev.max()) < self._early_stddev:
         break
+    # Final sampling-distribution parameters, for callers (and the
+    # executed-parity tests) that track the distribution rather than the
+    # argmax — the reference's NormalCrossEntropyMethod return surface.
+    self.final_mean_ = mean
+    self.final_stddev_ = stddev
     return best_action, best_score

@@ -24,7 +24,7 @@ import jax.numpy as jnp
 
 __all__ = [
     "to_float_image", "to_uint8_image",
-    "center_crop", "random_crop", "crop_image",
+    "center_crop", "random_crop", "crop_image", "custom_crop",
     "resize", "random_flip_left_right",
     "random_brightness", "random_contrast", "random_saturation",
     "random_hue", "add_gaussian_noise",
@@ -64,9 +64,42 @@ def center_crop(image: jnp.ndarray, target_height: int,
 
 def crop_image(image: jnp.ndarray, top: int, left: int, height: int,
                width: int) -> jnp.ndarray:
-  """Static custom crop (reference CustomCropImages)."""
+  """Static crop at a fixed offset."""
   _check_batched(image)
   return image[:, top:top + height, left:left + width, :]
+
+
+def custom_crop(image: jnp.ndarray, centers: jnp.ndarray,
+                target_height: int, target_width: int) -> jnp.ndarray:
+  """Per-image crop around given (y, x) pixel centers, border-clamped.
+
+  Reference CustomCropImages (preprocessors/image_transformations.py
+  :104-173): crop centers are clamped so the window stays inside the
+  image (max with target//2, min with dim - target//2), then a
+  target_shape glimpse is extracted around the clamped center. Pinned
+  against the executed reference op in
+  tests/test_reference_executed_parity.py.
+
+  Args:
+    image: [B, H, W, C] batch.
+    centers: [B, 2] float or int (y, x) crop centers in pixels.
+    target_height / target_width: output spatial size.
+  """
+  _check_batched(image)
+  b, h, w, c = image.shape
+  centers = jnp.asarray(centers, jnp.float32)
+  cy = jnp.clip(centers[:, 0], target_height // 2, h - target_height // 2)
+  cx = jnp.clip(centers[:, 1], target_width // 2, w - target_width // 2)
+  tops = jnp.round(cy - target_height / 2.0).astype(jnp.int32)
+  lefts = jnp.round(cx - target_width / 2.0).astype(jnp.int32)
+  tops = jnp.clip(tops, 0, h - target_height)
+  lefts = jnp.clip(lefts, 0, w - target_width)
+
+  def _one(img, top, left):
+    return jax.lax.dynamic_slice(
+        img, (top, left, 0), (target_height, target_width, c))
+
+  return jax.vmap(_one)(image, tops, lefts)
 
 
 def random_crop(key: jax.Array, image: jnp.ndarray, target_height: int,
