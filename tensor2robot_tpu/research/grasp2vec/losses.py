@@ -139,11 +139,14 @@ def send_to_zero_loss(tensor, mask=None) -> jnp.ndarray:
 
 def match_norms_loss(anchor_tensors, paired_tensors) -> jnp.ndarray:
   """Pushes paired-tensor norms toward (stop-gradient) anchor norms
-  (reference :222-238; tf.nn.l2_loss = sum(x^2)/2 per example)."""
+  (reference :222-238). Scaling pinned by the executed reference:
+  tf.nn.l2_loss is a scalar sum(x^2)/2 over the BATCH (the reference's
+  outer reduce_mean is a no-op on that scalar), so this is a batch sum,
+  not a mean."""
   anchor_norms = jax.lax.stop_gradient(
       jnp.linalg.norm(anchor_tensors, axis=1))
   paired_norms = jnp.linalg.norm(paired_tensors, axis=1)
-  return jnp.mean(0.5 * (anchor_norms - paired_norms) ** 2)
+  return 0.5 * jnp.sum((anchor_norms - paired_norms) ** 2)
 
 
 def get_softmax_response(goal_embedding, scene_spatial

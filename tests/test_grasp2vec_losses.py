@@ -141,7 +141,10 @@ class TestKeypointAndSpatial:
     anchors = jnp.ones((3, 4)) * 2.0
     paired = jnp.ones((3, 4))
     loss = g2v.match_norms_loss(anchors, paired)
-    assert float(loss) == pytest.approx(0.5 * (4.0 - 2.0) ** 2, rel=1e-5)
+    # Batch SUM of half squared norm differences (the reference's
+    # tf.nn.l2_loss semantics, pinned by the executed-parity test).
+    assert float(loss) == pytest.approx(3 * 0.5 * (4.0 - 2.0) ** 2,
+                                        rel=1e-5)
     grad = jax.grad(
         lambda p: g2v.match_norms_loss(anchors, p))(paired)
     assert np.abs(np.asarray(grad)).max() > 0
