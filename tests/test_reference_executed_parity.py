@@ -629,3 +629,45 @@ class TestReplayWriterWireExecutedParity:
       np.testing.assert_allclose(np.asarray(out["features/pose"][i]),
                                  ep["pose"], rtol=1e-6)
       assert int(np.asarray(out["features/step"][i])[0]) == i
+
+
+class TestMetaExampleExecutedParity:
+  """The reference's MetaExample wire construction (episode Examples
+  merged under condition_ep{i}/inference_ep{i} prefixes), executed on
+  the same episodes as our make_meta_example. Compared as parsed
+  feature maps (proto map serialization order is unspecified, so byte
+  equality is not the right contract)."""
+
+  def test_meta_example_merge_matches_reference(self):
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu.data import codec, example_pb2
+    from tensor2robot_tpu.meta_learning import meta_example as ours
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    ref = _load_reference("meta_learning/meta_example.py")
+    spec = SpecStruct({
+        "pose": TensorSpec(shape=(3,), dtype=np.float32, name="pose"),
+        "id": TensorSpec(shape=(1,), dtype=np.int64, name="id"),
+    })
+    rng = np.random.RandomState(9)
+    episodes = [codec.encode_example(
+        {"pose": rng.randn(3).astype(np.float32),
+         "id": np.array([i], np.int64)}, spec) for i in range(5)]
+    cond, inf = episodes[:3], episodes[3:]
+
+    ref_meta = ref.make_meta_example(
+        [tf.train.Example.FromString(e) for e in cond],
+        [tf.train.Example.FromString(e) for e in inf])
+    our_meta = example_pb2.Example.FromString(
+        ours.make_meta_example(cond, inf))
+
+    ref_map = ref_meta.features.feature
+    our_map = our_meta.features.feature
+    assert sorted(ref_map.keys()) == sorted(our_map.keys())
+    for key in ref_map:
+      rf, of = ref_map[key], our_map[key]
+      np.testing.assert_allclose(list(of.float_list.value),
+                                 list(rf.float_list.value), rtol=1e-6,
+                                 err_msg=key)
+      assert list(of.int64_list.value) == list(rf.int64_list.value), key
+      assert list(of.bytes_list.value) == list(rf.bytes_list.value), key
