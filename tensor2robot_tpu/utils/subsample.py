@@ -19,39 +19,54 @@ __all__ = ["uniform_indices", "random_indices", "pinned_random_indices",
 
 
 def uniform_indices(sequence_length: int, num_samples: int) -> np.ndarray:
-  """Evenly spaced indices including endpoints."""
-  if num_samples == 1:
-    return np.zeros(1, np.int64)
-  return np.round(np.linspace(0, sequence_length - 1,
-                              num_samples)).astype(np.int64)
+  """Consistent-frame-rate indices, last frame ALWAYS included.
+
+  The reference's uniform subsampler (get_uniform_subsample_indices,
+  subsample.py:22-51, pinned by the executed-parity test): a fixed
+  stride of (L-1)/n anchored at the LAST frame — the same frames are
+  always selected for a given length, the first frame may be dropped,
+  and num_samples=1 returns the last frame. (NOT an endpoint
+  linspace.)"""
+  idx = np.round(np.arange(num_samples, dtype=np.float64)
+                 * (sequence_length - 1) / num_samples)
+  idx = (sequence_length - 1) - idx
+  return np.sort(idx).astype(np.int64)
 
 
 def random_indices(sequence_length: int, num_samples: int,
                    rng: Optional[np.random.RandomState] = None
                    ) -> np.ndarray:
-  """Sorted random indices without replacement (with replacement when the
-  sequence is shorter than the request)."""
+  """Sorted random indices, sampled WITH replacement (the reference's
+  no-first/last subsampler, subsample.py:53-80, draws floor(U * L) per
+  slot — duplicates allowed even for long sequences)."""
   rng = rng or np.random
-  replace = sequence_length < num_samples
-  idx = rng.choice(sequence_length, size=num_samples, replace=replace)
-  return np.sort(idx).astype(np.int64)
+  return np.sort(rng.randint(0, sequence_length,
+                             size=num_samples)).astype(np.int64)
 
 
 def pinned_random_indices(sequence_length: int, num_samples: int,
                           rng: Optional[np.random.RandomState] = None
                           ) -> np.ndarray:
-  """First and last frames pinned, interior sampled randomly (reference
-  first-last-pinned generator)."""
-  if num_samples < 2:
-    raise ValueError("pinned_random_indices needs num_samples >= 2")
+  """First/last frames pinned, random middle — exactly the reference
+  recipe (get_subsample_indices / get_np_subsample_indices,
+  subsample.py:82-244, pinned stream-for-stream by the executed-parity
+  test): num_samples=1 returns one uniformly random frame; long-enough
+  sequences draw the middle WITHOUT replacement from the interior
+  (shuffle-and-slice); shorter sequences draw WITH replacement over the
+  FULL range (endpoints may repeat)."""
+  if num_samples < 1:
+    raise ValueError(f"num_samples must be >= 1, got {num_samples}")
   rng = rng or np.random
-  if sequence_length <= 2:
-    return uniform_indices(sequence_length, num_samples)
-  interior = rng.choice(np.arange(1, sequence_length - 1),
-                        size=num_samples - 2,
-                        replace=sequence_length - 2 < num_samples - 2)
-  idx = np.concatenate([[0], np.sort(interior), [sequence_length - 1]])
-  return idx.astype(np.int64)
+  if num_samples == 1:
+    return rng.randint(0, sequence_length, size=(1,)).astype(np.int64)
+  if sequence_length >= num_samples:
+    interior = np.arange(1, sequence_length - 1)
+    rng.shuffle(interior)
+    middle = interior[:num_samples - 2]
+  else:
+    middle = rng.randint(0, sequence_length, size=num_samples - 2)
+  return np.sort(np.concatenate(
+      [[0], middle, [sequence_length - 1]])).astype(np.int64)
 
 
 def boundary_segment_indices(sequence_length: int, num_samples: int,

@@ -93,9 +93,14 @@ class TestCollectEvalLoop:
 class TestSubsample:
 
   def test_uniform(self):
+    # Reference semantics (executed-parity pinned): last frame always
+    # included, consistent (L-1)/n stride — the first frame may drop.
     idx = subsample.uniform_indices(10, 4)
-    assert idx[0] == 0 and idx[-1] == 9
+    assert idx[-1] == 9
     assert len(idx) == 4
+    assert (np.diff(idx) > 0).all()
+    # num_samples=1 -> always the last frame (reference docstring).
+    assert subsample.uniform_indices(7, 1).tolist() == [6]
 
   def test_random_sorted_and_bounded(self):
     rng = np.random.RandomState(0)

@@ -671,3 +671,76 @@ class TestMetaExampleExecutedParity:
                                  err_msg=key)
       assert list(of.int64_list.value) == list(rf.int64_list.value), key
       assert list(of.bytes_list.value) == list(rf.bytes_list.value), key
+
+
+class TestSubsampleExecutedParity:
+  """Sequence-subsampling index generators vs the executed reference
+  (utils/subsample.py). The uniform sampler is deterministic (exact
+  equality); the pinned sampler is compared STREAM-FOR-STREAM against
+  the reference's numpy twin (same global np.random seed, same draw
+  order)."""
+
+  def test_uniform_indices_match_reference(self):
+    tf = pytest.importorskip("tensorflow")
+    from tensor2robot_tpu.utils import subsample
+
+    ref = _load_reference("utils/subsample.py")
+    lengths = np.array([10, 3, 7, 40, 2], np.int64)
+    for n in (1, 2, 3, 5):
+      ref_idx = np.asarray(ref.get_uniform_subsample_indices(
+          tf.constant(lengths), n))
+      ours = np.stack([subsample.uniform_indices(int(l), n)
+                       for l in lengths])
+      np.testing.assert_array_equal(ours, ref_idx, err_msg=f"n={n}")
+
+  def test_pinned_random_indices_match_reference_stream(self):
+    pytest.importorskip("tensorflow")  # the reference module imports tf
+    from tensor2robot_tpu.utils import subsample
+
+    ref = _load_reference("utils/subsample.py")
+    lengths = np.array([12, 3, 30, 2, 8], np.int64)
+    for n in (1, 2, 4, 6):
+      np.random.seed(1000 + n)
+      ref_idx = ref.get_np_subsample_indices(lengths, n)
+      np.random.seed(1000 + n)
+      ours = np.stack([subsample.pinned_random_indices(int(l), n)
+                       for l in lengths])
+      np.testing.assert_array_equal(ours, ref_idx, err_msg=f"n={n}")
+
+
+class TestImageEncodeExecutedParity:
+  """The reference's numpy->image-string helper (utils/image.py) against
+  our codec: PNG bytes are deterministic (exact byte equality) and the
+  reference's jpeg bytes must decode to the same pixels through our
+  decoder."""
+
+  def test_png_bytes_identical(self):
+    from tensor2robot_tpu.data import codec
+
+    ref = _load_reference("utils/image.py")
+    rng = np.random.RandomState(4)
+    image = rng.randint(0, 255, (24, 16, 3), np.uint8)
+    assert codec.encode_image(image, "png") == \
+        ref.numpy_to_image_string(image, "png")
+
+  def test_reference_jpeg_decodes_identically(self):
+    import io
+
+    from PIL import Image
+
+    from tensor2robot_tpu.data import codec
+
+    ref = _load_reference("utils/image.py")
+    # Smooth gradient: jpeg represents it faithfully (noise images lose
+    # ~50 gray levels to chroma subsampling and prove nothing).
+    y, x = np.mgrid[0:32, 0:32]
+    image = np.stack([y * 8, x * 8, (y + x) * 4], -1).astype(np.uint8)
+    jpeg = ref.numpy_to_image_string(image, "jpeg")
+    decoded = np.asarray(codec.decode_image(jpeg, channels=3))
+    # The parity contract: our decoder reads the reference's bytes to
+    # exactly PIL's pixels...
+    pil = np.asarray(Image.open(io.BytesIO(jpeg)).convert("RGB"))
+    np.testing.assert_array_equal(decoded, pil)
+    # ...and those pixels faithfully represent the source.
+    assert np.abs(decoded.astype(np.int32)
+                  - image.astype(np.int32)).mean() < 3.0
