@@ -310,14 +310,68 @@ class DevicePrefetcher:
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
-                         process_id: Optional[int] = None) -> None:
+                         process_id: Optional[int] = None,
+                         initialization_timeout_secs: float = 300.0,
+                         heartbeat_timeout_secs: Optional[float] = None
+                         ) -> None:
   """jax.distributed bring-up for multi-host pods (replaces the
   reference's TF_CONFIG cluster plumbing,
   /root/reference/models/abstract_model.py:440-443). No-op when
-  single-process or already initialized."""
+  single-process or already initialized.
+
+  Failure detection (SURVEY §5): `initialization_timeout_secs` bounds
+  how long a worker waits for the coordinator at bring-up — a dead or
+  unreachable coordinator surfaces as a clear RuntimeError instead of
+  an opaque multi-minute hang. After bring-up, the coordination
+  service's own heartbeats detect peers that die mid-training;
+  `heartbeat_timeout_secs` tunes how long a silent peer is tolerated
+  before the job errors out (None keeps jax's default).
+  """
   if num_processes in (None, 1):
     return
+  import time
+
+  deadline = time.monotonic() + initialization_timeout_secs
+  if process_id not in (None, 0) and coordinator_address:
+    # Pre-probe the coordinator over plain TCP within the SAME deadline
+    # budget: jax's distributed client handles its init deadline with a
+    # FATAL abort (client.h LOG(FATAL)), which no Python except-clause
+    # can turn into a diagnosable error. Retrying the probe also
+    # tolerates the normal startup race where workers launch before
+    # process 0.
+    import socket
+
+    host, sep, port_str = coordinator_address.rpartition(":")
+    host = host.strip("[]")  # bracketed IPv6 literals
+    if not sep or not port_str.isdigit():
+      raise ValueError(
+          f"coordinator_address {coordinator_address!r} must be "
+          "'<host>:<port>' (e.g. '10.0.0.1:8476').")
+    port = int(port_str)
+    while True:
+      try:
+        socket.create_connection((host, port), timeout=5.0).close()
+        break
+      except OSError as exc:
+        if time.monotonic() >= deadline:
+          raise RuntimeError(
+              f"multi-host bring-up failed for process {process_id}/"
+              f"{num_processes}: coordinator {coordinator_address!r} "
+              "did not become reachable within "
+              f"{initialization_timeout_secs:.0f}s "
+              f"({type(exc).__name__}: {exc}). Check that process 0 is "
+              "alive and the address/port is reachable from this "
+              "host.") from exc
+        time.sleep(0.5)
+  kwargs = {}
+  if heartbeat_timeout_secs is not None:
+    kwargs["heartbeat_timeout_seconds"] = int(heartbeat_timeout_secs)
+  # Hand jax only the RESIDUAL budget so probe + init together respect
+  # the caller's bound (jax's own deadline handling is a process abort,
+  # so it is the backstop, not the primary detector).
   jax.distributed.initialize(
       coordinator_address=coordinator_address,
       num_processes=num_processes,
-      process_id=process_id)
+      process_id=process_id,
+      initialization_timeout=max(1, int(deadline - time.monotonic())),
+      **kwargs)

@@ -15,9 +15,13 @@ _WORKER = textwrap.dedent("""
     import sys
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address="127.0.0.1:%d",
-                               num_processes=2,
-                               process_id=int(sys.argv[1]))
+    from tensor2robot_tpu.parallel import mesh as _mesh_lib
+    # Through initialize_multihost: covers the worker-side coordinator
+    # reachability probe against a LIVE coordinator (process 0 binds,
+    # process 1 probes then joins).
+    _mesh_lib.initialize_multihost(coordinator_address="127.0.0.1:%d",
+                                   num_processes=2,
+                                   process_id=int(sys.argv[1]))
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec
     from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -59,3 +63,41 @@ def test_two_process_mesh_and_collective(tmp_path):
   for out in outputs:
     # proc0 contributes 0*6, proc1 contributes 1*6 -> global sum 6
     assert "RESULT 6.0 2" in out, out[-500:]
+
+
+_DEAD_COORDINATOR_WORKER = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    try:
+      mesh_lib.initialize_multihost(
+          coordinator_address="127.0.0.1:%d", num_processes=2,
+          process_id=1, initialization_timeout_secs=5)
+    except RuntimeError as e:
+      assert "did not become reachable" in str(e), str(e)
+      assert "127.0.0.1" in str(e)
+      print("CLEAN_FAILURE")
+""")
+
+
+@pytest.mark.slow
+def test_dead_coordinator_fails_fast_and_clearly(tmp_path):
+  """Failure detection at bring-up (SURVEY §5): a worker pointed at a
+  dead coordinator errors within the configured timeout with a message
+  naming the coordinator — not an opaque multi-minute hang."""
+  import time
+
+  port = _free_port()  # nothing listens on it
+  script = tmp_path / "worker.py"
+  script.write_text(_DEAD_COORDINATOR_WORKER % port)
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT, "JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+  start = time.monotonic()
+  proc = subprocess.Popen([sys.executable, str(script)],
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True, env=env)
+  out, _ = proc.communicate(timeout=90)
+  elapsed = time.monotonic() - start
+  assert proc.returncode == 0, out[-2000:]
+  assert "CLEAN_FAILURE" in out, out[-2000:]
+  assert elapsed < 60, f"bring-up failure took {elapsed:.0f}s"
