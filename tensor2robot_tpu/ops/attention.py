@@ -134,19 +134,24 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
   o0 = jnp.zeros((q_block, q.shape[-1]), jnp.float32)
   m, l, o = jax.lax.fori_loop(0, num_k_blocks, body, (m0, l0, o0))
   o_ref[:] = _finalize(o, l).astype(o_ref.dtype)
-  # logsumexp per query row. Fully-masked (padded) rows would otherwise
-  # carry lse = mask_value + log(block) ~ -1e38, making the backward
-  # recompute exp(s - lse) overflow before its own mask zeroes it; pin
-  # those rows to 0 (their p is masked to 0 in the backward anyway).
-  # Validity is positional: a row is real iff its query index < valid_len
-  # (for causal rows the diagonal entry is always unmasked, so l > 0).
+  # logsumexp per query row, stored [T, 1]: the trailing unit lane dim
+  # keeps the block shape inside Mosaic's (8, 128)-divisible-or-whole
+  # tiling rule for EVERY block_q (a [T]-flat lse blocked at block_q
+  # fails TPU lowering whenever 8 <= block_q < 128 — caught by the
+  # local Mosaic lowering tests; interpret mode hides it).
+  # Fully-masked (padded) rows would otherwise carry
+  # lse = mask_value + log(block) ~ -1e38, making the backward recompute
+  # exp(s - lse) overflow before its own mask zeroes it; pin those rows
+  # to 0 (their p is masked to 0 in the backward anyway). Validity is
+  # positional: a row is real iff its query index < valid_len (for
+  # causal rows the diagonal entry is always unmasked, so l > 0).
   # broadcasted_iota, not 1D lax.iota: Mosaic rejects 1D iota at compile
   # time (TPU vectors are 2D sublane x lane; interpret mode hides this).
   q_pos = tq_idx * q_block + jax.lax.broadcasted_iota(
-      jnp.int32, (q_block, 1), 0).squeeze(-1)
+      jnp.int32, (q_block, 1), 0)
   row_valid = q_pos < valid_len
-  lse_ref[:] = jnp.where(row_valid,
-                         m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
+  lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, None]
+  lse_ref[:] = jnp.where(row_valid, lse, 0.0)
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -156,8 +161,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
   scale = 1.0 / math.sqrt(q_ref.shape[-1])
   q = q_ref[:]
   do = do_ref[:].astype(jnp.float32)
-  lse = lse_ref[:]
-  delta = delta_ref[:]
+  lse = lse_ref[:]      # [block_q, 1]
+  delta = delta_ref[:]  # [block_q, 1]
   tq_idx = pl.program_id(1)
   seq_len = k_ref.shape[0]
   num_k_blocks = seq_len // block_k
@@ -171,13 +176,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v_blk = v_ref[pl.ds(kb * block_k, block_k), :]
     s = jnp.matmul(q, k_blk.T,
                    preferred_element_type=jnp.float32) * scale
-    p = jnp.exp(s - lse[:, None])
+    p = jnp.exp(s - lse)
     mask = _valid_mask(tq_idx * q_block, kb * block_k, q_block, block_k,
                        causal, valid_len, seq_len)
     if mask is not None:
       p = jnp.where(mask, p, 0.0)
     dp = jnp.matmul(do, v_blk.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None]) * scale
+    ds = p * (dp - delta) * scale
     return dq + jnp.matmul(ds, k_blk,
                            preferred_element_type=jnp.float32)
 
@@ -205,11 +210,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk, dv = carry
     q_blk = q_ref[pl.ds(qb * block_q, block_q), :]
     do_blk = do_ref[pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-    lse_blk = lse_ref[pl.ds(qb * block_q, block_q)]
-    delta_blk = delta_ref[pl.ds(qb * block_q, block_q)]
+    lse_blk = lse_ref[pl.ds(qb * block_q, block_q), :]    # [block_q, 1]
+    delta_blk = delta_ref[pl.ds(qb * block_q, block_q), :]
     s = jnp.matmul(q_blk, k_blk.T,
                    preferred_element_type=jnp.float32) * scale
-    p = jnp.exp(s - lse_blk[:, None])
+    p = jnp.exp(s - lse_blk)
     mask = _valid_mask(qb * block_q, tk_idx * k_block, block_q, k_block,
                        causal, valid_len, seq_len)
     if mask is not None:
@@ -218,7 +223,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          preferred_element_type=jnp.float32)
     dp = jnp.matmul(do_blk, v_blk.T,
                     preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_blk[:, None]) * scale
+    ds = p * (dp - delta_blk) * scale
     dk = dk + jnp.matmul(ds.T, q_blk,
                          preferred_element_type=jnp.float32)
     return dk, dv
@@ -255,11 +260,11 @@ def _flash_forward(q3, k3, v3, causal, block_q, block_k, valid_len,
       ],
       out_specs=[
           pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
-          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
+          pl.BlockSpec((None, block_q, 1), lambda b, qb: (b, qb, 0)),
       ],
       out_shape=[
           jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-          jax.ShapeDtypeStruct((bh, t), jnp.float32),
+          jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
       ],
       interpret=interpret,
   )(q3, k3, v3)
@@ -285,7 +290,8 @@ def _flash_bwd(causal, block_q, block_k, valid_len, interpret, residuals,
   q3, k3, v3, out, lse = residuals
   bh, t, d = q3.shape
   # delta_i = sum_d dO_id * O_id (FlashAttention-2 backward precompute).
-  delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+  delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                  axis=-1, keepdims=True)  # [bh, t, 1], lse layout
   dq_kernel = functools.partial(
       _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
       q_block=block_q, valid_len=valid_len)
@@ -297,8 +303,8 @@ def _flash_bwd(causal, block_q, block_k, valid_len, interpret, residuals,
           pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
           pl.BlockSpec((None, t, d), lambda b, qb: (b, 0, 0)),
           pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
-          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
-          pl.BlockSpec((None, block_q), lambda b, qb: (b, qb)),
+          pl.BlockSpec((None, block_q, 1), lambda b, qb: (b, qb, 0)),
+          pl.BlockSpec((None, block_q, 1), lambda b, qb: (b, qb, 0)),
       ],
       out_specs=pl.BlockSpec((None, block_q, d), lambda b, qb: (b, qb, 0)),
       out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
@@ -315,8 +321,8 @@ def _flash_bwd(causal, block_q, block_k, valid_len, interpret, residuals,
           pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
           pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
           pl.BlockSpec((None, t, d), lambda b, kb: (b, 0, 0)),
-          pl.BlockSpec((None, t), lambda b, kb: (b, 0)),
-          pl.BlockSpec((None, t), lambda b, kb: (b, 0)),
+          pl.BlockSpec((None, t, 1), lambda b, kb: (b, 0, 0)),
+          pl.BlockSpec((None, t, 1), lambda b, kb: (b, 0, 0)),
       ],
       out_specs=[
           pl.BlockSpec((None, block_k, d), lambda b, kb: (b, kb, 0)),
