@@ -1,0 +1,206 @@
+"""LOCAL (no-hardware) XLA:TPU AOT compile + roofline analysis.
+
+The image's libtpu supports jax AOT compilation against a described TPU
+topology (`jax.experimental.topologies`), so the REAL v5e compiler runs
+locally: full Mosaic machine-code compilation of the Pallas kernels and
+exact per-step cost analysis (flops / bytes accessed / temp memory) of
+the flagship train step — the quantities the round-2/3 rooflines had to
+measure over the wedge-prone tunnel. Wall-clock still needs the chip
+(bench.py / scripts/tpu_window.sh); this script closes the compile-risk
+and bytes-side analysis loop without it.
+
+Usage (CPU-pinned; safe while the tunnel is wedged):
+  python scripts/tpu_aot_analysis.py flash        # flash fwd+bwd compile
+  python scripts/tpu_aot_analysis.py step 64      # train step @ batch
+  python scripts/tpu_aot_analysis.py step 64 remat
+  python scripts/tpu_aot_analysis.py sweep        # the lever matrix
+"""
+
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from tensor2robot_tpu.utils import backend
+
+backend.pin_cpu()
+
+PEAK_FLOPS = 197e12  # v5e dense bf16
+PEAK_BW = 819e9      # v5e HBM
+
+
+def _mesh():
+  import jax
+  from jax.experimental import topologies
+  from jax.sharding import Mesh
+
+  topo = topologies.get_topology_desc(platform="tpu",
+                                      topology_name="v5e:2x2")
+  return Mesh(topo.devices[:1], ("data",))
+
+
+def _shapes_with_sharding(tree, sharding):
+  import jax
+
+  return jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
+      tree,
+      is_leaf=lambda x: isinstance(x, jax.ShapeDtypeStruct)
+      or hasattr(x, "shape"))
+
+
+def _replicated_shapes(mesh, tree):
+  from jax.sharding import NamedSharding, PartitionSpec
+
+  return _shapes_with_sharding(tree, NamedSharding(mesh, PartitionSpec()))
+
+
+def _cost(compiled):
+  cost = compiled.cost_analysis()
+  cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+  return (float(cost.get("flops", float("nan"))),
+          float(cost.get("bytes accessed", float("nan"))))
+
+
+def step_analysis(batch_size: int, remat: bool) -> dict:
+  import jax
+
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  mesh = _mesh()
+  model = flagship.make_flagship_model("tpu", remat=remat)
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  state_shape = jax.eval_shape(
+      lambda rng, f: ts.create_train_state(model, rng, f)[0],
+      jax.random.PRNGKey(0), features)
+  state_s = _replicated_shapes(mesh, state_shape)
+  feat_s = _replicated_shapes(mesh, features)
+  lab_s = _replicated_shapes(mesh, labels)
+  start = time.time()
+  compiled = ts.make_train_step(model, donate=False).lower(
+      state_s, feat_s, lab_s).compile()
+  flops, byts = _cost(compiled)
+  mem = compiled.memory_analysis()
+  out = {
+      "config": f"grasping44_472_bf16_b{batch_size}"
+                + ("_remat" if remat else ""),
+      "compile_secs": round(time.time() - start, 1),
+      "flops_per_step_tf": round(flops / 1e12, 3),
+      "bytes_per_step_gb": round(byts / 1e9, 3),
+      "bytes_per_example_mb": round(byts / batch_size / 1e6, 1),
+      "compute_bound_ms": round(flops / PEAK_FLOPS * 1e3, 2),
+      "memory_bound_ms": round(byts / PEAK_BW * 1e3, 2),
+      "ceiling_examples_per_sec": round(
+          batch_size / max(flops / PEAK_FLOPS, byts / PEAK_BW), 0),
+      "temp_memory_mb": (round(mem.temp_size_in_bytes / 1e6, 0)
+                         if mem is not None
+                         and hasattr(mem, "temp_size_in_bytes") else None),
+  }
+  print(json.dumps(out))
+  return out
+
+
+def flash_analysis() -> None:
+  import jax
+  import jax.numpy as jnp
+  from jax.sharding import NamedSharding, PartitionSpec
+
+  from tensor2robot_tpu.ops import attention
+
+  mesh = _mesh()
+  repl = NamedSharding(mesh, PartitionSpec())
+
+  def run(name, fn, t):
+    s = jax.ShapeDtypeStruct((2, 4, t, 64), jnp.bfloat16, sharding=repl)
+    start = time.time()
+    compiled = jax.jit(fn).lower(s, s, s).compile()
+    _, byts = _cost(compiled)
+    print(json.dumps({
+        "config": f"flash_{name}_T{t}",
+        "compile_secs": round(time.time() - start, 1),
+        "bytes_accessed_mb": round(byts / 1e6, 1),
+    }))
+
+  def fwd(q, k, v):
+    return attention.flash_attention(q, k, v, causal=True,
+                                     interpret=False)
+
+  def bwd(q, k, v):
+    return jax.grad(
+        lambda a, b, c: fwd(a, b, c).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+
+  for t in (1024, 4096, 16384):
+    run("fwd", fwd, t)
+  for t in (1024, 4096):
+    run("fwd_bwd", bwd, t)
+
+
+def multichip_analysis(batch_size: int = 128) -> None:
+  """Compile the REAL dp-sharded train step for a 4-chip v5e mesh —
+  actual TPU collectives/layouts, not the CPU-virtual-device dryrun."""
+  import jax
+  from jax.experimental import topologies
+  from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  topo = topologies.get_topology_desc(platform="tpu",
+                                      topology_name="v5e:2x2")
+  mesh = Mesh(
+      __import__("numpy").array(topo.devices).reshape(4, 1, 1),
+      ("data", "fsdp", "model"))
+  repl = NamedSharding(mesh, PartitionSpec())
+  data_sharded = NamedSharding(mesh, PartitionSpec("data"))
+  model = flagship.make_flagship_model("tpu")
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  state_shape = jax.eval_shape(
+      lambda rng, f: ts.create_train_state(model, rng, f)[0],
+      jax.random.PRNGKey(0), features)
+  start = time.time()
+  compiled = ts.make_train_step(model, donate=False).lower(
+      _shapes_with_sharding(state_shape, repl),
+      _shapes_with_sharding(features, data_sharded),
+      _shapes_with_sharding(labels, data_sharded)).compile()
+  flops, byts = _cost(compiled)
+  print(json.dumps({
+      "config": f"grasping44_472_bf16_b{batch_size}_dp4_v5e_2x2",
+      "compile_secs": round(time.time() - start, 1),
+      "flops_per_step_tf": round(flops / 1e12, 3),
+      "bytes_per_step_gb": round(byts / 1e9, 3),
+      "note": "per-chip cost; REAL TPU collectives compiled (4-chip dp)",
+  }))
+
+
+def main():
+  mode = sys.argv[1] if len(sys.argv) > 1 else "sweep"
+  if mode == "flash":
+    flash_analysis()
+  elif mode == "step":
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    step_analysis(batch, remat="remat" in sys.argv)
+  elif mode == "multichip":
+    multichip_analysis(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+  else:  # sweep: the round-3 lever matrix, fully local
+    for batch, remat in [(64, False), (128, False), (256, False),
+                         (64, True), (128, True)]:
+      step_analysis(batch, remat)
+
+
+if __name__ == "__main__":
+  main()

@@ -28,18 +28,23 @@ def _export_for_tpu(fn, *shapes):
   return export.export(jax.jit(fn), platforms=["tpu"])(*shapes)
 
 
-def _tpu_lowering_available() -> bool:
+def _tpu_lowering_probe() -> str:
+  """Empty string when TPU lowering works; the failure reason otherwise
+  (embedded in the skip message so an API/libtpu breakage reads as
+  itself, not as a generic 'no libtpu' skip that silently disarms the
+  whole suite)."""
   try:
     _export_for_tpu(lambda x: x + 1.0,
                     jax.ShapeDtypeStruct((8, 128), jnp.float32))
-    return True
-  except Exception:
-    return False
+    return ""
+  except Exception as exc:  # noqa: BLE001 - reason lands in the skip text
+    return f"{type(exc).__name__}: {exc}"
 
 
+_PROBE_FAILURE = _tpu_lowering_probe()
 pytestmark = pytest.mark.skipif(
-    not _tpu_lowering_available(),
-    reason="TPU lowering unavailable (no libtpu in this environment)")
+    bool(_PROBE_FAILURE),
+    reason=f"TPU lowering unavailable: {_PROBE_FAILURE}")
 
 
 CONFIGS = [
