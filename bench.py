@@ -46,11 +46,11 @@ MEASURE_STEPS = 50
 # (this project's only real device) — device_kind lands in the JSON so
 # a mismatch is visible.
 PEAK_BF16_FLOPS = {
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
+    "TPU v5 lite": backend_lib.V5E_PEAK_BF16_FLOPS,
+    "TPU v5e": backend_lib.V5E_PEAK_BF16_FLOPS,
     "TPU v4": 275e12,
     "TPU v6 lite": 918e12,
-    "default": 197e12,
+    "default": backend_lib.V5E_PEAK_BF16_FLOPS,
 }
 
 
@@ -69,14 +69,15 @@ def main() -> None:
   on_tpu = device.platform != "cpu"
   measure_steps = MEASURE_STEPS if on_tpu else 5
 
-  def make_model(remat: bool = False):
+  def make_model(remat: bool = False, s2d: bool = False):
     # The one shared flagship config (research/qtopt/flagship.py) so the
     # bench, tuning and latency scripts all time the SAME network.
-    return flagship.make_flagship_model(device.platform, remat=remat)
+    return flagship.make_flagship_model(device.platform, remat=remat,
+                                        space_to_depth=s2d)
 
-  def measure(batch_size: int, remat: bool = False):
+  def measure(batch_size: int, remat: bool = False, s2d: bool = False):
     """Returns (examples/sec, flops/step, bytes/step) for the train step."""
-    model = make_model(remat)
+    model = make_model(remat, s2d)
     features = specs_lib.make_random_numpy(
         model.preprocessor.get_out_feature_specification(modes.TRAIN),
         batch_size=batch_size, seed=0)
@@ -118,7 +119,7 @@ def main() -> None:
     # Per-probe trace on stderr (the JSON contract line stays single):
     # the window/driver logs then record the whole tuning curve, not
     # just the winner.
-    print(f"bench: probe batch={batch_size} remat={remat} -> "
+    print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} -> "
           f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step)",
           file=sys.stderr)
     return batch_size / sec, flops, bytes_accessed
@@ -170,11 +171,12 @@ def main() -> None:
       examples_per_sec, batch_size = bigger, probe
       flops, bytes_accessed = flops2, bytes2
       probe *= 2
+  use_s2d = False
   if on_tpu:
-    # Rematerialization probe at the winning batch: the step is HBM-bound
-    # at ~14% MXU (PERFORMANCE.md roofline), so recomputing the forward
-    # instead of storing activations trades idle-MXU FLOPs for the
-    # bottleneck resource. Keep whichever wins; "remat" lands in the JSON.
+    # Rematerialization probe at the winning batch. The local v5e AOT
+    # lever matrix (PERFORMANCE.md round 4) predicts remat HURTS here
+    # (more bytes AND more flops; the step is not activation-bound) —
+    # the probe stays as the on-chip check. Keep whichever wins.
     try:
       r_eps, r_flops, r_bytes = measure(batch_size, remat=True)
       if r_eps > examples_per_sec:
@@ -183,6 +185,22 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - the non-remat number stands
       print(f"bench: remat probe failed ({type(e).__name__}: {e}); "
             f"keeping remat=False", file=sys.stderr)
+    # Space-to-depth stem probe (exact math, tests pin equivalence):
+    # the 3-channel stem conv drives 3/128 MXU lanes; folding 2x2
+    # pixels into 12 channels quadruples lane utilization on a conv the
+    # cost model prices at 3% of flops but that can take a far larger
+    # wall-clock share at 2% MXU efficiency. Only the chip can price
+    # it; "space_to_depth" lands in the JSON.
+    try:
+      s_eps, s_flops, s_bytes = measure(batch_size, remat=use_remat,
+                                        s2d=True)
+      if s_eps > examples_per_sec:
+        examples_per_sec, use_s2d = s_eps, True
+        flops, bytes_accessed = s_flops, s_bytes
+    except Exception as e:  # noqa: BLE001 - the non-s2d number stands
+      print(f"bench: space-to-depth probe failed "
+            f"({type(e).__name__}: {e}); keeping s2d=False",
+            file=sys.stderr)
   # Efficiency accounting: achieved model FLOP/s over the device peak
   # (MFU a.k.a. MXU utilization) and HBM bytes per step, both from the
   # compiled executable's own XLA cost analysis — so the driver record
@@ -202,6 +220,7 @@ def main() -> None:
         # fixed-batch non-remat number for round-over-round comparison.
         "batch_size": batch_size,
         "remat": use_remat,
+        "space_to_depth": use_s2d,
         "value_batch64": (round(value_batch64, 2)
                           if value_batch64 is not None else None),
         "mfu": round(mfu, 4) if mfu is not None else None,

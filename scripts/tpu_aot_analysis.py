@@ -26,8 +26,8 @@ from tensor2robot_tpu.utils import backend
 
 backend.pin_cpu()
 
-PEAK_FLOPS = 197e12  # v5e dense bf16
-PEAK_BW = 819e9      # v5e HBM
+PEAK_FLOPS = backend.V5E_PEAK_BF16_FLOPS
+PEAK_BW = backend.V5E_PEAK_HBM_BW
 
 
 def _mesh():
@@ -148,6 +148,7 @@ def multichip_analysis(batch_size: int = 128) -> None:
   """Compile the REAL dp-sharded train step for a 4-chip v5e mesh —
   actual TPU collectives/layouts, not the CPU-virtual-device dryrun."""
   import jax
+  import numpy as np
   from jax.experimental import topologies
   from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -157,9 +158,8 @@ def multichip_analysis(batch_size: int = 128) -> None:
 
   topo = topologies.get_topology_desc(platform="tpu",
                                       topology_name="v5e:2x2")
-  mesh = Mesh(
-      __import__("numpy").array(topo.devices).reshape(4, 1, 1),
-      ("data", "fsdp", "model"))
+  mesh = Mesh(np.array(topo.devices).reshape(4, 1, 1),
+              ("data", "fsdp", "model"))
   repl = NamedSharding(mesh, PartitionSpec())
   data_sharded = NamedSharding(mesh, PartitionSpec("data"))
   model = flagship.make_flagship_model("tpu")

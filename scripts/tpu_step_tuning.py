@@ -65,8 +65,9 @@ def roofline(batch_size=64):
   # Time the AOT executable itself — calling `step` would jit-compile the
   # same computation a second time (~20-40 s over the tunnel).
   sec, _ = _step_time(jax, state, compiled, features, labels)
-  # TPU v5e: ~197 bf16 TFLOP/s peak, ~819 GB/s HBM.
-  peak_flops, peak_bw = 197e12, 819e9
+  # TPU v5e public-spec peaks (shared constants in utils/backend).
+  peak_flops = backend.V5E_PEAK_BF16_FLOPS
+  peak_bw = backend.V5E_PEAK_HBM_BW
   print(f"batch={batch_size} step={sec * 1e3:.1f} ms  "
         f"flops={flops / 1e12:.3f} TF  bytes={bytes_accessed / 1e9:.2f} GB")
   print(f"compute bound={flops / peak_flops * 1e3:.1f} ms  "

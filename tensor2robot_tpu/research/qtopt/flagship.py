@@ -19,9 +19,12 @@ ACTION_SIZE = 5
 GRASP_PARAM_NAMES = {"world_vector": (0, 3), "vertical_rotation": (3, 2)}
 
 
-def make_flagship_model(device_platform: str, remat: bool = False):
+def make_flagship_model(device_platform: str, remat: bool = False,
+                        space_to_depth: bool = False):
   """Reference-scale Grasping44 critic on accelerators; small smoke
-  critic on 'cpu'."""
+  critic on 'cpu'. `space_to_depth` folds the stem per
+  Grasping44.space_to_depth (exact math, 4x the stem's MXU lane
+  utilization) — a bench probe, off by default."""
   on_tpu = device_platform != "cpu"
   return qtopt_models.QTOptModel(
       image_size=IMAGE_SIZE if on_tpu else 32,
@@ -29,4 +32,5 @@ def make_flagship_model(device_platform: str, remat: bool = False):
       network="grasping44" if on_tpu else "small",
       action_size=ACTION_SIZE if on_tpu else 4,
       grasp_param_names=GRASP_PARAM_NAMES if on_tpu else None,
+      space_to_depth=space_to_depth and on_tpu,
       use_bfloat16=on_tpu, use_ema=True, remat=remat)

@@ -23,6 +23,12 @@ import sys
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# The project's one real device class (TPU v5e / "v5 lite"): public-spec
+# peaks shared by bench.py and the tuning/AOT-analysis scripts so MFU
+# and roofline numbers cannot silently disagree.
+V5E_PEAK_BF16_FLOPS = 197e12
+V5E_PEAK_HBM_BW = 819e9
+
 
 def pin_cpu(n_devices: int = 0) -> None:
   """Pins this process's jax to CPU (optionally with n virtual devices).

@@ -2,6 +2,7 @@
 (reference /root/reference/research/qtopt/networks.py:299-615) and the
 BuildOpt HParams optimizer surface (optimizer_builder.py:25-96)."""
 
+import flax
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -252,3 +253,65 @@ class TestRemat:
     assert results[False][0] == pytest.approx(results[True][0], rel=1e-6)
     np.testing.assert_allclose(np.asarray(results[True][1]),
                                np.asarray(results[False][1]), atol=1e-6)
+
+
+class TestSpaceToDepthStem:
+  """space_to_depth=True must be EXACTLY the same function as the
+  reference 6x6/stride-2 stem under the bijective weight map
+  (stem_kernel_to_s2d), not an approximation."""
+
+  def _features(self, rng, image=64, batch=2):
+    return {
+        "state/image": jnp.asarray(
+            rng.randint(0, 255, (batch, image, image, 3)), jnp.uint8),
+        "action/action": jnp.asarray(rng.randn(batch, 4), jnp.float32),
+    }
+
+  def test_logits_match_standard_stem_exactly(self):
+    rng = np.random.RandomState(3)
+    # 128px: the (2,1,1) tower's VALID tail needs >=3 spatial cells
+    # (64px collapses to zero spatial size and vacuous 0.0 logits).
+    features = self._features(rng, image=128)
+    std = qtopt_models.Grasping44(num_convs=(2, 1, 1))
+    s2d = qtopt_models.Grasping44(num_convs=(2, 1, 1), space_to_depth=True)
+    variables = flax.core.unfreeze(
+        std.init(jax.random.PRNGKey(0), features))
+    # Amplify every kernel so the comparison sees O(1) activations end
+    # to end (the pinned truncated_normal(0.01) init attenuates logits
+    # to ~1e-6 through the tower, rendering the equality vacuous).
+    variables["params"] = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (jnp.asarray(
+            rng.randn(*leaf.shape) * 0.3, jnp.float32)
+            if path[-1].key == "kernel" else leaf),
+        variables["params"])
+    params_s2d = dict(variables["params"])
+    stem = params_s2d.pop("conv1_1")
+    params_s2d["conv1_1_s2d"] = {
+        "kernel": qtopt_models.stem_kernel_to_s2d(stem["kernel"])}
+    vars_s2d = {**variables, "params": params_s2d}
+
+    out_std = std.apply(variables, features, train=False)
+    out_s2d = s2d.apply(vars_s2d, features, train=False)
+    logits_std = np.asarray(out_std["logits"], np.float32)
+    logits_s2d = np.asarray(out_s2d["logits"], np.float32)
+    assert np.abs(logits_std).max() > 1e-3  # non-vacuous comparison
+    np.testing.assert_allclose(logits_s2d, logits_std, rtol=2e-4,
+                               atol=1e-5)
+
+  def test_kernel_map_is_bijective(self):
+    rng = np.random.RandomState(4)
+    kernel = rng.randn(6, 6, 3, 8).astype(np.float32)
+    mapped = np.asarray(qtopt_models.stem_kernel_to_s2d(jnp.asarray(kernel)))
+    assert mapped.shape == (3, 3, 12, 8)
+    # Spot-check the index law: w_s2d[ki,kj,(py*2+px)*C+c] = w[2ki+py,2kj+px,c].
+    for ki, kj, py, px, c in [(0, 0, 0, 0, 0), (1, 2, 1, 0, 2),
+                              (2, 1, 0, 1, 1), (2, 2, 1, 1, 2)]:
+      np.testing.assert_array_equal(mapped[ki, kj, (py * 2 + px) * 3 + c],
+                                    kernel[2 * ki + py, 2 * kj + px, c])
+
+  def test_odd_spatial_dims_rejected(self):
+    rng = np.random.RandomState(5)
+    features = self._features(rng, image=63)
+    model = qtopt_models.Grasping44(num_convs=(1, 1, 1), space_to_depth=True)
+    with pytest.raises(ValueError, match="even spatial"):
+      model.init(jax.random.PRNGKey(0), features)
