@@ -14,6 +14,8 @@ Usage (CPU-pinned; safe while the tunnel is wedged):
   python scripts/tpu_aot_analysis.py step 64      # train step @ batch
   python scripts/tpu_aot_analysis.py step 64 remat
   python scripts/tpu_aot_analysis.py sweep        # the lever matrix
+  python scripts/tpu_aot_analysis.py multichip    # 4-chip dp compile
+  python scripts/tpu_aot_analysis.py families     # per-family rooflines
 """
 
 import json
@@ -63,15 +65,15 @@ def _cost(compiled):
           float(cost.get("bytes accessed", float("nan"))))
 
 
-def step_analysis(batch_size: int, remat: bool) -> dict:
+def _compile_train_step(model, batch_size: int, tag: str) -> dict:
+  """AOT-compiles one model's train step for v5e; returns the roofline
+  record (shared by the flagship sweep and the per-family mode)."""
   import jax
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts
-  from tensor2robot_tpu.research.qtopt import flagship
 
   mesh = _mesh()
-  model = flagship.make_flagship_model("tpu", remat=remat)
   features = specs_lib.make_random_numpy(
       model.preprocessor.get_out_feature_specification(modes.TRAIN),
       batch_size=batch_size, seed=0)
@@ -81,17 +83,15 @@ def step_analysis(batch_size: int, remat: bool) -> dict:
   state_shape = jax.eval_shape(
       lambda rng, f: ts.create_train_state(model, rng, f)[0],
       jax.random.PRNGKey(0), features)
-  state_s = _replicated_shapes(mesh, state_shape)
-  feat_s = _replicated_shapes(mesh, features)
-  lab_s = _replicated_shapes(mesh, labels)
   start = time.time()
   compiled = ts.make_train_step(model, donate=False).lower(
-      state_s, feat_s, lab_s).compile()
+      _replicated_shapes(mesh, state_shape),
+      _replicated_shapes(mesh, features),
+      _replicated_shapes(mesh, labels)).compile()
   flops, byts = _cost(compiled)
   mem = compiled.memory_analysis()
   out = {
-      "config": f"grasping44_472_bf16_b{batch_size}"
-                + ("_remat" if remat else ""),
+      "config": tag,
       "compile_secs": round(time.time() - start, 1),
       "flops_per_step_tf": round(flops / 1e12, 3),
       "bytes_per_step_gb": round(byts / 1e9, 3),
@@ -106,6 +106,37 @@ def step_analysis(batch_size: int, remat: bool) -> dict:
   }
   print(json.dumps(out))
   return out
+
+
+def step_analysis(batch_size: int, remat: bool) -> dict:
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  model = flagship.make_flagship_model("tpu", remat=remat)
+  return _compile_train_step(
+      model, batch_size,
+      f"grasping44_472_bf16_b{batch_size}" + ("_remat" if remat else ""))
+
+
+def families_analysis() -> None:
+  """The BASELINE.md table's TPU column, compiler-computed: AOT-compile
+  each driver gin config's train step AT ITS TPU-TARGET SCALE for v5e
+  and report the roofline (VERDICT r3 weak #6 — per-family TPU numbers
+  without the tunnel; wall-clock confirmation stays a window item)."""
+  import family_baselines as fb  # sibling script; scripts/ is sys.path[0]
+
+  from tensor2robot_tpu.utils import config
+
+  for name, config_file, _ in fb.FAMILIES:
+    try:
+      config.clear_config()
+      config.parse_config_file(f"{fb.CONFIG_ROOT}/{config_file}")
+      model = config.query_parameter("train_eval_model.model")
+      batch_size = int(config.query_parameter(
+          "DefaultRandomInputGenerator.batch_size"))
+      _compile_train_step(model, batch_size, f"family_{name}_v5e")
+    except Exception as exc:  # noqa: BLE001 - keep the other families
+      print(json.dumps({"config": f"family_{name}_v5e",
+                        "error": f"{type(exc).__name__}: {exc}"[:300]}))
 
 
 def flash_analysis() -> None:
@@ -196,6 +227,8 @@ def main():
     step_analysis(batch, remat="remat" in sys.argv)
   elif mode == "multichip":
     multichip_analysis(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+  elif mode == "families":
+    families_analysis()
   else:  # sweep: the round-3 lever matrix, fully local
     for batch, remat in [(64, False), (128, False), (256, False),
                          (64, True), (128, True)]:
