@@ -16,6 +16,7 @@ Usage (CPU-pinned; safe while the tunnel is wedged):
   python scripts/tpu_aot_analysis.py sweep        # the lever matrix
   python scripts/tpu_aot_analysis.py multichip    # 4-chip dp compile
   python scripts/tpu_aot_analysis.py families     # per-family rooflines
+  python scripts/tpu_aot_analysis.py serving      # CEM policy roofline
 """
 
 import json
@@ -139,6 +140,49 @@ def families_analysis() -> None:
                         "error": f"{type(exc).__name__}: {exc}"[:300]}))
 
 
+def serving_analysis() -> None:
+  """Compile the on-device CEM action-selection call (Grasping44 @472,
+  64 samples x 3 iterations — the reference serving cost) for v5e and
+  report the compiler cost: a roofline bound for window item 7's
+  wall-clock actions/sec measurement."""
+  import jax
+  from jax.sharding import NamedSharding, PartitionSpec
+
+  from tensor2robot_tpu import modes, specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.policies import device_cem
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  mesh = _mesh()
+  repl = NamedSharding(mesh, PartitionSpec())
+  model = flagship.make_flagship_model("tpu")
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=2, seed=0)
+  state_shape = jax.eval_shape(
+      lambda rng, f: ts.create_train_state(model, rng, f)[0],
+      jax.random.PRNGKey(0), features)
+  select = device_cem.make_device_cem_fn(
+      model, action_size=flagship.ACTION_SIZE)
+  shapes = _shapes_with_sharding(state_shape, repl)
+  obs = {"image": jax.ShapeDtypeStruct(
+      (flagship.IMAGE_SIZE, flagship.IMAGE_SIZE, 3), "uint8",
+      sharding=repl)}
+  rng = jax.ShapeDtypeStruct((2,), "uint32", sharding=repl)
+  start = time.time()
+  compiled = select.lower(shapes, obs, rng).compile()
+  flops, byts = _cost(compiled)
+  bound_ms = max(flops / PEAK_FLOPS, byts / PEAK_BW) * 1e3
+  print(json.dumps({
+      "config": "device_cem_grasping44_472_64x3",
+      "compile_secs": round(time.time() - start, 1),
+      "flops_per_action_gf": round(flops / 1e9, 2),
+      "bytes_per_action_mb": round(byts / 1e6, 1),
+      "roofline_bound_ms_per_action": round(bound_ms, 2),
+      "roofline_actions_per_sec": round(1e3 / max(bound_ms, 1e-9), 0),
+  }))
+
+
 def flash_analysis() -> None:
   import jax
   import jax.numpy as jnp
@@ -229,6 +273,8 @@ def main():
     multichip_analysis(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
   elif mode == "families":
     families_analysis()
+  elif mode == "serving":
+    serving_analysis()
   else:  # sweep: the round-3 lever matrix, fully local
     for batch, remat in [(64, False), (128, False), (256, False),
                          (64, True), (128, True)]:

@@ -100,6 +100,13 @@ class TestFlashMosaicLowering:
         s, s, s)
 
 
+def _uniform_shapes(tree, sharding):
+  """ShapeDtypeStructs for a tree with one sharding everywhere."""
+  return jax.tree_util.tree_map(
+      lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding),
+      tree, is_leaf=lambda x: hasattr(x, "shape"))
+
+
 def _v5e_devices():
   from jax.experimental import topologies
 
@@ -135,17 +142,50 @@ def _compile_step_for_mesh(model, mesh, batch, rules=None):
         tree, sharding_tree,
         is_leaf=lambda x: hasattr(x, "shape"))
 
-  def shapes_uniform(tree, sharding):
-    return jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                       sharding=sharding),
-        tree, is_leaf=lambda x: hasattr(x, "shape"))
-
   step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
                             batch_spec=batch_spec, donate=False)
   return step.lower(shapes(state_shape, shardings),
-                    shapes_uniform(features, batch_sh),
-                    shapes_uniform(labels, batch_sh)).compile()
+                    _uniform_shapes(features, batch_sh),
+                    _uniform_shapes(labels, batch_sh)).compile()
+
+
+class TestServingCompilesForV5e:
+  """The on-device CEM action-selection loop (the serving hot path:
+  Grasping44 critic scored over 64 samples x 3 iterations inside one
+  jitted call) compiles for v5e — at a reduced image scale so the test
+  stays in CI seconds; the full @472 figure is the AOT script's
+  `serving` mode."""
+
+  def test_device_cem_select_compiles(self):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from tensor2robot_tpu import modes, specs as specs_lib
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.policies import device_cem
+    from tensor2robot_tpu.research.qtopt import flagship
+    from tensor2robot_tpu.research.qtopt import models as qtopt_models
+
+    # The flagship constants keep this CI guard the reduced-scale twin
+    # of the AOT script's serving mode (only image_size differs).
+    model = qtopt_models.QTOptModel(
+        image_size=256, device_type="tpu", network="grasping44",
+        action_size=flagship.ACTION_SIZE,
+        grasp_param_names=flagship.GRASP_PARAM_NAMES,
+        use_bfloat16=True, use_ema=True)
+    features = specs_lib.make_random_numpy(
+        model.preprocessor.get_out_feature_specification(modes.TRAIN),
+        batch_size=2, seed=0)
+    state_shape = jax.eval_shape(
+        lambda rng, f: ts.create_train_state(model, rng, f)[0],
+        jax.random.PRNGKey(0), features)
+    select = device_cem.make_device_cem_fn(
+        model, action_size=flagship.ACTION_SIZE)
+    mesh = Mesh(_v5e_devices()[:1], ("data",))
+    repl = NamedSharding(mesh, PartitionSpec())
+    obs = {"image": jax.ShapeDtypeStruct((256, 256, 3), jnp.uint8,
+                                         sharding=repl)}
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
+    select.lower(_uniform_shapes(state_shape, repl), obs, rng).compile()
 
 
 class TestParallelStacksCompileForV5e:
