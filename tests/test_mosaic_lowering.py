@@ -243,3 +243,21 @@ class TestParallelStacksCompileForV5e:
     _compile_step_for_mesh(
         model, mesh, batch=4,
         rules=pipelined_model.pipeline_parallel_rules())
+
+  def test_ulysses_with_flash_inner_compiles(self):
+    """The deepest combination: the Pallas flash kernel INSIDE the
+    Ulysses all-to-all shard_map, compiled for a real v5e sp mesh —
+    Mosaic kernel + ICI collectives in one program."""
+    import optax
+    from jax.sharding import Mesh
+
+    from tensor2robot_tpu.models import sequence_model
+
+    mesh = Mesh(_v5e_devices().reshape(2, 2), ("data", "sp"))
+    model = sequence_model.SequenceRegressionModel(
+        obs_size=8, action_size=4, hidden_size=32, num_heads=4,
+        sequence_length=256, attention_backend="ulysses",
+        ulysses_inner="flash", device_type="cpu",
+        optimizer_fn=lambda: optax.adam(1e-3))
+    model.set_mesh(mesh)
+    _compile_step_for_mesh(model, mesh, batch=8)
