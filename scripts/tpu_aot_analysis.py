@@ -14,7 +14,8 @@ Usage (CPU-pinned; safe while the tunnel is wedged):
   python scripts/tpu_aot_analysis.py step 64      # train step @ batch
   python scripts/tpu_aot_analysis.py step 64 remat
   python scripts/tpu_aot_analysis.py sweep        # the lever matrix
-  python scripts/tpu_aot_analysis.py multichip    # 4-chip dp compile
+  python scripts/tpu_aot_analysis.py multichip    # 4-chip dp + 16-chip
+                                                  #   dp x fsdp compiles
   python scripts/tpu_aot_analysis.py families     # per-family rooflines
   python scripts/tpu_aot_analysis.py serving      # CEM policy roofline
 """
@@ -259,6 +260,36 @@ def multichip_analysis(batch_size: int = 128) -> None:
       "flops_per_step_tf": round(flops / 1e12, 3),
       "bytes_per_step_gb": round(byts / 1e9, 3),
       "note": "per-chip cost; REAL TPU collectives compiled (4-chip dp)",
+  }))
+
+  # 16-chip scale-out: dp4 x fsdp2 on a v5e:4x4 topology (the mesh
+  # carries a model axis but the flagship declares no model-axis spec
+  # shardings and fsdp_rules only shard 'fsdp', so that axis is
+  # replication — the compiled collectives are dp all-reduce + fsdp
+  # all-gather/reduce-scatter at 16-chip scale).
+  topo16 = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:4x4")
+  mesh16 = Mesh(np.array(topo16.devices).reshape(4, 2, 2),
+                ("data", "fsdp", "model"))
+  shardings = ts.state_shardings(state_shape, mesh16,
+                                 rules=ts.fsdp_rules())
+  state_sh = jax.tree_util.tree_map(
+      lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+      state_shape, shardings, is_leaf=lambda x: hasattr(x, "shape"))
+  data16 = NamedSharding(mesh16, PartitionSpec("data"))
+  start = time.time()
+  compiled = ts.make_train_step(model, mesh=mesh16, shardings=shardings,
+                                donate=False).lower(
+      state_sh, _shapes_with_sharding(features, data16),
+      _shapes_with_sharding(labels, data16)).compile()
+  flops, byts = _cost(compiled)
+  print(json.dumps({
+      "config": f"grasping44_472_bf16_b{batch_size}_dp4xfsdp2_v5e_4x4",
+      "compile_secs": round(time.time() - start, 1),
+      "flops_per_step_tf": round(flops / 1e12, 3),
+      "bytes_per_step_gb": round(byts / 1e9, 3),
+      "note": "per-chip cost; 16-chip dp x fsdp compiled "
+              "(model axis replicated: no tp annotations on this net)",
   }))
 
 
