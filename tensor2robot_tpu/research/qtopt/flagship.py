@@ -20,14 +20,17 @@ GRASP_PARAM_NAMES = {"world_vector": (0, 3), "vertical_rotation": (3, 2)}
 
 
 def make_flagship_model(device_platform: str, remat: bool = False,
-                        space_to_depth: bool = False):
+                        space_to_depth: bool = False,
+                        image_size: int = None):
   """Reference-scale Grasping44 critic on accelerators; small smoke
   critic on 'cpu'. `space_to_depth` folds the stem per
   Grasping44.space_to_depth (exact math, 4x the stem's MXU lane
-  utilization) — a bench probe, off by default."""
+  utilization) — a bench probe, off by default. `image_size` overrides
+  the reference 472 (reduced-scale CI compile twins stay on this one
+  constructor instead of hand-copying it)."""
   on_tpu = device_platform != "cpu"
   return qtopt_models.QTOptModel(
-      image_size=IMAGE_SIZE if on_tpu else 32,
+      image_size=image_size or (IMAGE_SIZE if on_tpu else 32),
       device_type=device_platform,
       network="grasping44" if on_tpu else "small",
       action_size=ACTION_SIZE if on_tpu else 4,

@@ -163,15 +163,10 @@ class TestServingCompilesForV5e:
     from tensor2robot_tpu.parallel import train_step as ts
     from tensor2robot_tpu.policies import device_cem
     from tensor2robot_tpu.research.qtopt import flagship
-    from tensor2robot_tpu.research.qtopt import models as qtopt_models
 
-    # The flagship constants keep this CI guard the reduced-scale twin
-    # of the AOT script's serving mode (only image_size differs).
-    model = qtopt_models.QTOptModel(
-        image_size=256, device_type="tpu", network="grasping44",
-        action_size=flagship.ACTION_SIZE,
-        grasp_param_names=flagship.GRASP_PARAM_NAMES,
-        use_bfloat16=True, use_ema=True)
+    # The ONE flagship constructor, at reduced image scale: this CI
+    # guard stays the twin of the AOT script's serving mode.
+    model = flagship.make_flagship_model("tpu", image_size=256)
     features = specs_lib.make_random_numpy(
         model.preprocessor.get_out_feature_specification(modes.TRAIN),
         batch_size=2, seed=0)
@@ -260,4 +255,23 @@ class TestParallelStacksCompileForV5e:
         ulysses_inner="flash", device_type="cpu",
         optimizer_fn=lambda: optax.adam(1e-3))
     model.set_mesh(mesh)
+    _compile_step_for_mesh(model, mesh, batch=8)
+
+
+class TestSpaceToDepthStemCompilesForV5e:
+  """bench.py probes the space-to-depth stem on the chip at the winning
+  batch WITH the winning remat setting (bench probes s2d after remat);
+  certify both combinations compile for v5e (reduced image scale for CI
+  time) so the probe can never burn a hardware window on a compile
+  failure."""
+
+  @pytest.mark.parametrize("remat", [False, True])
+  def test_s2d_grasping44_train_step_compiles(self, remat):
+    from jax.sharding import Mesh
+
+    from tensor2robot_tpu.research.qtopt import flagship
+
+    model = flagship.make_flagship_model(
+        "tpu", remat=remat, space_to_depth=True, image_size=256)
+    mesh = Mesh(_v5e_devices()[:1], ("data",))
     _compile_step_for_mesh(model, mesh, batch=8)
