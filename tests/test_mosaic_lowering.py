@@ -14,12 +14,19 @@ THIS suite cannot compile on the chip at all.
 
 from __future__ import annotations
 
+import importlib
+import json
+import os
+import sys
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from tensor2robot_tpu.ops import attention
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _export_for_tpu(fn, *shapes):
@@ -256,6 +263,40 @@ class TestParallelStacksCompileForV5e:
         optimizer_fn=lambda: optax.adam(1e-3))
     model.set_mesh(mesh)
     _compile_step_for_mesh(model, mesh, batch=8)
+
+
+class TestAOTCostPins:
+  """Compiler-cost regression guard: the flagship b64/b128 train-step
+  flops and bytes-accessed, as computed by the real local XLA:TPU v5e
+  compiler, must stay within 10% of the values committed in
+  AOT_ANALYSIS_r04.json. Without this, a refactor that doubles
+  bytes/step (e.g. re-introducing the round-2 f32 activation leak,
+  which was exactly a 1.5x bytes regression) passes every green test
+  and silently burns the next hardware window. ~2 min compile each —
+  the price of making the AOT unlock durable.
+
+  On an intentional cost change (new stem, different fusion), rerun
+  `python scripts/tpu_aot_analysis.py sweep` and re-commit the artifact
+  with the rationale in PERFORMANCE.md — the failure message prints the
+  new record to make that a copy-paste."""
+
+  @pytest.mark.parametrize("batch", [64, 128])
+  def test_flagship_cost_within_10pct_of_committed(self, batch):
+    scripts_dir = os.path.join(_REPO_ROOT, "scripts")
+    if scripts_dir not in sys.path:
+      sys.path.insert(0, scripts_dir)
+    aot = importlib.import_module("tpu_aot_analysis")
+    with open(os.path.join(_REPO_ROOT, "AOT_ANALYSIS_r04.json")) as f:
+      matrix = json.load(f)["flagship_lever_matrix"]
+    pinned = {e["config"]: e for e in matrix}[
+        f"grasping44_472_bf16_b{batch}"]
+    got = aot.step_analysis(batch, remat=False)
+    for key in ("flops_per_step_tf", "bytes_per_step_gb"):
+      want = pinned[key]
+      assert abs(got[key] - want) <= 0.10 * want, (
+          f"{key} at batch {batch} drifted >10% from the committed pin: "
+          f"pinned={want}, now={got[key]}. If intentional, re-baseline "
+          f"AOT_ANALYSIS_r04.json with this record: {got}")
 
 
 class TestSpaceToDepthStemCompilesForV5e:
