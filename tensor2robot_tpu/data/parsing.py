@@ -44,11 +44,16 @@ _EXTRACTED_VALUE_CAP = 4
 # a stream that is legacy-format throughout stops paying for the wasted
 # native pass after this many batches in a row fall back.
 _NATIVE_DISABLE_STREAK = 3
-# Total (non-consecutive) mismatch budget: a shuffle-merge of legacy and
+# Non-consecutive mismatch budget: a shuffle-merge of legacy and
 # new-format shards interleaves mismatches with good batches, so the
-# streak alone would never trip — stop paying for wasted native passes
-# once this many batches of a stream have fallen back overall.
+# streak alone would never trip. Disable once this many batches have
+# fallen back overall AND mismatches are at least _NATIVE_DISABLE_RATIO
+# of all batches attempted natively — the ratio guard keeps a
+# multi-day stream with rare anomalous records (say 1 bad batch per
+# 10k) on the fast path for its lifetime, while a genuinely mixed
+# stream (a legacy shard merge runs ~50% mismatched) still trips.
 _NATIVE_DISABLE_TOTAL = 20
+_NATIVE_DISABLE_RATIO = 0.25
 
 
 class _NativeFormatMismatch(Exception):
@@ -232,6 +237,7 @@ class ParseFn:
     self._native_parsers: Dict[str, Any] = {}
     self._native_mismatch_streak: Dict[str, int] = {}
     self._native_mismatch_total: Dict[str, int] = {}
+    self._native_batches_attempted: Dict[str, int] = {}
     for dkey in self._dataset_keys:
       subset = specs_lib.filter_by_dataset(merged, dkey)
       self._plans[dkey] = _plan_for(subset)
@@ -259,6 +265,7 @@ class ParseFn:
           self._plans[dkey])
       self._native_mismatch_streak[dkey] = 0
       self._native_mismatch_total[dkey] = 0
+      self._native_batches_attempted[dkey] = 0
 
   def _maybe_native_parser(self, plans: List[_LeafPlan]):
     """Builds the C++ columnar parser when every leaf fits its profile:
@@ -467,6 +474,8 @@ class ParseFn:
       raise ValueError(f"Dataset batch sizes differ: {batch_sizes}")
     for dkey, serialized_list in records.items():
       if self._native_parsers.get(dkey) is not None:
+        attempted = self._native_batches_attempted.get(dkey, 0) + 1
+        self._native_batches_attempted[dkey] = attempted
         try:
           batched.update(self._parse_batch_native(dkey, serialized_list))
           self._native_mismatch_streak[dkey] = 0
@@ -477,13 +486,15 @@ class ParseFn:
           # batch falls back — one anomalous record must not downgrade
           # the whole stream. Two disable triggers bound the wasted
           # native passes: _NATIVE_DISABLE_STREAK mismatches in a row
-          # (the stream carries that format throughout) and
-          # _NATIVE_DISABLE_TOTAL overall (legacy shards shuffle-merged
-          # with new-format ones, where good batches keep resetting the
-          # streak). Loud on first fallback and on disable, debug in
-          # between: the Python path is orders of magnitude slower, and
-          # a silent downgrade would be undiagnosable — but one warning
-          # per mismatched batch would spam a multi-hour run.
+          # (the stream carries that format throughout) and the
+          # _NATIVE_DISABLE_TOTAL + _NATIVE_DISABLE_RATIO pair (legacy
+          # shards shuffle-merged with new-format ones, where good
+          # batches keep resetting the streak; the ratio guard keeps a
+          # long stream with RARE anomalies on the fast path forever).
+          # Loud on first fallback and on disable, debug in between:
+          # the Python path is orders of magnitude slower, and a silent
+          # downgrade would be undiagnosable — but one warning per
+          # mismatched batch would spam a multi-hour run.
           streak = self._native_mismatch_streak.get(dkey, 0) + 1
           self._native_mismatch_streak[dkey] = streak
           total = self._native_mismatch_total.get(dkey, 0) + 1
@@ -493,7 +504,8 @@ class ParseFn:
               "(legacy float_list/int64_list plane, or a plane split "
               f"across >{_EXTRACTED_VALUE_CAP} bytes values)")
           if (streak >= _NATIVE_DISABLE_STREAK
-              or total >= _NATIVE_DISABLE_TOTAL):
+              or (total >= _NATIVE_DISABLE_TOTAL
+                  and total >= _NATIVE_DISABLE_RATIO * attempted)):
             logging.warning(
                 "Native columnar parser disabled for dataset %r: %s in "
                 "%d consecutive / %d total batches. Falling back to the "
@@ -504,10 +516,12 @@ class ParseFn:
             logging.warning(
                 "Native columnar parser fell back to the Python path for "
                 "one batch of dataset %r: %s. The native path stays "
-                "enabled; %d consecutive or %d total mismatched batches "
-                "disable it (further per-batch fallbacks log at debug).",
+                "enabled; %d consecutive mismatches, or %d total at "
+                ">=%d%% of attempted batches, disable it (further "
+                "per-batch fallbacks log at debug).",
                 dkey, detail, _NATIVE_DISABLE_STREAK,
-                _NATIVE_DISABLE_TOTAL)
+                _NATIVE_DISABLE_TOTAL,
+                int(_NATIVE_DISABLE_RATIO * 100))
           else:
             logging.debug(
                 "Native parser per-batch fallback for dataset %r: %s "

@@ -76,9 +76,19 @@ def custom_crop(image: jnp.ndarray, centers: jnp.ndarray,
   Reference CustomCropImages (preprocessors/image_transformations.py
   :104-173): crop centers are clamped so the window stays inside the
   image (max with target//2, min with dim - target//2), then a
-  target_shape glimpse is extracted around the clamped center. Pinned
-  against the executed reference op in
-  tests/test_reference_executed_parity.py.
+  target_shape glimpse is extracted around the clamped center.
+
+  INTENTIONAL DIVERGENCE (ADVICE r4): the reference clamps (y, x) but
+  then feeds [x, y] to v1 extract_glimpse, which reads offsets as
+  (y, x) — so it actually crops at the TRANSPOSED center. This op
+  implements the documented intent (crop at the given (y, x) center);
+  exact agreement with the reference therefore holds only for y == x
+  centers on square images. Anyone porting a reference-trained
+  pipeline with asymmetric crop centers must swap the center columns
+  to reproduce the reference's behavior. Both facts are pinned in
+  tests/test_reference_executed_parity.py: the intent path against a
+  symmetric-center executed crop, and the swapped behavior as a
+  documented-divergence test.
 
   Args:
     image: [B, H, W, C] batch.

@@ -12,6 +12,8 @@ with its own honest labeling at the call sites.
 
 from __future__ import annotations
 
+from typing import Optional
+
 from tensor2robot_tpu.research.qtopt import models as qtopt_models
 
 IMAGE_SIZE = 472
@@ -21,7 +23,7 @@ GRASP_PARAM_NAMES = {"world_vector": (0, 3), "vertical_rotation": (3, 2)}
 
 def make_flagship_model(device_platform: str, remat: bool = False,
                         space_to_depth: bool = False,
-                        image_size: int = None):
+                        image_size: Optional[int] = None):
   """Reference-scale Grasping44 critic on accelerators; small smoke
   critic on 'cpu'. `space_to_depth` folds the stem per
   Grasping44.space_to_depth (exact math, 4x the stem's MXU lane
@@ -30,7 +32,8 @@ def make_flagship_model(device_platform: str, remat: bool = False,
   constructor instead of hand-copying it)."""
   on_tpu = device_platform != "cpu"
   return qtopt_models.QTOptModel(
-      image_size=image_size or (IMAGE_SIZE if on_tpu else 32),
+      image_size=(image_size if image_size is not None
+                  else (IMAGE_SIZE if on_tpu else 32)),
       device_type=device_platform,
       network="grasping44" if on_tpu else "small",
       action_size=ACTION_SIZE if on_tpu else 4,

@@ -263,6 +263,35 @@ class TestNativeExampleParser:
     assert fast._native_parsers[""] is None, \
         "total mismatch budget must disable the native path"
 
+  def test_native_rare_mismatch_ratio_never_disables(self, lib):
+    """A long-lived stream with RARE anomalous batches keeps the fast
+    path indefinitely (ADVICE r4): the total budget only disables when
+    mismatches are also >= _NATIVE_DISABLE_RATIO of attempted batches,
+    so 1-in-10 anomalies never trip it even past the total count."""
+    from tensor2robot_tpu.data import codec, parsing
+    from tensor2robot_tpu.specs import SpecStruct, TensorSpec
+
+    spec = SpecStruct({
+        "plane": TensorSpec(shape=(2, 3), dtype=np.float32, name="plane",
+                            data_format="png", is_extracted=True),
+    })
+    values = np.arange(6, dtype=np.float32).reshape(2, 3)
+    legacy = codec.encode_example({"plane": values}, None)  # float_list
+    good = codec.encode_example({"plane": values}, spec)    # bytes plane
+    fast = parsing.create_parse_fn(spec)
+    assert fast._native_parsers[""] is not None
+    # Mismatch ratio 10% (1 legacy per 10 batches), well under the 25%
+    # ratio gate; run past the total budget to prove the count alone no
+    # longer disables.
+    for _ in range(parsing._NATIVE_DISABLE_TOTAL + 5):
+      out = fast.parse_batch([legacy])
+      np.testing.assert_allclose(out["features/plane"][0], values)
+      for _ in range(9):
+        fast.parse_batch([good])
+    assert fast._native_mismatch_total[""] > parsing._NATIVE_DISABLE_TOTAL
+    assert fast._native_parsers[""] is not None, \
+        "rare anomalies must not permanently disable the native path"
+
   def test_extracted_plane_over_cap_split_falls_back(self, lib):
     """A plane split across more bytes values than the native cap joins
     correctly via the Python fallback (pre-native behavior preserved)."""
