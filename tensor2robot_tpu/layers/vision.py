@@ -28,14 +28,24 @@ __all__ = ["FilmParams", "film", "BerkeleyNet", "HighResBerkeleyNet",
 # - BuildImagesToFeaturesModel (the BerkeleyNet tower): slim.batch_norm
 #   decay=0.99, epsilon=1e-4, scale=False (vision_layers.py:72-77); conv
 #   weights slim.xavier_initializer() with constant 0.01 biases
-#   (vision_layers.py:123-126).
+#   (vision_layers.py:123-126). NOTE slim.conv2d creates NO bias at all
+#   when a normalizer_fn is set — the scope passes
+#   normalizer_fn=normalizer_fn (:128), so in the default
+#   layer_norm/batch_norm towers conv biases simply don't exist; the
+#   0.01 pin applies only to the normalizer=None configuration
+#   (ADVICE r4: carrying a bias under layer_norm would be an extra
+#   learnable degree of freedom the reference doesn't have).
 # - BuildImagesToFeaturesModelHighRes: its OWN conv arg scope uses
 #   truncated_normal(stddev=0.1) with default zero biases
-#   (vision_layers.py:236-241).
+#   (vision_layers.py:236-241), again only without a normalizer.
 # - BuildImageFeaturesToPoseModel (the pose head): FC weights
 #   truncated_normal(stddev=0.01) with constant 0.01 biases, and the
 #   bias-transform variable itself initializes at 0.01
-#   (vision_layers.py:317-328).
+#   (vision_layers.py:317-328). The HIDDEN layers pass
+#   normalizer_fn=slim.layer_norm (:335, the signature default at every
+#   reference call site) — so they are matmul (no bias) -> layer_norm
+#   -> relu; only the output layer (normalizer-less, :337-341) carries
+#   the 0.01 bias.
 # - tf.contrib.layers.layer_norm normalizes with variance_epsilon=1e-12
 #   (its hardcoded default); flax LayerNorm defaults to 1e-6. Stats run
 #   in f32 on both sides, so 1e-12 is safe to match.
@@ -95,7 +105,11 @@ class BerkeleyNet(nn.Module):
     x = normalize_image(images, self.dtype)
     for i, (f, k, s) in enumerate(zip(self.filters, self.kernel_sizes,
                                       self.strides)):
+      # slim.conv2d semantics: a conv under a normalizer_fn has NO bias
+      # (the normalizer's own center term replaces it); the bias pin
+      # only exists on the normalizer-less path.
       x = nn.Conv(f, (k, k), strides=(s, s),
+                  use_bias=self.normalizer == "none",
                   kernel_init=self.conv_kernel_init,
                   bias_init=self.conv_bias_init, name=f"conv_{i}")(x)
       # Explicit norm dtype: with dtype=None the f32 stats/params win the
@@ -166,8 +180,9 @@ class PipelinedBerkeleyTower(nn.Module):
     defs = []
     for i, ((_, _, cin), (_, _, cout)) in enumerate(geometry):
       k = self.kernel_sizes[i]
+      # No conv bias: BerkeleyNet-with-layer_norm semantics (slim drops
+      # the bias under a normalizer_fn; ln_bias is the center term).
       d = {"kernel": ((k, k, cin, cout), _CONV_KERNEL_INIT),
-           "bias": ((cout,), _CONV_BIAS_INIT),
            "ln_scale": ((cout,), nn.initializers.ones),
            "ln_bias": ((cout,), nn.initializers.zeros)}
       if self.condition_size:
@@ -231,7 +246,6 @@ class PipelinedBerkeleyTower(nn.Module):
         y = jax.lax.conv_general_dilated(
             act, p["kernel"].astype(compute), (stride, stride), "SAME",
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        y = y + p["bias"].astype(compute)
         # LayerNorm over the channel axis, stats in f32 (flax semantics);
         # epsilon pinned to BerkeleyNet's (the parity test in
         # tests/test_layers.py compares the two with shared weights).
@@ -316,11 +330,19 @@ class HighResBerkeleyNet(nn.Module):
 class PoseHead(nn.Module):
   """FC pose regression head with an optional bias-transform input
   (reference BuildImageFeaturesToPoseModel :277-350): a learned constant
-  vector concatenated to the features — the MAML bias-transform trick."""
+  vector concatenated to the features — the MAML bias-transform trick.
+
+  Hidden layers follow the reference's slim semantics at its default
+  (and every call site's) normalizer_fn=slim.layer_norm: matmul with NO
+  bias -> layer_norm -> relu. Only the normalizer-less output layer
+  carries the 0.01-initialized bias. `normalizer='none'` restores plain
+  biased FCs for the reference's normalizer_fn=None configuration."""
 
   output_size: int = 7
   hidden_sizes: Sequence[int] = (100, 100)
   bias_transform_size: int = 0
+  normalizer: str = "layer_norm"  # 'layer_norm' | 'none'
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, features: jnp.ndarray,
@@ -336,7 +358,12 @@ class PoseHead(nn.Module):
                        (x.shape[0], 1))
       x = jnp.concatenate([x, tiled], axis=-1)
     for i, size in enumerate(self.hidden_sizes):
-      x = nn.relu(nn.Dense(size, kernel_init=_FC_KERNEL_INIT,
-                           bias_init=_FC_BIAS_INIT, name=f"fc_{i}")(x))
+      x = nn.Dense(size, use_bias=self.normalizer == "none",
+                   kernel_init=_FC_KERNEL_INIT,
+                   bias_init=_FC_BIAS_INIT, name=f"fc_{i}")(x)
+      if self.normalizer == "layer_norm":
+        x = nn.LayerNorm(epsilon=_LAYER_NORM_EPSILON, dtype=self.dtype,
+                         name=f"fc_norm_{i}")(x)
+      x = nn.relu(x)
     return nn.Dense(self.output_size, kernel_init=_FC_KERNEL_INIT,
                     bias_init=_FC_BIAS_INIT, name="pose")(x)

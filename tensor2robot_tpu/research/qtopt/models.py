@@ -95,7 +95,8 @@ def stem_kernel_to_s2d(kernel: jnp.ndarray) -> jnp.ndarray:
   """Maps a [6, 6, C, O] stride-2 stem kernel to the exactly equivalent
   [3, 3, 4C, O] space-to-depth kernel (Grasping44.space_to_depth):
   w_s2d[ki, kj, (py*2 + px)*C + c, o] = w[2*ki + py, 2*kj + px, c, o].
-  Use to convert reference-layout checkpoints to the s2d stem."""
+  Use to convert reference-layout checkpoints to the s2d stem (the
+  stem's [O] bias is layout-independent and carries over unchanged)."""
   kh, kw, c, o = kernel.shape
   if kh != 6 or kw != 6:
     raise ValueError(f"expected a [6, 6, C, O] stem kernel, got "
@@ -147,14 +148,18 @@ class Grasping44(nn.Module):
   space_to_depth: bool = False
   dtype: Optional[Any] = None  # compute dtype (bf16 under the TPU policy)
 
-  def _bn(self, name):
+  def _bn(self, name, use_scale: bool = True):
     # Explicit dtype: flax BatchNorm computes stats in f32 internally and,
     # with dtype=None, PROMOTES its output to f32 (the f32 running stats /
     # stat computation win the promotion) — one BN would re-poison the
-    # bf16 tower after every conv.
+    # bf16 tower after every conv. use_scale=False for the reference's
+    # "separate" batch norms (stem + fcgrasp, networks.py:451-459 and
+    # :502-510): those call slim.batch_norm(..., scale=False) directly,
+    # unlike the conv-attached norms whose arg-scope dict sets
+    # scale=True (:393-406).
     return nn.BatchNorm(momentum=self.batch_norm_decay,
                         epsilon=self.batch_norm_epsilon, dtype=self.dtype,
-                        name=name)
+                        use_scale=use_scale, name=name)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
@@ -164,7 +169,10 @@ class Grasping44(nn.Module):
     image = normalize_image(features["state/image"], self.dtype)
     use_ra = not train
 
-    # Stem (reference conv1_1 + pool1).
+    # Stem (reference conv1_1 + pool1). Unlike the deeper convs, conv1_1
+    # opts OUT of the normalizer arg scope (normalizer_fn=None,
+    # networks.py:443-450), so slim gives it a zero-init bias; its
+    # "separate" batch norm then runs with scale=False (:459).
     if self.space_to_depth:
       b, h, w, c = image.shape
       if h % 2 or w % 2:
@@ -172,13 +180,14 @@ class Grasping44(nn.Module):
             f"space_to_depth stem needs even spatial dims, got {h}x{w}")
       folded = image.reshape(b, h // 2, 2, w // 2, 2, c).transpose(
           0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
-      net = nn.Conv(self.filters, (3, 3), strides=(1, 1), use_bias=False,
+      net = nn.Conv(self.filters, (3, 3), strides=(1, 1),
                     kernel_init=_TRUNC_NORMAL_001,
                     name="conv1_1_s2d")(folded)
     else:
-      net = nn.Conv(self.filters, (6, 6), strides=(2, 2), use_bias=False,
+      net = nn.Conv(self.filters, (6, 6), strides=(2, 2),
                     kernel_init=_TRUNC_NORMAL_001, name="conv1_1")(image)
-    net = nn.relu(self._bn("conv1_bn")(net, use_running_average=use_ra))
+    net = nn.relu(self._bn("conv1_bn", use_scale=False)(
+        net, use_running_average=use_ra))
     net = nn.max_pool(net, (3, 3), strides=(3, 3), padding="SAME")
 
     conv_id = 2
@@ -220,7 +229,9 @@ class Grasping44(nn.Module):
       blocks = [("fcgrasp", grasp_params)]
     fcgrasp = sum(
         nn.Dense(256, kernel_init=_TRUNC_NORMAL_001, name=name)(block) for name, block in blocks)
-    fcgrasp = nn.relu(self._bn("fcgrasp_bn")(
+    # Another "separate" norm in the reference (slim.batch_norm on the
+    # add_n sum, scale=False, networks.py:500-510).
+    fcgrasp = nn.relu(self._bn("fcgrasp_bn", use_scale=False)(
         fcgrasp, use_running_average=use_ra))
     fcgrasp = nn.Dense(self.grasp_context_size, use_bias=False,
                        kernel_init=_TRUNC_NORMAL_001, name="fcgrasp2")(fcgrasp)

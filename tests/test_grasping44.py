@@ -157,7 +157,8 @@ class TestGrasping44:
     updates, _ = optimizer.update(zero_grads, opt_state, params)
     conv_update = np.abs(np.asarray(updates["conv1_1"]["kernel"])).max()
     bn_update = np.abs(np.asarray(
-        updates["conv1_bn"]["scale"])).max()
+        updates["conv1_bn"]["bias"])).max()  # beta (the stem BN carries
+    # no gamma: the reference's separate norms run scale=False)
     assert conv_update > 0.0  # kernels decay toward zero
     assert bn_update == 0.0   # 1-D params (BN/bias) are not decayed
 
@@ -287,7 +288,8 @@ class TestSpaceToDepthStem:
     params_s2d = dict(variables["params"])
     stem = params_s2d.pop("conv1_1")
     params_s2d["conv1_1_s2d"] = {
-        "kernel": qtopt_models.stem_kernel_to_s2d(stem["kernel"])}
+        "kernel": qtopt_models.stem_kernel_to_s2d(stem["kernel"]),
+        "bias": stem["bias"]}  # [O] bias is layout-independent
     vars_s2d = {**variables, "params": params_s2d}
 
     out_std = std.apply(variables, features, train=False)

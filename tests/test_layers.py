@@ -106,7 +106,6 @@ class TestVision:
     for i in range(len(filters)):
       stage_params.append({
           "kernel": p[f"conv_{i}"]["kernel"],
-          "bias": p[f"conv_{i}"]["bias"],
           "ln_scale": p[f"norm_{i}"]["scale"],
           "ln_bias": p[f"norm_{i}"]["bias"],
           "film_kernel": p[f"film_{i}"]["film_proj"]["kernel"],
@@ -461,7 +460,7 @@ class TestTF1ParityPins:
         np.random.RandomState(1).rand(4, 16, 16, 3), jnp.float32)
     variables = module.init(jax.random.PRNGKey(0), x)
     conv0 = variables["params"]["conv_0"]
-    y = nn.Conv(64, (7, 7), strides=(2, 2),
+    y = nn.Conv(64, (7, 7), strides=(2, 2), use_bias=False,
                 name="conv_0").bind({"params": conv0})(x)
     running = self._recovered_momentum(
         module, variables, x, ("norm_0", "mean"))
@@ -472,8 +471,9 @@ class TestTF1ParityPins:
     assert abs(recovered - 0.99) < 1e-3, recovered
 
   def test_berkeleynet_conv_init_pinned_to_reference(self):
-    """Xavier-uniform kernels (bounded, uniform) + 0.01 biases — not
-    flax's lecun_normal/zeros."""
+    """Xavier-uniform kernels (bounded, uniform); conv biases exist ONLY
+    on the normalizer-less path (slim.conv2d creates no bias under a
+    normalizer_fn — ADVICE r4), where they pin at 0.01."""
     module = vision.BerkeleyNet()
     x = jnp.zeros((1, 32, 32, 3), jnp.float32)
     params = module.init(jax.random.PRNGKey(3), x)["params"]
@@ -483,12 +483,20 @@ class TestTF1ParityPins:
     bound = np.sqrt(6.0 / (fan_in + fan_out))
     assert np.abs(kernel).max() <= bound + 1e-6  # uniform: hard bound
     assert np.abs(kernel).max() > 0.8 * bound    # ...and actually fills it
-    np.testing.assert_allclose(np.asarray(params["conv_0"]["bias"]), 0.01)
+    # Default tower (layer_norm): no conv bias, like the reference.
+    assert "bias" not in params["conv_0"]
+    bare = vision.BerkeleyNet(normalizer="none", use_spatial_softmax=False)
+    bare_params = bare.init(jax.random.PRNGKey(3), x)["params"]
+    np.testing.assert_allclose(
+        np.asarray(bare_params["conv_0"]["bias"]), 0.01)
 
   def test_pose_head_fc_init_pinned_to_reference(self):
-    """truncated_normal(stddev=0.01) FC weights with 0.01 constant
-    biases, and the bias-transform variable itself at 0.01 (reference
-    BuildImageFeaturesToPoseModel, vision_layers.py:317-328)."""
+    """truncated_normal(stddev=0.01) FC weights; the bias-transform
+    variable at 0.01 (reference BuildImageFeaturesToPoseModel,
+    vision_layers.py:317-328). Hidden FCs run under the reference's
+    default normalizer_fn=slim.layer_norm (:335): no bias, a LayerNorm
+    after the matmul. Only the normalizer-less output layer carries the
+    0.01 bias."""
     module = vision.PoseHead(hidden_sizes=(64,), output_size=7,
                              bias_transform_size=10)
     params = module.init(jax.random.PRNGKey(4),
@@ -497,8 +505,18 @@ class TestTF1ParityPins:
       kernel = np.asarray(params[layer]["kernel"])
       assert np.abs(kernel).max() <= 0.02 + 1e-6, layer  # 2-sigma bound
       assert 0.005 < kernel.std() < 0.012, (layer, kernel.std())
-      np.testing.assert_allclose(np.asarray(params[layer]["bias"]), 0.01)
+    assert "bias" not in params["fc_0"]  # hidden: slim drops it under LN
+    assert "fc_norm_0" in params        # ...and the LN exists
+    np.testing.assert_allclose(np.asarray(params["pose"]["bias"]), 0.01)
     np.testing.assert_allclose(np.asarray(params["bias_transform"]), 0.01)
+    # normalizer='none' restores the reference's biased-FC configuration.
+    bare = vision.PoseHead(hidden_sizes=(64,), output_size=7,
+                           normalizer="none")
+    bare_params = bare.init(jax.random.PRNGKey(4),
+                            jnp.zeros((1, 16), jnp.float32))["params"]
+    np.testing.assert_allclose(
+        np.asarray(bare_params["fc_0"]["bias"]), 0.01)
+    assert "fc_norm_0" not in bare_params
 
   def test_high_res_tower_init_pinned_to_reference(self):
     """BuildImagesToFeaturesModelHighRes uses its OWN conv scope —
@@ -514,8 +532,10 @@ class TestTF1ParityPins:
       kernel = np.asarray(layer["kernel"])
       assert np.abs(kernel).max() <= 0.2 + 1e-6, path  # 2-sigma bound
       assert 0.07 < kernel.std() < 0.11, (path, kernel.std())
-    np.testing.assert_allclose(
-        np.asarray(params["main"]["conv_0"]["bias"]), 0.0)
+    # The main tower runs under a normalizer, so slim semantics give its
+    # convs no bias at all (the zero-bias pin applies only bias-ful
+    # configurations; ADVICE r4).
+    assert "bias" not in params["main"]["conv_0"]
 
   def test_berkeleynet_batch_norm_has_no_scale(self):
     """slim.batch_norm scale=False in the reference tower params
