@@ -220,25 +220,19 @@ def flash_analysis() -> None:
     run("fwd_bwd", bwd, t)
 
 
-def multichip_analysis(batch_size: int = 128) -> None:
-  """Compile the REAL dp-sharded train step for a 4-chip v5e mesh —
-  actual TPU collectives/layouts, not the CPU-virtual-device dryrun."""
+def _compile_sharded_step(model, mesh, batch_size: int, tag: str,
+                          note: str, rules=None) -> None:
+  """Compiles the production-sharded flagship train step for `mesh`
+  (state shardings from `rules` — replicated when None — and batches
+  over 'data') and prints the per-chip cost record. The ONE scaffolding
+  for every multichip/multislice mode, and the full-scale twin of
+  tests/test_mosaic_lowering.py `_compile_step_for_mesh`."""
   import jax
-  import numpy as np
-  from jax.experimental import topologies
-  from jax.sharding import Mesh, NamedSharding, PartitionSpec
+  from jax.sharding import NamedSharding, PartitionSpec
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts
-  from tensor2robot_tpu.research.qtopt import flagship
 
-  topo = topologies.get_topology_desc(platform="tpu",
-                                      topology_name="v5e:2x2")
-  mesh = Mesh(np.array(topo.devices).reshape(4, 1, 1),
-              ("data", "fsdp", "model"))
-  repl = NamedSharding(mesh, PartitionSpec())
-  data_sharded = NamedSharding(mesh, PartitionSpec("data"))
-  model = flagship.make_flagship_model("tpu")
   features = specs_lib.make_random_numpy(
       model.preprocessor.get_out_feature_specification(modes.TRAIN),
       batch_size=batch_size, seed=0)
@@ -248,49 +242,92 @@ def multichip_analysis(batch_size: int = 128) -> None:
   state_shape = jax.eval_shape(
       lambda rng, f: ts.create_train_state(model, rng, f)[0],
       jax.random.PRNGKey(0), features)
+  shardings = ts.state_shardings(state_shape, mesh, rules=rules)
+  state_sh = jax.tree_util.tree_map(
+      lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+      state_shape, shardings, is_leaf=lambda x: hasattr(x, "shape"))
+  data_sh = NamedSharding(mesh, PartitionSpec("data"))
   start = time.time()
-  compiled = ts.make_train_step(model, donate=False).lower(
-      _shapes_with_sharding(state_shape, repl),
-      _shapes_with_sharding(features, data_sharded),
-      _shapes_with_sharding(labels, data_sharded)).compile()
+  compiled = ts.make_train_step(model, mesh=mesh, shardings=shardings,
+                                donate=False).lower(
+      state_sh, _shapes_with_sharding(features, data_sh),
+      _shapes_with_sharding(labels, data_sh)).compile()
   flops, byts = _cost(compiled)
   print(json.dumps({
-      "config": f"grasping44_472_bf16_b{batch_size}_dp4_v5e_2x2",
+      "config": tag,
       "compile_secs": round(time.time() - start, 1),
       "flops_per_step_tf": round(flops / 1e12, 3),
       "bytes_per_step_gb": round(byts / 1e9, 3),
-      "note": "per-chip cost; REAL TPU collectives compiled (4-chip dp)",
+      "note": note,
   }))
 
-  # 16-chip scale-out: dp4 x fsdp2 on a v5e:4x4 topology (the mesh
-  # carries a model axis but the flagship declares no model-axis spec
-  # shardings and fsdp_rules only shard 'fsdp', so that axis is
-  # replication — the compiled collectives are dp all-reduce + fsdp
-  # all-gather/reduce-scatter at 16-chip scale).
+
+def multichip_analysis(batch_size: int = 128) -> None:
+  """Compile the REAL dp-sharded train step for a 4-chip v5e mesh —
+  actual TPU collectives/layouts, not the CPU-virtual-device dryrun —
+  then the 16-chip dp4 x fsdp2 scale-out on v5e:4x4 (the mesh carries a
+  model axis but the flagship declares no model-axis spec shardings and
+  fsdp_rules only shard 'fsdp', so that axis is replication — the
+  compiled collectives are dp all-reduce + fsdp
+  all-gather/reduce-scatter at 16-chip scale)."""
+  import numpy as np
+  from jax.experimental import topologies
+  from jax.sharding import Mesh
+
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  model = flagship.make_flagship_model("tpu")
+  topo = topologies.get_topology_desc(platform="tpu",
+                                      topology_name="v5e:2x2")
+  mesh = Mesh(np.array(topo.devices).reshape(4, 1, 1),
+              ("data", "fsdp", "model"))
+  _compile_sharded_step(
+      model, mesh, batch_size,
+      f"grasping44_472_bf16_b{batch_size}_dp4_v5e_2x2",
+      "per-chip cost; REAL TPU collectives compiled (4-chip dp)")
+
   topo16 = topologies.get_topology_desc(platform="tpu",
                                         topology_name="v5e:4x4")
   mesh16 = Mesh(np.array(topo16.devices).reshape(4, 2, 2),
                 ("data", "fsdp", "model"))
-  shardings = ts.state_shardings(state_shape, mesh16,
-                                 rules=ts.fsdp_rules())
-  state_sh = jax.tree_util.tree_map(
-      lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
-      state_shape, shardings, is_leaf=lambda x: hasattr(x, "shape"))
-  data16 = NamedSharding(mesh16, PartitionSpec("data"))
-  start = time.time()
-  compiled = ts.make_train_step(model, mesh=mesh16, shardings=shardings,
-                                donate=False).lower(
-      state_sh, _shapes_with_sharding(features, data16),
-      _shapes_with_sharding(labels, data16)).compile()
-  flops, byts = _cost(compiled)
-  print(json.dumps({
-      "config": f"grasping44_472_bf16_b{batch_size}_dp4xfsdp2_v5e_4x4",
-      "compile_secs": round(time.time() - start, 1),
-      "flops_per_step_tf": round(flops / 1e12, 3),
-      "bytes_per_step_gb": round(byts / 1e9, 3),
-      "note": "per-chip cost; 16-chip dp x fsdp compiled "
-              "(model axis replicated: no tp annotations on this net)",
-  }))
+  _compile_sharded_step(
+      model, mesh16, batch_size,
+      f"grasping44_472_bf16_b{batch_size}_dp4xfsdp2_v5e_4x4",
+      "per-chip cost; 16-chip dp x fsdp compiled "
+      "(model axis replicated: no tp annotations on this net)",
+      rules=ts.fsdp_rules())
+
+
+def multislice_analysis(batch_size: int = 128) -> None:
+  """Compile the flagship step for a 2-SLICE v5e hybrid mesh: dp over
+  DCN (the outer axis create_hybrid_device_mesh routes across slices),
+  fsdp over ICI inside each slice — through the repo's own
+  `parallel.mesh.create_mesh(dcn_data_parallelism=...)` path, so the
+  claimed DCN hybrid support meets the real compiler (VERDICT r4 item
+  8). The compiled program carries cross-slice dp all-reduce over DCN +
+  in-slice fsdp all-gather/reduce-scatter over ICI."""
+  from jax.experimental import topologies
+
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  topo = topologies.get_topology_desc(platform="tpu",
+                                      topology_name="v5e:2x2",
+                                      num_slices=2)
+  mesh = mesh_lib.create_mesh(mesh_shape=[2, 4, 1],
+                              axis_names=("data", "fsdp", "model"),
+                              devices=topo.devices,
+                              dcn_data_parallelism=2)
+  _compile_sharded_step(
+      model=flagship.make_flagship_model("tpu"), mesh=mesh,
+      batch_size=batch_size,
+      tag=f"grasping44_472_bf16_b{batch_size}_dcn2x_fsdp4_v5e_2slice",
+      note="per-chip cost; 2-slice hybrid mesh (dp over DCN, fsdp "
+           "over ICI) via parallel.mesh.create_mesh "
+           "dcn_data_parallelism=2; 8 chips total",
+      rules=ts.fsdp_rules())
 
 
 def main():
@@ -302,6 +339,8 @@ def main():
     step_analysis(batch, remat="remat" in sys.argv)
   elif mode == "multichip":
     multichip_analysis(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
+  elif mode == "multislice":
+    multislice_analysis(int(sys.argv[2]) if len(sys.argv) > 2 else 128)
   elif mode == "families":
     families_analysis()
   elif mode == "serving":

@@ -265,6 +265,44 @@ class TestParallelStacksCompileForV5e:
     _compile_step_for_mesh(model, mesh, batch=8)
 
 
+class TestMultisliceDCNHybridCompilesForV5e:
+  """parallel.mesh.create_mesh(dcn_data_parallelism=...) builds a
+  hybrid mesh whose outer data axis crosses slices over DCN; until
+  round 5 only single-slice ICI meshes had met the real compiler. This
+  compiles the flagship train step for an actual 2-slice v5e topology
+  (cross-slice dp all-reduce over DCN + in-slice fsdp collectives over
+  ICI) at reduced image scale; the full-472 figure is the AOT script's
+  `multislice` mode (AOT_ANALYSIS_r05.json)."""
+
+  def test_dcn_dp_x_ici_fsdp_2slice_compiles(self):
+    from jax.experimental import topologies
+
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.research.qtopt import flagship
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2",
+                                        num_slices=2)
+    devices = np.array(topo.devices)
+    assert len({getattr(d, "slice_index", 0) for d in devices}) == 2
+    mesh = mesh_lib.create_mesh(mesh_shape=[2, 4, 1],
+                                axis_names=("data", "fsdp", "model"),
+                                devices=list(devices),
+                                dcn_data_parallelism=2)
+    # The outer axis must actually cross slices (DCN), the inner must
+    # stay inside one slice (ICI) — otherwise the "hybrid" mesh would
+    # quietly put fsdp reduce-scatters on the slow network.
+    slice_of = np.vectorize(lambda d: d.slice_index)
+    mesh_slices = slice_of(mesh.devices)  # [data=2, fsdp=4, model=1]
+    assert (mesh_slices == mesh_slices[:, :1, :]).all(), \
+        "fsdp axis crosses slices"
+    assert (mesh_slices[0] != mesh_slices[1]).all(), \
+        "data axis does not cross slices"
+    model = flagship.make_flagship_model("tpu", image_size=256)
+    _compile_step_for_mesh(model, mesh, batch=16, rules=ts.fsdp_rules())
+
+
 class TestAOTCostPins:
   """Compiler-cost regression guard: the flagship b64/b128 train-step
   flops and bytes-accessed, as computed by the real local XLA:TPU v5e
