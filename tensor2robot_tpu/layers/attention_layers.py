@@ -30,7 +30,14 @@ class MultiHeadAttention(nn.Module):
   backend: str = "reference"  # 'reference'|'flash'|'ring'|'ulysses'
   mesh: Optional[Mesh] = None  # required for 'ring'/'ulysses'
   sp_axis: str = "sp"
-  ulysses_inner: str = "reference"  # per-device kernel under 'ulysses' 
+  ulysses_inner: str = "reference"  # per-device kernel under 'ulysses'
+  # Pallas interpret mode for the flash paths. Models that know their
+  # target pass it STATICALLY (device_type != 'tpu') — the None
+  # auto-select emits a lax.platform_dependent switch whose branch
+  # buffers XLA:TPU stack-allocates in scoped VMEM at long T (the
+  # round-5 T=8192 compile blocker).
+  flash_interpret: Optional[bool] = None
+  dtype: Optional[jnp.dtype] = None  # compute dtype for the projections
 
   @nn.compact
   def __call__(self, x: jnp.ndarray,
@@ -39,9 +46,11 @@ class MultiHeadAttention(nn.Module):
     kv = x if kv is None else kv
     b, t, _ = x.shape
     proj = self.num_heads * self.head_dim
-    q = nn.Dense(proj, name="q_proj")(x)
-    k = nn.Dense(proj, name="k_proj")(kv)
-    v = nn.Dense(proj, name="v_proj")(kv)
+    # Explicit dtype: with dtype=None the f32 params win the flax
+    # promotion and the projections un-bf16 the attention core.
+    q = nn.Dense(proj, dtype=self.dtype, name="q_proj")(x)
+    k = nn.Dense(proj, dtype=self.dtype, name="k_proj")(kv)
+    v = nn.Dense(proj, dtype=self.dtype, name="v_proj")(kv)
 
     def heads(y):
       return y.reshape(b, -1, self.num_heads,
@@ -49,7 +58,8 @@ class MultiHeadAttention(nn.Module):
 
     q, k, v = heads(q), heads(k), heads(v)  # [B, H, T, D]
     if self.backend == "flash":
-      out = attention_ops.flash_attention(q, k, v, causal=self.causal)
+      out = attention_ops.flash_attention(q, k, v, causal=self.causal,
+                                          interpret=self.flash_interpret)
     elif self.backend == "ring":
       if self.mesh is None:
         raise ValueError("ring backend requires a mesh.")
@@ -60,11 +70,12 @@ class MultiHeadAttention(nn.Module):
         raise ValueError("ulysses backend requires a mesh.")
       out = attention_ops.ulysses_attention(
           q, k, v, self.mesh, axis_name=self.sp_axis, causal=self.causal,
-          inner=self.ulysses_inner)
+          inner=self.ulysses_inner,
+          flash_interpret=self.flash_interpret)
     else:
       out = attention_ops.attention(q, k, v, causal=self.causal)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, proj)
     if self.dropout_rate:
       out = nn.Dropout(self.dropout_rate, name="dropout")(
           out, deterministic=not train)
-    return nn.Dense(x.shape[-1], name="out_proj")(out)
+    return nn.Dense(x.shape[-1], dtype=self.dtype, name="out_proj")(out)

@@ -48,6 +48,7 @@ class _AttentionTrunk(nn.Module):
   mesh: Optional[Any] = None
   sp_axis: str = "sp"
   ulysses_inner: str = "reference"  # per-device kernel under 'ulysses'
+  flash_interpret: Optional[bool] = None  # static Pallas interpret choice
   dtype: Optional[Any] = None
 
   @nn.compact
@@ -56,7 +57,11 @@ class _AttentionTrunk(nn.Module):
     x = features["observation"]  # [B, T, obs]
     if self.dtype is not None and x.dtype != self.dtype:
       x = x.astype(self.dtype)
-    x = nn.Dense(self.hidden_size, name="embed")(x)
+    # Every Dense carries the explicit compute dtype: with dtype=None
+    # the f32 params win the flax promotion and one projection
+    # un-bf16s the whole trunk (the round-2 f32-activation-leak class,
+    # caught again here in round 5 via the T=8192 compile probe).
+    x = nn.Dense(self.hidden_size, dtype=self.dtype, name="embed")(x)
     head_dim = self.hidden_size // self.num_heads
     for i in range(self.num_blocks):
       y = nn.LayerNorm(dtype=self.dtype, name=f"ln_attn_{i}")(x)
@@ -64,13 +69,17 @@ class _AttentionTrunk(nn.Module):
           num_heads=self.num_heads, head_dim=head_dim, causal=True,
           backend=self.backend, mesh=self.mesh, sp_axis=self.sp_axis,
           ulysses_inner=self.ulysses_inner,
+          flash_interpret=self.flash_interpret, dtype=self.dtype,
           name=f"attn_{i}")(y, train=train)
       x = x + y
       y = nn.LayerNorm(dtype=self.dtype, name=f"ln_mlp_{i}")(x)
-      y = nn.Dense(2 * self.hidden_size, name=f"mlp_in_{i}")(y)
-      y = nn.Dense(self.hidden_size, name=f"mlp_out_{i}")(nn.gelu(y))
+      y = nn.Dense(2 * self.hidden_size, dtype=self.dtype,
+                   name=f"mlp_in_{i}")(y)
+      y = nn.Dense(self.hidden_size, dtype=self.dtype,
+                   name=f"mlp_out_{i}")(nn.gelu(y))
       x = x + y
-    action = nn.Dense(self.action_size, name="head")(x)  # [B, T, act]
+    action = nn.Dense(self.action_size, dtype=self.dtype,
+                      name="head")(x)  # [B, T, act]
     return specs_lib.SpecStruct({
         "action": action,
         "inference_output": action,
@@ -154,11 +163,17 @@ class SequenceRegressionModel(abstract_model.T2RModel):
     if backend in ("ring", "ulysses") and self._mesh is None:
       raise ValueError(f"attention_backend={backend!r} requires "
                        "set_mesh() before the module is built.")
+    # Static interpret choice: the model KNOWS its target platform, so
+    # the flash paths never emit the platform_dependent switch (whose
+    # cond branches XLA:TPU stack-allocates in scoped VMEM at long T —
+    # the round-5 T=8192 compile blocker). TPU models lower the real
+    # Mosaic kernels even when AOT-compiled from a CPU host.
     return _AttentionTrunk(
         action_size=self._action_size, hidden_size=self._hidden_size,
         num_blocks=self._num_blocks, num_heads=self._num_heads,
         backend=backend, mesh=self._mesh, sp_axis=self._sp_axis,
         ulysses_inner=self._ulysses_inner,
+        flash_interpret=self.device_type != "tpu",
         dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):

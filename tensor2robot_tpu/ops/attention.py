@@ -363,9 +363,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
   Sequences that don't tile the block size are padded to the next block
   multiple and masked — never a silent O(T^2) fallback. `interpret=None`
-  auto-selects: real kernels on TPU, interpreter elsewhere (CPU tests).
-  Cross-attention (Tq != Tk) falls back to the reference implementation
-  (the kernels assume self-attention layout).
+  auto-selects PER LOWERING PLATFORM: real kernels in TPU-target
+  programs, the interpreter elsewhere (CPU tests). Cross-attention
+  (Tq != Tk) falls back to the reference implementation (the kernels
+  assume self-attention layout).
   """
   b, h, t, d = q.shape
   if not _HAS_PALLAS:
@@ -373,7 +374,25 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
   if k.shape[2] != t:
     return attention(q, k, v, causal=causal)
   if interpret is None:
-    interpret = jax.default_backend() != "tpu"
+    # lax.platform_dependent, NOT jax.default_backend(): the process
+    # backend bakes the HOST platform into the trace, so AOT-lowering a
+    # TPU-topology program from a CPU host silently compiled (and cost-
+    # priced) the interpreter emulation instead of the Mosaic kernel in
+    # every path that relied on the auto-select (round-5 review catch;
+    # pinned by test_default_interpret_lowers_mosaic_for_tpu). The
+    # platform switch folds away in single-platform lowerings. The
+    # barriers keep XLA:TPU from staging the cond's operands/results in
+    # scoped VMEM at long T (same failure mode as the in-kernel
+    # barriers below — 16 MB "stack" allocations at T=8192/h512).
+    q, k, v = jax.lax.optimization_barrier((q, k, v))
+    return jax.lax.optimization_barrier(jax.lax.platform_dependent(
+        q, k, v,
+        tpu=functools.partial(flash_attention, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              interpret=False),
+        default=functools.partial(flash_attention, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=True)))
   # Normalize blocks to powers of two in [_MIN_BLOCK, next_pow2(T)]: the
   # padding arithmetic below relies on lcm(bq, bk) == max(bq, bk), which
   # only holds for powers of two.
@@ -390,7 +409,22 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     q3 = jnp.pad(q3, pad)
     k3 = jnp.pad(k3, pad)
     v3 = jnp.pad(v3, pad)
+  if not interpret:
+    # XLA:TPU fuses surrounding layout ops (the model layer's
+    # BTHD->BHTD head-split transposes, the non-tiling-T pads above)
+    # into the custom-call's scoped-VMEM region; at long T the fused
+    # operands/results exceed VMEM and compilation fails with
+    # RESOURCE_EXHAUSTED "allocating on stack" (found at T=8192/h512 by
+    # the round-5 seqattn duel — interpret mode hid it, like the
+    # round-4 lse blocker). The barrier — placed directly on the kernel
+    # operands, AFTER any padding — pins them to plain HBM buffers;
+    # since its transpose rule is itself a barrier, the backward
+    # kernels get the same protection. Pinned by TestFlashMosaicLowering
+    # test_long_context_train_graph_compiles.
+    q3, k3, v3 = jax.lax.optimization_barrier((q3, k3, v3))
   out = _flash(causal, eff_bq, eff_bk, t, interpret, q3, k3, v3)
+  if not interpret:
+    out = jax.lax.optimization_barrier(out)  # see the entry barrier
   if t_pad != t:
     out = out[:, :t]
   return out.reshape(b, h, t, d)
@@ -404,7 +438,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                       axis_name: str = "sp",
                       causal: bool = False,
                       batch_axis: Optional[str] = "data",
-                      inner: str = "reference") -> jnp.ndarray:
+                      inner: str = "reference",
+                      flash_interpret: Optional[bool] = None) -> jnp.ndarray:
   """Exact attention with the sequence dim sharded via head all_to_all
   (DeepSpeed-Ulysses style).
 
@@ -463,7 +498,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     q_g, k_g, v_g = seq_to_heads(q_l), seq_to_heads(k_l), seq_to_heads(v_l)
     if inner == "flash":
-      out = flash_attention(q_g, k_g, v_g, causal=causal)
+      out = flash_attention(q_g, k_g, v_g, causal=causal,
+                            interpret=flash_interpret)
     else:
       out = attention(q_g, k_g, v_g, causal=causal)
     return heads_to_seq(out)

@@ -134,6 +134,27 @@ def test_pose_env_critic_bf16_end_to_end():
   _assert_all_bf16(_conv_dot_dtypes(model))
 
 
+def test_sequence_trunk_bf16_end_to_end():
+  """The long-context trunk keeps every projection/MLP/attention dot in
+  bf16 under the policy. Regression for the round-5 find: the trunk's
+  Dense layers carried dtype=None, so the f32 params won the flax
+  promotion and the 'bf16' sequence configs silently computed f32 —
+  the exact round-2 leak class, in the one model family this suite
+  didn't cover. ('reference' backend: the Mosaic kernel can't lower on
+  the CPU test backend; the leak was in the projections, which all
+  flash/SP backends share.)"""
+  import optax
+
+  from tensor2robot_tpu.models import sequence_model
+
+  model = sequence_model.SequenceRegressionModel(
+      obs_size=16, action_size=7, sequence_length=256, hidden_size=64,
+      num_blocks=2, num_heads=4, attention_backend="reference",
+      device_type="tpu", use_bfloat16=True,
+      optimizer_fn=lambda: optax.adam(1e-3))
+  _assert_all_bf16(_conv_dot_dtypes(model))
+
+
 def test_f32_policy_unchanged():
   """Without the bf16 policy everything still computes in f32."""
   from tensor2robot_tpu.research.qtopt import models as qtopt_models

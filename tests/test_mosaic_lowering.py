@@ -99,6 +99,62 @@ class TestFlashMosaicLowering:
     text = exported.mlir_module()
     assert "tpu_custom_call" in text, "flash did not lower via Mosaic"
 
+  def test_default_interpret_lowers_mosaic_for_tpu(self):
+    """interpret=None (every model-path call: MultiHeadAttention,
+    ulysses inner='flash') must select the REAL kernel per lowering
+    platform. Regression for the round-5 seqattn incident: the old
+    jax.default_backend() auto-select baked the CPU host backend into
+    TPU-target AOT programs, so 'flash' compile facts silently priced
+    the interpreter emulation."""
+    s = jax.ShapeDtypeStruct((2, 2, 256, 64), jnp.bfloat16)
+    exported = _export_for_tpu(
+        lambda q, k, v: attention.flash_attention(q, k, v, causal=True),
+        s, s, s)
+    assert "tpu_custom_call" in exported.mlir_module(), (
+        "default-interpret flash lowered the interpreter emulation "
+        "into a TPU-target program")
+    # The backward pass too (the custom-vjp kernels ride the same
+    # auto-select).
+    grads = _export_for_tpu(
+        lambda q, k, v: jax.grad(
+            lambda q_, k_, v_: attention.flash_attention(
+                q_, k_, v_, causal=True).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v), s, s, s)
+    assert "tpu_custom_call" in grads.mlir_module()
+
+  @pytest.mark.parametrize("t", [8192, 8000])
+  def test_long_context_train_graph_compiles(self, t):
+    """The kernel embedded in a model-like graph (head-split transposes
+    + projections + grad) must COMPILE at long T, not just lower:
+    without the optimization barriers XLA:TPU fuses the surrounding
+    transposes into the custom-call's scoped-VMEM region and T=8192
+    dies with RESOURCE_EXHAUSTED 'allocating on stack' (round-5 seqattn
+    catch; the bare-kernel tests above can't see it). T=8000 covers the
+    non-block-multiple path, where the pad ops sit between the model
+    transposes and the kernel — the barriers must bind to the padded
+    operands, not the pre-pad ones."""
+    from jax.experimental import topologies
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2")
+    mesh = Mesh(np.array(topo.devices)[:1], ("data",))
+    repl = NamedSharding(mesh, PartitionSpec())
+    bsz, h, d, f = 2, 8, 64, 512
+    xs = jax.ShapeDtypeStruct((bsz, t, f), jnp.bfloat16, sharding=repl)
+    ws = jax.ShapeDtypeStruct((f, h * d), jnp.bfloat16, sharding=repl)
+
+    def loss(x, wq, wk, wv):
+      def heads(y):
+        return y.reshape(bsz, t, h, d).transpose(0, 2, 1, 3)
+      out = attention.flash_attention(
+          heads(x @ wq), heads(x @ wk), heads(x @ wv), causal=True,
+          interpret=False)
+      return out.astype(jnp.float32).sum()
+
+    jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3))).lower(
+        xs, ws, ws, ws).compile()
+
   def test_f32_inputs_lower(self):
     s = jax.ShapeDtypeStruct((1, 2, 256, 64), jnp.float32)
     _export_for_tpu(
