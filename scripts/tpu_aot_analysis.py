@@ -381,19 +381,23 @@ def seqattn_analysis() -> None:
   topo = topologies.get_topology_desc(platform="tpu",
                                       topology_name="v5e:2x2")
   mesh = Mesh(np.array(topo.devices).reshape(1, 4), ("data", "sp"))
-  model = sequence_model.SequenceRegressionModel(
-      obs_size=16, action_size=7, sequence_length=8192,
-      hidden_size=512, num_blocks=2, num_heads=8,
-      attention_backend="ulysses", ulysses_inner="flash",
-      device_type="tpu", use_bfloat16=True,
-      optimizer_fn=lambda: optax.adam(1e-3))
-  model.set_mesh(mesh)
-  _compile_sharded_step(
-      model, mesh, batch_size=2,
-      tag="seq_ulysses_flash_T8192_h512_sp4",
-      note="per-chip cost; flash kernel inside the Ulysses "
-           "all_to_all shard_map over a real 4-way v5e sp axis",
-      batch_spec=model.batch_partition_spec)
+  for backend, inner, tag, note in [
+      ("ulysses", "flash", "seq_ulysses_flash_T8192_h512_sp4",
+       "per-chip cost; flash kernel inside the Ulysses all_to_all "
+       "shard_map over a real 4-way v5e sp axis"),
+      ("ring", "reference", "seq_ring_T8192_h512_sp4",
+       "per-chip cost; ppermute K/V ring over a real 4-way v5e sp "
+       "axis, online-softmax accumulation per hop"),
+  ]:
+    model = sequence_model.SequenceRegressionModel(
+        obs_size=16, action_size=7, sequence_length=8192,
+        hidden_size=512, num_blocks=2, num_heads=8,
+        attention_backend=backend, ulysses_inner=inner,
+        device_type="tpu", use_bfloat16=True,
+        optimizer_fn=lambda: optax.adam(1e-3))
+    model.set_mesh(mesh)
+    _compile_sharded_step(model, mesh, batch_size=2, tag=tag, note=note,
+                          batch_spec=model.batch_partition_spec)
 
 
 def main():
