@@ -39,6 +39,11 @@ run python scripts/tpu_flash_validate.py correctness
 run python scripts/tpu_flash_validate.py time 1024
 run python scripts/tpu_flash_validate.py time 4096
 run python scripts/tpu_flash_validate.py time 16384
+# 2b. Full sequence train step at the SHIPPED long-context shape, both
+#     backends — the wall-clock confirmation of the flash ship decision
+#     (AOT_ANALYSIS_r05.json seqattn: flash ceiling 4.6x reference).
+run python scripts/tpu_seq_timing.py reference
+run python scripts/tpu_seq_timing.py flash
 # 3. Roofline after the bf16 fix + batch scaling + remat HBM lever.
 run python scripts/tpu_step_tuning.py roofline
 run python scripts/tpu_step_tuning.py batch 32
