@@ -46,8 +46,9 @@ class MultiHeadAttention(nn.Module):
     kv = x if kv is None else kv
     b, t, _ = x.shape
     proj = self.num_heads * self.head_dim
-    # Explicit dtype: with dtype=None the f32 params win the flax
-    # promotion and the projections un-bf16 the attention core.
+    # Explicit dtype: keeps direct module.apply in the intended compute
+    # dtype (the policy wrapper's param downcast covers the trained
+    # path; standalone use has no wrapper).
     q = nn.Dense(proj, dtype=self.dtype, name="q_proj")(x)
     k = nn.Dense(proj, dtype=self.dtype, name="k_proj")(kv)
     v = nn.Dense(proj, dtype=self.dtype, name="v_proj")(kv)

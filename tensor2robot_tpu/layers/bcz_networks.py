@@ -45,7 +45,8 @@ class ConvGRUEncoder(nn.Module):
                         dtype=self.dtype, name="torso")
     points = torso(flat, cond, train=train)
     points = points.reshape(b, t, -1)
-    rnn = nn.RNN(nn.GRUCell(features=self.hidden_size), name="gru")
+    rnn = nn.RNN(nn.GRUCell(features=self.hidden_size,
+                            dtype=self.dtype), name="gru")
     return rnn(points)
 
 
@@ -57,14 +58,19 @@ class SnailEncoder(nn.Module):
   filters: int = 32
   key_size: int = 16
   value_size: int = 16
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the policy)
 
   @nn.compact
   def __call__(self, features: jnp.ndarray,
                train: bool = False) -> jnp.ndarray:
-    x = TCBlock(self.sequence_length, self.filters, name="tc1")(features)
-    x = AttentionBlock(self.key_size, self.value_size, name="attn1")(x)
-    x = TCBlock(self.sequence_length, self.filters, name="tc2")(x)
-    x = AttentionBlock(self.key_size, self.value_size, name="attn2")(x)
+    x = TCBlock(self.sequence_length, self.filters, dtype=self.dtype,
+                name="tc1")(features)
+    x = AttentionBlock(self.key_size, self.value_size, dtype=self.dtype,
+                       name="attn1")(x)
+    x = TCBlock(self.sequence_length, self.filters, dtype=self.dtype,
+                name="tc2")(x)
+    x = AttentionBlock(self.key_size, self.value_size, dtype=self.dtype,
+                       name="attn2")(x)
     return x
 
 
@@ -77,6 +83,7 @@ class MultiHeadMLP(nn.Module):
   action_size: int
   hidden_sizes: Sequence[int] = (256, 256)
   stop_gradient_future: bool = True
+  dtype: Optional[Any] = None  # compute dtype (bf16 under the policy)
 
   @nn.compact
   def __call__(self, features: jnp.ndarray,
@@ -87,6 +94,8 @@ class MultiHeadMLP(nn.Module):
       if w > 0 and self.stop_gradient_future:
         x = jax.lax.stop_gradient(x)
       for i, size in enumerate(self.hidden_sizes):
-        x = nn.relu(nn.Dense(size, name=f"head{w}_fc{i}")(x))
-      outputs.append(nn.Dense(self.action_size, name=f"head{w}_out")(x))
+        x = nn.relu(nn.Dense(size, dtype=self.dtype,
+                             name=f"head{w}_fc{i}")(x))
+      outputs.append(nn.Dense(self.action_size, dtype=self.dtype,
+                              name=f"head{w}_out")(x))
     return jnp.stack(outputs, axis=1)  # [B, W, action_size]

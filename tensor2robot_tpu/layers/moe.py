@@ -35,7 +35,7 @@ Design notes for TPU:
 from __future__ import annotations
 
 import math
-from typing import Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -72,6 +72,14 @@ class MixtureOfExperts(nn.Module):
   capacity_factor: float = 1.25  # sparse/alltoall only
   mesh: Optional[Mesh] = None  # alltoall only
   ep_axis: str = "data"  # alltoall only: axis sharding tokens AND experts
+  # Compute dtype for the EXPERT einsums (the FLOPs bulk — where EP's
+  # MXU time goes); router/gates/aux stay f32 by design (the softmax
+  # and load statistics are numerics-sensitive and tiny). On the
+  # trained path the policy wrapper (abstract.py inference_network_fn)
+  # already downcasts f32 params before apply; this attr makes the
+  # module correct STANDALONE too (direct module.apply has no wrapper)
+  # and states the intended compute dtype explicitly.
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray, train: bool = False
@@ -102,6 +110,10 @@ class MixtureOfExperts(nn.Module):
                     (self.num_experts, self.hidden_size, self.output_size))
     b2 = self.param("experts_b2", nn.initializers.zeros,
                     (self.num_experts, 1, self.output_size))
+    if self.dtype is not None:
+      # Cast the expert params once: every dispatch branch reads its
+      # compute dtype from w1.dtype, so the expert einsums follow.
+      w1, b1, w2, b2 = (p.astype(self.dtype) for p in (w1, b1, w2, b2))
 
     if self.dispatch == "dense":
       gates = jnp.zeros_like(probs)
@@ -163,7 +175,7 @@ class MixtureOfExperts(nn.Module):
     """Capacity-bounded routing via one-hot dispatch/combine einsums."""
     combine = self._pack_combine(top_probs, top_idx,
                                  self._capacity(tokens.shape[0]))
-    dispatch = (combine > 0).astype(tokens.dtype)    # [N, E, C]
+    dispatch = (combine > 0).astype(w1.dtype)        # [N, E, C]
 
     expert_inputs = jnp.einsum("nec,nf->ecf", dispatch,
                                tokens.astype(w1.dtype))

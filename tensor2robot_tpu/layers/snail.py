@@ -9,7 +9,7 @@ convs are `nn.Conv` with left padding so all shapes stay static.
 from __future__ import annotations
 
 import math
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -18,11 +18,17 @@ __all__ = ["CausalConv", "DenseBlock", "TCBlock", "AttentionBlock"]
 
 
 class CausalConv(nn.Module):
-  """1D causal (left-padded) dilated convolution over [B, T, C]."""
+  """1D causal (left-padded) dilated convolution over [B, T, C].
+
+  `dtype`: compute dtype — under DIRECT module.apply (no policy
+  wrapper downcasting params) a None dtype lets the f32 params win the
+  flax promotion and un-bf16 a bf16 caller's activations downstream
+  (pinned by test_snail_encoder_respects_compute_dtype)."""
 
   filters: int
   kernel_size: int = 2
   dilation: int = 1
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -30,7 +36,7 @@ class CausalConv(nn.Module):
     x = jnp.pad(x, ((0, 0), (pad, 0), (0, 0)))
     return nn.Conv(self.filters, (self.kernel_size,),
                    kernel_dilation=(self.dilation,), padding="VALID",
-                   name="conv")(x)
+                   dtype=self.dtype, name="conv")(x)
 
 
 class DenseBlock(nn.Module):
@@ -38,11 +44,14 @@ class DenseBlock(nn.Module):
 
   filters: int
   dilation: int = 1
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-    xf = CausalConv(self.filters, dilation=self.dilation, name="filter")(x)
-    xg = CausalConv(self.filters, dilation=self.dilation, name="gate")(x)
+    xf = CausalConv(self.filters, dilation=self.dilation,
+                    dtype=self.dtype, name="filter")(x)
+    xg = CausalConv(self.filters, dilation=self.dilation,
+                    dtype=self.dtype, name="gate")(x)
     activations = jnp.tanh(xf) * nn.sigmoid(xg)
     return jnp.concatenate([x, activations], axis=-1)
 
@@ -53,31 +62,38 @@ class TCBlock(nn.Module):
 
   sequence_length: int
   filters: int
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
     num_blocks = max(1, int(math.ceil(math.log2(self.sequence_length))))
     for i in range(num_blocks):
-      x = DenseBlock(self.filters, dilation=2 ** i, name=f"dense_{i}")(x)
+      x = DenseBlock(self.filters, dilation=2 ** i, dtype=self.dtype,
+                     name=f"dense_{i}")(x)
     return x
 
 
 class AttentionBlock(nn.Module):
-  """Single-head causal attention; output concatenates onto the input."""
+  """Single-head causal attention; output concatenates onto the input.
+  The softmax runs in f32 (standard mixed-precision practice); the
+  projections and score/read matmuls follow `dtype`."""
 
   key_size: int
   value_size: int
+  dtype: Optional[Any] = None
 
   @nn.compact
   def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
     t = x.shape[1]
-    keys = nn.Dense(self.key_size, name="keys")(x)
-    queries = nn.Dense(self.key_size, name="queries")(x)
-    values = nn.Dense(self.value_size, name="values")(x)
+    keys = nn.Dense(self.key_size, dtype=self.dtype, name="keys")(x)
+    queries = nn.Dense(self.key_size, dtype=self.dtype,
+                       name="queries")(x)
+    values = nn.Dense(self.value_size, dtype=self.dtype,
+                      name="values")(x)
     logits = queries @ keys.transpose(0, 2, 1) / math.sqrt(self.key_size)
     causal_mask = jnp.tril(jnp.ones((t, t), bool))
     logits = jnp.where(causal_mask, logits,
                        jnp.asarray(-1e9, logits.dtype))
-    attention = nn.softmax(logits, axis=-1)
-    read = attention @ values
+    attention = nn.softmax(logits.astype(jnp.float32), axis=-1)
+    read = attention.astype(values.dtype) @ values
     return jnp.concatenate([x, read], axis=-1)

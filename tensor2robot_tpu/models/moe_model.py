@@ -45,20 +45,29 @@ class _MoENetwork(nn.Module):
   capacity_factor: float = 1.25
   mesh: object = None
   ep_axis: str = "data"
+  dtype: object = None  # compute dtype (bf16 under the TPU policy)
 
   @nn.compact
   def __call__(self, features, mode: str = modes_lib.TRAIN,
                train: bool = False):
     x = features["observation"]
-    x = nn.relu(nn.Dense(self.hidden_size, name="embed")(x))
+    # Explicit dtype: keeps the module's compute dtype correct even
+    # when applied OUTSIDE the policy wrapper (inference_network_fn
+    # downcasts f32 params before apply on the trained path; direct
+    # module.apply — unit tests, standalone reuse — has no such
+    # protection). nn.Dense(dtype=...) also casts its input, so no
+    # separate input cast is needed.
+    x = nn.relu(nn.Dense(self.hidden_size, dtype=self.dtype,
+                         name="embed")(x))
     x, aux = moe_lib.MixtureOfExperts(
         num_experts=self.num_experts, hidden_size=self.hidden_size,
         output_size=self.hidden_size, top_k=self.top_k,
         dispatch=self.dispatch, capacity_factor=self.capacity_factor,
-        mesh=self.mesh, ep_axis=self.ep_axis,
+        mesh=self.mesh, ep_axis=self.ep_axis, dtype=self.dtype,
         name="moe")(x, train=train)
     x = nn.relu(x)
-    action = nn.Dense(self.action_size, name="action")(x)
+    action = nn.Dense(self.action_size, dtype=self.dtype,
+                      name="action")(x)
     return specs_lib.SpecStruct({
         "action": action,
         "inference_output": action,
@@ -118,7 +127,8 @@ class MoERegressionModel(abstract_model.T2RModel):
         action_size=self._action_size, num_experts=self._num_experts,
         hidden_size=self._hidden_size, top_k=self._top_k,
         dispatch=self._dispatch, capacity_factor=self._capacity_factor,
-        mesh=self._mesh, ep_axis=self._ep_axis)
+        mesh=self._mesh, ep_axis=self._ep_axis,
+        dtype=self.compute_dtype if self.use_bfloat16 else None)
 
   def model_train_fn(self, features, labels, inference_outputs, mode):
     mse = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)

@@ -57,10 +57,11 @@ class _AttentionTrunk(nn.Module):
     x = features["observation"]  # [B, T, obs]
     if self.dtype is not None and x.dtype != self.dtype:
       x = x.astype(self.dtype)
-    # Every Dense carries the explicit compute dtype: with dtype=None
-    # the f32 params win the flax promotion and one projection
-    # un-bf16s the whole trunk (the round-2 f32-activation-leak class,
-    # caught again here in round 5 via the T=8192 compile probe).
+    # Every Dense carries the explicit compute dtype. On the trained
+    # path the policy wrapper already downcasts f32 params before
+    # apply; the explicit dtype keeps DIRECT module.apply (unit tests,
+    # standalone reuse, the round-5 T=8192 bisect that first flagged
+    # this) in the intended dtype too, instead of promoting to f32.
     x = nn.Dense(self.hidden_size, dtype=self.dtype, name="embed")(x)
     head_dim = self.hidden_size // self.num_heads
     for i in range(self.num_blocks):

@@ -280,7 +280,8 @@ class _BCZNetwork(nn.Module):
     action_size = sum(size for _, size, _, _ in self.components)
     waypoints = bcz_networks.MultiHeadMLP(
         num_waypoints=self.num_waypoints, action_size=action_size,
-        name="decoder")(feats, train=train)  # [B, W, action_size]
+        dtype=self.dtype, name="decoder")(feats,
+                                          train=train)  # [B, W, action]
     outputs = specs_lib.SpecStruct()
     offset = 0
     for name, size, residual, _ in self.components:
@@ -295,8 +296,9 @@ class _BCZNetwork(nn.Module):
                 outputs[name].dtype)[:, None, :]
     if self.predict_stop:
       stop_feats = jax.lax.stop_gradient(feats)
-      x = nn.relu(nn.Dense(64, name="stop_fc")(stop_feats))
-      outputs[STOP_KEY] = nn.Dense(self.num_waypoints,
+      x = nn.relu(nn.Dense(64, dtype=self.dtype,
+                           name="stop_fc")(stop_feats))
+      outputs[STOP_KEY] = nn.Dense(self.num_waypoints, dtype=self.dtype,
                                    name="stop_logits")(x)
     if self.predict_stop_state:
       # 3-class continue / fail-help / success head (reference
@@ -304,13 +306,20 @@ class _BCZNetwork(nn.Module):
       # linear -> layer_norm -> relu stack, fed the raw embedding — the
       # first waypoint's logits DO backprop into the backbone; logits
       # for the remaining waypoints come off a stop-gradient branch.
+      # slim.fully_connected under normalizer_fn=layer_norm creates NO
+      # bias on the hidden FCs (the LN center term replaces it); only
+      # the normalizer-less logits layers carry one (r5 parity sweep).
       x = feats
       for i, width in enumerate((100, 100)):
-        x = nn.relu(nn.LayerNorm(name=f"stop_state_ln{i}")(
-            nn.Dense(width, name=f"stop_state_fc{i}")(x)))
-      first = nn.Dense(NUM_STOP_STATES, name="stop_state_logits")(x)
+        x = nn.relu(nn.LayerNorm(dtype=self.dtype,
+                                 name=f"stop_state_ln{i}")(
+            nn.Dense(width, use_bias=False, dtype=self.dtype,
+                     name=f"stop_state_fc{i}")(x)))
+      first = nn.Dense(NUM_STOP_STATES, dtype=self.dtype,
+                       name="stop_state_logits")(x)
       if self.num_waypoints > 1:
         rest = nn.Dense((self.num_waypoints - 1) * NUM_STOP_STATES,
+                        dtype=self.dtype,
                         name="stop_state_rest_logits")(
                             jax.lax.stop_gradient(x))
         logits = jnp.concatenate([first, rest], axis=-1)

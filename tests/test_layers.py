@@ -391,6 +391,18 @@ class TestBCZNetworks:
     out, _ = _init_apply(module, x)
     assert out.shape[0:2] == (2, 4)
 
+  def test_snail_encoder_respects_compute_dtype(self):
+    """With dtype=bf16, bf16 activations stay bf16 through every TC /
+    attention block: an f32 Dense/Conv param anywhere would win the
+    flax promotion and surface as an f32 output (the concat of x and
+    an f32 read promotes — exactly the round-5 leak class)."""
+    module = bcz_networks.SnailEncoder(sequence_length=4, filters=8,
+                                       dtype=jnp.bfloat16)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 6),
+                          jnp.bfloat16)
+    out, _ = _init_apply(module, x)
+    assert out.dtype == jnp.bfloat16
+
   def test_multihead_mlp_stop_gradient(self):
     module = bcz_networks.MultiHeadMLP(num_waypoints=3, action_size=2,
                                        hidden_sizes=(8,))

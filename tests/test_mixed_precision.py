@@ -29,15 +29,17 @@ from tensor2robot_tpu.parallel import train_step as ts
 _SMALL_F32_DOT_ELEMENTS = 4096
 
 
-def _conv_dot_dtypes(model, batch_size=2):
+def _conv_dot_dtypes(model, batch_size=2, mesh=None):
   features = specs_lib.make_random_numpy(
       model.preprocessor.get_out_feature_specification(modes.TRAIN),
       batch_size=batch_size, seed=0)
   labels = specs_lib.make_random_numpy(
       model.preprocessor.get_out_label_specification(modes.TRAIN),
       batch_size=batch_size, seed=1)
-  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
-  step = ts.make_train_step(model, donate=False)
+  state, shardings = ts.create_train_state(
+      model, jax.random.PRNGKey(0), features, mesh=mesh)
+  step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
+                            donate=False)
   hlo = step.lower(state, features, labels).as_text()
   counts = Counter()
   big_f32 = []
@@ -136,13 +138,10 @@ def test_pose_env_critic_bf16_end_to_end():
 
 def test_sequence_trunk_bf16_end_to_end():
   """The long-context trunk keeps every projection/MLP/attention dot in
-  bf16 under the policy. Regression for the round-5 find: the trunk's
-  Dense layers carried dtype=None, so the f32 params won the flax
-  promotion and the 'bf16' sequence configs silently computed f32 —
-  the exact round-2 leak class, in the one model family this suite
-  didn't cover. ('reference' backend: the Mosaic kernel can't lower on
-  the CPU test backend; the leak was in the projections, which all
-  flash/SP backends share.)"""
+  bf16 under the policy — the one model family this suite didn't cover
+  until round 5. ('reference' backend: the Mosaic kernel can't lower
+  on the CPU test backend; the projections are shared by all
+  flash/SP backends.)"""
   import optax
 
   from tensor2robot_tpu.models import sequence_model
@@ -153,6 +152,63 @@ def test_sequence_trunk_bf16_end_to_end():
       device_type="tpu", use_bfloat16=True,
       optimizer_fn=lambda: optax.adam(1e-3))
   _assert_all_bf16(_conv_dot_dtypes(model))
+
+
+def test_moe_alltoall_trunk_bf16_end_to_end():
+  """The explicit shard_map all_to_all dispatch keeps its expert
+  einsums in bf16 under the policy — a separate code path from
+  dense/sparse. Like every *_end_to_end test here, this pins the
+  POLICY OUTCOME (whichever mechanism provides it — the wrapper's
+  param downcast and/or module dtype attrs); module-level dtype
+  mechanics are pinned separately (test_layers snail test)."""
+  import optax
+  from jax.sharding import Mesh
+
+  from tensor2robot_tpu.models import moe_model
+
+  mesh = Mesh(np.array(jax.devices()[:2]).reshape(2, 1, 1),
+              ("data", "fsdp", "model"))
+  model = moe_model.MoERegressionModel(
+      obs_size=64, action_size=8, num_experts=4, hidden_size=128,
+      dispatch="alltoall", ep_axis="data", device_type="tpu",
+      use_bfloat16=True, optimizer_fn=lambda: optax.adam(1e-3))
+  model.set_mesh(mesh)
+  _assert_all_bf16(_conv_dot_dtypes(model, batch_size=16, mesh=mesh))
+
+
+def test_bcz_aux_heads_bf16_end_to_end():
+  """The BCZ side branches the base test's small batch exempts: the
+  past-frames ConvGRUEncoder (GRU cell dots), the stop head and the
+  3-class stop-state stack — at batch 128 their dots exceed the f32
+  size exemption, so a policy break in any of them fails loudly."""
+  import functools
+
+  from tensor2robot_tpu.research.bcz import models as bcz_models
+
+  model = bcz_models.BCZModel(
+      image_size=32, network="spatial_softmax", num_waypoints=3,
+      device_type="tpu", use_bfloat16=True, num_past_frames=2,
+      predict_stop=True, predict_stop_state=True,
+      preprocessor_cls=functools.partial(
+          bcz_models.BCZPreprocessor, input_size=(40, 40),
+          crop_size=(36, 36), model_size=(32, 32)))
+  _assert_all_bf16(_conv_dot_dtypes(model, batch_size=128))
+
+
+@pytest.mark.parametrize("dispatch", ["dense", "sparse"])
+def test_moe_trunk_bf16_end_to_end(dispatch):
+  """The routed-expert einsums (the MoE trunk's FLOPs bulk) follow the
+  bf16 policy; the router/gates/aux stay f32 by design (small,
+  numerics-sensitive — exempted by the size threshold)."""
+  import optax
+
+  from tensor2robot_tpu.models import moe_model
+
+  model = moe_model.MoERegressionModel(
+      obs_size=64, action_size=8, num_experts=4, hidden_size=128,
+      dispatch=dispatch, device_type="tpu", use_bfloat16=True,
+      optimizer_fn=lambda: optax.adam(1e-3))
+  _assert_all_bf16(_conv_dot_dtypes(model, batch_size=16))
 
 
 def test_f32_policy_unchanged():
