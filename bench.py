@@ -8,12 +8,22 @@ EMA) on the REFERENCE-SCALE network: Grasping44 (16 convs + BN, named
 grasp-param blocks, /root/reference/research/qtopt/networks.py:299-615)
 at 472x472x3 bfloat16 images. The per-chip config is auto-tuned: the
 bench measures batch 64, keeps doubling the batch while throughput
-improves (cap 512), then probes rematerialization at the winning batch
-— the step is HBM-bound, so larger batches amortize per-step
-optimizer/EMA traffic and remat trades idle-MXU FLOPs for activation
-bytes. The config actually used lands in the JSON ("batch_size",
-"remat"); "value_batch64" keeps the fixed-batch non-remat number for
+improves (cap 512), then probes rematerialization and the
+space-to-depth stem at the winning batch. The config actually used
+lands in the JSON ("batch_size", "remat", "space_to_depth");
+"value_batch64" keeps the fixed-batch non-remat number for
 round-over-round comparison.
+
+Probe isolation (round 5): every measurement runs in its OWN short
+subprocess — the pattern scripts/tpu_window.sh established for safe
+tunnel use. A probe that hangs (a wedged axon tunnel hangs client init
+and can stall any device call forever; see PERFORMANCE.md incident
+history) is abandoned after a deadline WITHOUT being signalled
+(SIGTERM/SIGKILL of a process holding a TPU client is the documented
+tunnel-wedging trigger), further probes are skipped, and the bench
+emits the best number it already has. Before round 5 a single hung
+probe forfeited the whole headline JSON (observed live: the s2d probe
+stalled >18 min on an otherwise-captured 1478 ex/s run).
 
 Baseline anchor: the reference publishes no absolute throughput
 (BASELINE.md). The anchor is the BASELINE.json north star's 8xV100-class
@@ -29,9 +39,12 @@ the TPU number, only to itself across rounds.
 from __future__ import annotations
 
 import json
+import math
+import os
+import subprocess
 import sys
-
-import numpy as np
+import tempfile
+import time
 
 from tensor2robot_tpu.utils import backend as backend_lib
 
@@ -41,6 +54,10 @@ BATCH_SIZE = 64
 # with the tuning/latency scripts so all measurements time one network).
 WARMUP_STEPS = 3
 MEASURE_STEPS = 50
+# Per-probe wall-clock budget. A healthy probe is compile (20-40 s over
+# the tunnel) + ~53 steps (<1 min); the slowest healthy probe observed
+# is ~4 min. Past this deadline the child is abandoned un-signalled.
+PROBE_DEADLINE_SEC = 600.0
 # Peak dense bf16 FLOP/s per chip for the MFU denominator. v5e public
 # spec: 197 TFLOP/s bf16. Unknown kinds fall back to the v5e figure
 # (this project's only real device) — device_kind lands in the JSON so
@@ -54,9 +71,13 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def main() -> None:
-  if not backend_lib.accelerator_healthy():
-    # Device backend unreachable: fall back to CPU rather than hang.
+def probe_main(cfg: dict) -> dict:
+  """Runs ONE measurement (the probe child body); returns the record.
+
+  Called in a fresh subprocess for TPU probes (tunnel-hazard isolation)
+  and in-process for the CPU smoke fallback (no tunnel involved).
+  """
+  if cfg["platform"] == "cpu":
     backend_lib.pin_cpu()
     backend_lib.assert_cpu_backend()
   import jax
@@ -67,182 +88,271 @@ def main() -> None:
 
   device = jax.devices()[0]
   on_tpu = device.platform != "cpu"
+  batch_size = cfg["batch_size"]
+  remat = cfg.get("remat", False)
+  s2d = cfg.get("s2d", False)
   measure_steps = MEASURE_STEPS if on_tpu else 5
 
-  def make_model(remat: bool = False, s2d: bool = False):
-    # The one shared flagship config (research/qtopt/flagship.py) so the
-    # bench, tuning and latency scripts all time the SAME network.
-    return flagship.make_flagship_model(device.platform, remat=remat,
-                                        space_to_depth=s2d)
-
-  def measure(batch_size: int, remat: bool = False, s2d: bool = False):
-    """Returns (examples/sec, flops/step, bytes/step) for the train step."""
-    model = make_model(remat, s2d)
-    features = specs_lib.make_random_numpy(
-        model.preprocessor.get_out_feature_specification(modes.TRAIN),
-        batch_size=batch_size, seed=0)
-    labels = specs_lib.make_random_numpy(
-        model.preprocessor.get_out_label_specification(modes.TRAIN),
-        batch_size=batch_size, seed=1)
-    features = jax.device_put(features, device)
-    labels = jax.device_put(labels, device)
-    state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
-    # AOT-compile once: the executable is both the timed step and the
-    # source of the XLA cost analysis (flops + bytes per step) — no
-    # second trace/compile over the tunnel. The bench must emit its
-    # number even when the backend lacks AOT/cost support, so both are
-    # best-effort with the plain jitted step as fallback.
-    flops = bytes_accessed = float("nan")
-    step = ts.make_train_step(model)
-    try:
-      step = step.lower(state, features, labels).compile()
-      cost = step.cost_analysis()
-      cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
-      flops = float(cost.get("flops", float("nan")))
-      bytes_accessed = float(cost.get("bytes accessed", float("nan")))
-    except Exception as e:  # noqa: BLE001 - efficiency fields are optional
-      # If .lower()/.compile() itself failed, `step` is still the plain
-      # jitted fn; if only cost_analysis failed, it is the (callable)
-      # AOT executable. Either way the timing loop below works.
-      print(f"bench: AOT cost analysis unavailable "
-            f"({type(e).__name__}: {e}); efficiency fields will be null",
-            file=sys.stderr)
-    # backend_lib.time_train_steps is the one shared tunnel-safe timing
-    # recipe: warmup -> host-fetch barrier on the smallest param leaf
-    # (block_until_ready returns early over the axon tunnel; the loss
-    # does not depend on the final step's optimizer/EMA update) ->
-    # timed loop -> barrier. The ~0.1 s fetch round-trip is amortized
-    # over measure_steps and biases throughput slightly LOW.
-    sec, _ = backend_lib.time_train_steps(
+  model = flagship.make_flagship_model(device.platform, remat=remat,
+                                       space_to_depth=s2d)
+  features = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_feature_specification(modes.TRAIN),
+      batch_size=batch_size, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.preprocessor.get_out_label_specification(modes.TRAIN),
+      batch_size=batch_size, seed=1)
+  features = jax.device_put(features, device)
+  labels = jax.device_put(labels, device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  # AOT-compile once: the executable is both the timed step and the
+  # source of the XLA cost analysis (flops + bytes per step) — no
+  # second trace/compile over the tunnel. The bench must emit its
+  # number even when the backend lacks AOT/cost support, so both are
+  # best-effort with the plain jitted step as fallback.
+  flops = bytes_accessed = float("nan")
+  step = ts.make_train_step(model)
+  try:
+    step = step.lower(state, features, labels).compile()
+    cost = step.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+    flops = float(cost.get("flops", float("nan")))
+    bytes_accessed = float(cost.get("bytes accessed", float("nan")))
+  except Exception as e:  # noqa: BLE001 - efficiency fields are optional
+    # If .lower()/.compile() itself failed, `step` is still the plain
+    # jitted fn; if only cost_analysis failed, it is the (callable)
+    # AOT executable. Either way the timing loop below works.
+    print(f"bench: AOT cost analysis unavailable "
+          f"({type(e).__name__}: {e}); efficiency fields will be null",
+          file=sys.stderr)
+  # backend_lib.time_train_steps is the one shared tunnel-safe timing
+  # recipe: warmup -> host-fetch barrier on the smallest param leaf
+  # (block_until_ready returns early over the axon tunnel; the loss
+  # does not depend on the final step's optimizer/EMA update) ->
+  # timed loop -> barrier. The ~0.1 s fetch round-trip is amortized
+  # over measure_steps and biases throughput slightly LOW.
+  # CPU smoke: host-load noise swings this VM +-20% (PERFORMANCE.md
+  # round-2 A/B), so time the loop `reruns` times on the one compiled
+  # step and keep the median. TPU runs stay single (50 steps amortize
+  # noise; re-running costs tunnel time).
+  secs = []
+  for _ in range(cfg.get("reruns", 1)):
+    sec, state = backend_lib.time_train_steps(
         step, state, features, labels, iters=measure_steps,
         warmup=WARMUP_STEPS)
-    # Per-probe trace on stderr (the JSON contract line stays single):
-    # the window/driver logs then record the whole tuning curve, not
-    # just the winner.
-    print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} -> "
-          f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step)",
-          file=sys.stderr)
-    return batch_size / sec, flops, bytes_accessed
+    secs.append(sec)
+  sec = sorted(secs)[len(secs) // 2]
+  print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} -> "
+        f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step)",
+        file=sys.stderr)
+  return {
+      "ok": True,
+      "examples_per_sec": batch_size / sec,
+      "step_sec": sec,
+      "flops": None if math.isnan(flops) else flops,
+      "bytes_accessed": (None if math.isnan(bytes_accessed)
+                         else bytes_accessed),
+      "device_kind": device.device_kind,
+      "platform": device.platform,
+      "batch_size": batch_size,
+  }
 
-  # The bench must emit a number even if the reference-scale config does
-  # not fit a particular chip's HBM: halve the batch on RESOURCE_EXHAUSTED
-  # (throughput is reported per example, so it stays comparable-ish; the
-  # batch actually used is recorded in the JSON).
-  def measure_with_oom_fallback(batch_size):
-    while True:
-      try:
-        return measure(batch_size) + (batch_size,)
-      except Exception as e:  # noqa: BLE001 - retry only on OOM
-        if "RESOURCE_EXHAUSTED" not in str(e) or batch_size <= 4:
-          raise
-        print(f"bench: batch {batch_size} OOM; retrying at "
-              f"{batch_size // 2}", file=sys.stderr)
-        batch_size //= 2
 
-  examples_per_sec, flops, bytes_accessed, batch_size = (
-      measure_with_oom_fallback(BATCH_SIZE if on_tpu else 16))
-  if not on_tpu:
-    # Host-load noise swings this VM +-20% (PERFORMANCE.md round-2 A/B):
-    # take the median of three short runs so a single low sample does
-    # not read as a round-over-round regression. TPU runs stay single
-    # (50 steps amortize noise; re-running costs tunnel compiles).
-    reruns = sorted([examples_per_sec] +
-                    [measure(batch_size)[0] for _ in range(2)])
-    examples_per_sec = reruns[1]
-  value_batch64 = examples_per_sec if batch_size == BATCH_SIZE else None
-  use_remat = False
-  if on_tpu and batch_size == BATCH_SIZE:
-    # The step is HBM-bandwidth-bound (PERFORMANCE.md roofline) and the
-    # optimizer/EMA traffic is per-STEP: larger batches amortize it per
-    # example. Keep doubling while throughput improves (cap 512 bounds
-    # the window time); any failure keeps the last good number. The
-    # batch actually used lands in the JSON.
-    probe = 2 * BATCH_SIZE
-    while probe <= 512:
-      try:
-        bigger, flops2, bytes2 = measure(probe)
-      except Exception as e:  # noqa: BLE001 - the last number stands
-        print(f"bench: batch-{probe} probe failed "
-              f"({type(e).__name__}: {e}); keeping batch {batch_size}",
-              file=sys.stderr)
-        break
-      if bigger <= examples_per_sec:
-        break
-      examples_per_sec, batch_size = bigger, probe
-      flops, bytes_accessed = flops2, bytes2
-      probe *= 2
-  use_s2d = False
-  if on_tpu:
-    # Rematerialization probe at the winning batch. The local v5e AOT
-    # lever matrix (PERFORMANCE.md round 4) predicts remat HURTS here
-    # (more bytes AND more flops; the step is not activation-bound) —
-    # the probe stays as the on-chip check. Keep whichever wins.
+def _probe_child_entry(cfg_json: str, out_path: str) -> None:
+  try:
+    rec = probe_main(json.loads(cfg_json))
+  except Exception as e:  # noqa: BLE001 - parent decides how to react
+    rec = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+  tmp = out_path + ".tmp"
+  with open(tmp, "w") as f:
+    json.dump(rec, f)
+  os.replace(tmp, out_path)
+
+
+def _subprocess_probe(batch_size: int, remat: bool = False,
+                      s2d: bool = False,
+                      deadline: float = PROBE_DEADLINE_SEC) -> dict:
+  """Runs one TPU probe in a fresh subprocess; never signals it.
+
+  Returns the child's record, {"ok": False, ...} on child error, or
+  {"timeout": True} when the deadline passes (child left to finish or
+  hang on its own — signalling a process that holds a TPU client is the
+  documented tunnel-wedging trigger, PERFORMANCE.md rules #4/#5).
+  """
+  cfg = {"platform": "tpu", "batch_size": batch_size, "remat": remat,
+         "s2d": s2d}
+  fd, out_path = tempfile.mkstemp(prefix="bench_probe_", suffix=".json")
+  os.close(fd)
+  os.unlink(out_path)  # child creates it atomically
+  proc = subprocess.Popen(
+      [sys.executable, os.path.abspath(__file__), "--probe",
+       json.dumps(cfg), out_path],
+      stdout=sys.stderr, stderr=sys.stderr)
+  start = time.monotonic()
+  while time.monotonic() - start < deadline:
+    if proc.poll() is not None:
+      break
+    time.sleep(2.0)
+  try:
+    if proc.poll() is None:
+      print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} "
+            f"exceeded {deadline:.0f}s deadline; abandoning it un-signalled "
+            "and skipping remaining probes", file=sys.stderr)
+      return {"timeout": True}
+    with open(out_path) as f:
+      return json.load(f)
+  except OSError:
+    return {"ok": False,
+            "error": f"probe child exited rc={proc.returncode} "
+                     "without writing a result"}
+  finally:
+    # Best-effort: on the timeout path the abandoned child may still
+    # os.replace() its record here later; the unlink then just loses a
+    # stale temp file instead of leaking one per hung-tunnel run.
     try:
-      r_eps, r_flops, r_bytes = measure(batch_size, remat=True)
-      if r_eps > examples_per_sec:
-        examples_per_sec, use_remat = r_eps, True
-        flops, bytes_accessed = r_flops, r_bytes
-    except Exception as e:  # noqa: BLE001 - the non-remat number stands
-      print(f"bench: remat probe failed ({type(e).__name__}: {e}); "
-            f"keeping remat=False", file=sys.stderr)
-    # Space-to-depth stem probe (exact math, tests pin equivalence):
-    # the 3-channel stem conv drives 3/128 MXU lanes; folding 2x2
-    # pixels into 12 channels quadruples lane utilization on a conv the
-    # cost model prices at 3% of flops but that can take a far larger
-    # wall-clock share at 2% MXU efficiency. Only the chip can price
-    # it; "space_to_depth" lands in the JSON.
-    try:
-      s_eps, s_flops, s_bytes = measure(batch_size, remat=use_remat,
-                                        s2d=True)
-      if s_eps > examples_per_sec:
-        examples_per_sec, use_s2d = s_eps, True
-        flops, bytes_accessed = s_flops, s_bytes
-    except Exception as e:  # noqa: BLE001 - the non-s2d number stands
-      print(f"bench: space-to-depth probe failed "
-            f"({type(e).__name__}: {e}); keeping s2d=False",
+      os.unlink(out_path)
+    except OSError:
+      pass
+
+
+def autotune(probe, initial_batch: int = BATCH_SIZE,
+             batch_cap: int = 512) -> dict | None:
+  """Batch/remat/s2d auto-tune over a probe callable; pure logic.
+
+  `probe(batch_size, remat, s2d)` returns probe_main-style records (or
+  {"timeout": True}). Returns the winning record extended with
+  {"batch_size", "remat", "s2d", "value_batch64", "aborted"}; None when
+  the very first probe yields no usable number (caller falls back).
+  Policy (unchanged from rounds 2-4, now timeout-aware):
+    - OOM at the initial batch halves it (floor 4);
+    - batch doubles while throughput improves (cap `batch_cap`);
+    - remat, then space-to-depth, probed at the winning batch;
+    - ANY timeout abandons all remaining probes (the tunnel is suspect
+      and each further probe would hang the full deadline) but keeps
+      the best already-measured number.
+  """
+  batch = initial_batch
+  rec = None
+  while True:
+    r = probe(batch, False, False)
+    if r.get("timeout"):
+      return None
+    if r.get("ok"):
+      rec = r
+      break
+    if "RESOURCE_EXHAUSTED" in r.get("error", "") and batch > 4:
+      print(f"bench: batch {batch} OOM; retrying at {batch // 2}",
             file=sys.stderr)
-  # Efficiency accounting: achieved model FLOP/s over the device peak
-  # (MFU a.k.a. MXU utilization) and HBM bytes per step, both from the
-  # compiled executable's own XLA cost analysis — so the driver record
-  # tracks efficiency, not just throughput.
-  step_sec = batch_size / examples_per_sec
-  peak = PEAK_BF16_FLOPS.get(device.device_kind, PEAK_BF16_FLOPS["default"])
-  mfu = (flops / step_sec / peak) if np.isfinite(flops) else None
-  if on_tpu:
+      batch //= 2
+      continue
+    print(f"bench: initial probe failed ({r.get('error')})",
+          file=sys.stderr)
+    return None
+  best = dict(rec, batch_size=batch, remat=False, s2d=False,
+              value_batch64=(rec["examples_per_sec"]
+                             if batch == BATCH_SIZE else None),
+              aborted=False)
+
+  def try_probe(b, remat, s2d, what):
+    nonlocal best
+    if best["aborted"]:
+      return None
+    r = probe(b, remat, s2d)
+    if r.get("timeout"):
+      best["aborted"] = True
+      return None
+    if not r.get("ok"):
+      print(f"bench: {what} probe failed ({r.get('error')}); "
+            f"keeping the current best", file=sys.stderr)
+      return None
+    return r
+
+  # The step is HBM-bandwidth-bound (PERFORMANCE.md roofline) and the
+  # optimizer/EMA traffic is per-STEP: larger batches amortize it per
+  # example. Keep doubling while throughput improves (cap bounds the
+  # window time); any failure keeps the last good number.
+  if batch == initial_batch:
+    probe_batch = 2 * batch
+    while probe_batch <= batch_cap:
+      r = try_probe(probe_batch, False, False, f"batch-{probe_batch}")
+      if r is None or r["examples_per_sec"] <= best["examples_per_sec"]:
+        break
+      best.update(r, batch_size=probe_batch)
+      probe_batch *= 2
+  # Rematerialization probe at the winning batch. The local v5e AOT
+  # lever matrix (PERFORMANCE.md round 4) predicts remat HURTS here
+  # (more bytes AND more flops; the step is not activation-bound) —
+  # the probe stays as the on-chip check. Keep whichever wins.
+  r = try_probe(best["batch_size"], True, False, "remat")
+  if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
+    best.update(r, remat=True)
+  # Space-to-depth stem probe (exact math, tests pin equivalence):
+  # the 3-channel stem conv drives 3/128 MXU lanes; folding 2x2
+  # pixels into 12 channels quadruples lane utilization on a conv the
+  # cost model prices at 3% of flops but that can take a far larger
+  # wall-clock share at 2% MXU efficiency. Only the chip can price it.
+  r = try_probe(best["batch_size"], best["remat"], True, "space-to-depth")
+  if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
+    best.update(r, s2d=True)
+  return best
+
+
+def main() -> None:
+  if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
+    _probe_child_entry(sys.argv[2], sys.argv[3])
+    return
+  best = None
+  if backend_lib.accelerator_healthy():
+    best = autotune(_subprocess_probe)
+  if best is not None:
+    # Efficiency accounting: achieved model FLOP/s over the device peak
+    # (MFU a.k.a. MXU utilization) and HBM bytes per step, both from the
+    # compiled executable's own XLA cost analysis — so the driver record
+    # tracks efficiency, not just throughput.
+    eps = best["examples_per_sec"]
+    step_sec = best["batch_size"] / eps
+    peak = PEAK_BF16_FLOPS.get(best.get("device_kind"),
+                               PEAK_BF16_FLOPS["default"])
+    flops = best.get("flops")
+    mfu = (flops / step_sec / peak) if flops else None
     print(json.dumps({
         "metric": "qtopt_grasps_per_sec_per_chip",
-        "value": round(examples_per_sec, 2),
+        "value": round(eps, 2),
         "unit": "examples/sec",
-        "vs_baseline": round(examples_per_sec / BASELINE_PER_CHIP, 3),
+        "vs_baseline": round(eps / BASELINE_PER_CHIP, 3),
         # < BATCH_SIZE: OOM degradation (the reference-scale batch did
-        # not fit); > BATCH_SIZE: a doubling probe (cap 512) won. The
-        # remat probe may also flip "remat" on. value_batch64 keeps the
+        # not fit); > BATCH_SIZE: a doubling probe won. The remat/s2d
+        # probes may also flip their flags on. value_batch64 keeps the
         # fixed-batch non-remat number for round-over-round comparison.
-        "batch_size": batch_size,
-        "remat": use_remat,
-        "space_to_depth": use_s2d,
-        "value_batch64": (round(value_batch64, 2)
-                          if value_batch64 is not None else None),
+        # probes_aborted: a probe hit the hang deadline and the rest
+        # were skipped — the value is a lower bound for the tuned one.
+        "batch_size": best["batch_size"],
+        "remat": best["remat"],
+        "space_to_depth": best["s2d"],
+        "value_batch64": (round(best["value_batch64"], 2)
+                          if best["value_batch64"] is not None else None),
         "mfu": round(mfu, 4) if mfu is not None else None,
-        "flops_per_step": flops if np.isfinite(flops) else None,
-        "bytes_per_step": (bytes_accessed
-                           if np.isfinite(bytes_accessed) else None),
-        "device_kind": device.device_kind,
+        "flops_per_step": flops,
+        "bytes_per_step": best.get("bytes_accessed"),
+        "device_kind": best.get("device_kind"),
+        "probes_aborted": best["aborted"],
     }))
-  else:
-    # Honest labeling: the CPU smoke config (smaller image/batch) is not
-    # comparable to the V100-class anchor. The anchor is the throughput
-    # measured for this exact config on this host during round 1
-    # (3643 examples/sec), so vs_baseline ~= 1.0 means "no regression vs
-    # the recorded CPU baseline", nothing more.
-    cpu_anchor = 3643.0  # recorded for this exact config at batch 16
-    print(json.dumps({
-        "metric": "qtopt_grasps_per_sec_cpu_smoke",
-        "value": round(examples_per_sec, 2),
-        "unit": "examples/sec",
-        "vs_baseline": round(examples_per_sec / cpu_anchor, 3),
-        "batch_size": batch_size,
-    }))
+    return
+  # Device backend unreachable (or every TPU probe failed): CPU smoke
+  # fallback, in-process — pin_cpu never touches the tunnel. Honest
+  # labeling: the CPU smoke config (smaller image/batch) is not
+  # comparable to the V100-class anchor. The anchor is the throughput
+  # measured for this exact config on this host during round 1
+  # (3643 examples/sec), so vs_baseline ~= 1.0 means "no regression vs
+  # the recorded CPU baseline", nothing more.
+  rec = probe_main({"platform": "cpu", "batch_size": 16, "reruns": 3})
+  cpu_anchor = 3643.0  # recorded for this exact config at batch 16
+  print(json.dumps({
+      "metric": "qtopt_grasps_per_sec_cpu_smoke",
+      "value": round(rec["examples_per_sec"], 2),
+      "unit": "examples/sec",
+      "vs_baseline": round(rec["examples_per_sec"] / cpu_anchor, 3),
+      "batch_size": rec["batch_size"],
+  }))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,129 @@
+"""bench.py auto-tune policy: pure-logic tests over a fake probe.
+
+The real measurements run in per-probe subprocesses against the tunnel
+(untestable in CI); the decision policy — batch doubling, OOM halving,
+remat/s2d adoption, and the round-5 hang-deadline abort that keeps the
+best-so-far number instead of forfeiting the headline JSON — is pure
+logic over a probe callable and is pinned here. Reference analogue:
+the reference has no throughput bench; policy provenance is
+PERFORMANCE.md (axon tunnel measurement rules) and the round-4 AOT
+lever matrix.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+_spec = importlib.util.spec_from_file_location("bench", _BENCH_PATH)
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+class FakeProbe:
+  """Maps (batch, remat, s2d) -> ex/s, 'oom', 'timeout', or 'error'."""
+
+  def __init__(self, table):
+    self.table = table
+    self.calls = []
+
+  def __call__(self, batch, remat, s2d):
+    self.calls.append((batch, remat, s2d))
+    val = self.table[(batch, remat, s2d)]
+    if val == "timeout":
+      return {"timeout": True}
+    if val == "oom":
+      return {"ok": False, "error": "RESOURCE_EXHAUSTED: hbm"}
+    if val == "error":
+      return {"ok": False, "error": "XlaRuntimeError: boom"}
+    return {"ok": True, "examples_per_sec": val, "step_sec": batch / val,
+            "flops": 1e12, "bytes_accessed": 2e10,
+            "device_kind": "TPU v5e", "platform": "tpu",
+            "batch_size": batch}
+
+
+def test_doubling_stops_on_regression_and_probes_remat_s2d_at_winner():
+  probe = FakeProbe({
+      (64, False, False): 1000.0,
+      (128, False, False): 1500.0,
+      (256, False, False): 1200.0,   # regression: stop doubling
+      (128, True, False): 1400.0,    # remat loses
+      (128, False, True): 1600.0,    # s2d wins
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 128
+  assert not best["remat"] and best["s2d"]
+  assert best["examples_per_sec"] == 1600.0
+  assert best["value_batch64"] == 1000.0
+  assert not best["aborted"]
+  # s2d probed at the winning batch with the winning remat setting.
+  assert (128, False, True) in probe.calls
+
+
+def test_remat_win_carries_into_s2d_probe():
+  probe = FakeProbe({
+      (64, False, False): 1000.0,
+      (128, False, False): 900.0,
+      (64, True, False): 1100.0,
+      (64, True, True): 1050.0,
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 64 and best["remat"] and not best["s2d"]
+  assert best["examples_per_sec"] == 1100.0
+  assert (64, True, True) in probe.calls
+
+
+def test_timeout_mid_doubling_keeps_best_and_skips_all_remaining():
+  probe = FakeProbe({
+      (64, False, False): 1478.0,
+      (128, False, False): "timeout",
+  })
+  best = bench.autotune(probe)
+  # The already-captured number survives; nothing else is probed
+  # (each further probe would hang the full deadline on a suspect
+  # tunnel — the round-5 incident this policy exists for).
+  assert best["examples_per_sec"] == 1478.0
+  assert best["aborted"]
+  assert probe.calls == [(64, False, False), (128, False, False)]
+
+
+def test_timeout_on_first_probe_returns_none_for_fallback():
+  probe = FakeProbe({(64, False, False): "timeout"})
+  assert bench.autotune(probe) is None
+
+
+def test_error_on_first_probe_returns_none_for_fallback():
+  probe = FakeProbe({(64, False, False): "error"})
+  assert bench.autotune(probe) is None
+
+
+def test_oom_halves_initial_batch_and_skips_doubling():
+  probe = FakeProbe({
+      (64, False, False): "oom",
+      (32, False, False): 800.0,
+      (32, True, False): 700.0,
+      (32, False, True): 750.0,
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 32
+  assert best["value_batch64"] is None
+  # Degraded-batch runs do not double (matches rounds 2-4 policy).
+  assert (64, False, False) in probe.calls
+  assert all(b <= 64 for b, _, _ in probe.calls)
+
+
+def test_probe_failure_mid_tune_keeps_best_without_abort():
+  probe = FakeProbe({
+      (64, False, False): 1000.0,
+      (128, False, False): "error",
+      (64, True, False): "error",
+      (64, False, True): "error",
+  })
+  best = bench.autotune(probe)
+  assert best["examples_per_sec"] == 1000.0
+  assert not best["aborted"]
+  # Non-timeout failures keep probing (an OOM at batch 128 says
+  # nothing about remat at batch 64).
+  assert (64, False, True) in probe.calls
