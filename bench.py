@@ -275,6 +275,18 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
     while probe_batch <= batch_cap:
       r = try_probe(probe_batch, False, False, f"batch-{probe_batch}")
       if r is None or r["examples_per_sec"] <= best["examples_per_sec"]:
+        # Round-5 on-chip fact: doubling can fall off a CLIFF, not a
+        # slope (b128 measured 5x slower than b64 against a 2x-better
+        # compiler ceiling). When the doubled batch lost >20%, the
+        # winner-batch..cliff midpoint may keep the winner's regime
+        # while amortizing more per-step traffic — one extra probe.
+        if (r is not None
+            and r["examples_per_sec"] < 0.8 * best["examples_per_sec"]):
+          mid = best["batch_size"] * 3 // 2
+          m = try_probe(mid, False, False, f"batch-{mid} midpoint")
+          if (m is not None
+              and m["examples_per_sec"] > best["examples_per_sec"]):
+            best.update(m, batch_size=mid)
         break
       best.update(r, batch_size=probe_batch)
       probe_batch *= 2

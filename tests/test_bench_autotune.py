@@ -114,6 +114,45 @@ def test_oom_halves_initial_batch_and_skips_doubling():
   assert all(b <= 64 for b, _, _ in probe.calls)
 
 
+def test_cliff_regression_probes_the_midpoint_batch():
+  probe = FakeProbe({
+      (64, False, False): 1478.0,
+      (128, False, False): 285.0,    # >20% cliff -> midpoint probed
+      (96, False, False): 1650.0,    # midpoint wins
+      (96, True, False): 1000.0,
+      (96, False, True): 1200.0,
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 96
+  assert best["examples_per_sec"] == 1650.0
+  assert best["value_batch64"] == 1478.0
+
+
+def test_mild_regression_skips_the_midpoint_probe():
+  probe = FakeProbe({
+      (64, False, False): 1000.0,
+      (128, False, False): 950.0,    # <20% loss: plateau, no midpoint
+      (64, True, False): 900.0,
+      (64, False, True): 900.0,
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 64
+  assert (96, False, False) not in probe.calls
+
+
+def test_midpoint_loss_keeps_the_doubling_winner():
+  probe = FakeProbe({
+      (64, False, False): 1478.0,
+      (128, False, False): 285.0,
+      (96, False, False): 1400.0,    # midpoint loses -> keep 64
+      (64, True, False): 1000.0,
+      (64, False, True): 1000.0,
+  })
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 64
+  assert best["examples_per_sec"] == 1478.0
+
+
 def test_probe_failure_mid_tune_keeps_best_without_abort():
   probe = FakeProbe({
       (64, False, False): 1000.0,
