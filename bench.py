@@ -162,6 +162,11 @@ def _probe_child_entry(cfg_json: str, out_path: str) -> None:
     rec = probe_main(json.loads(cfg_json))
   except Exception as e:  # noqa: BLE001 - parent decides how to react
     rec = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+  if out_path == "-":
+    # Standalone probe mode (scripts/tpu_window.sh uses it to A/B
+    # local-vs-remote compile): record to stdout, not a file.
+    print(json.dumps(rec))
+    return
   tmp = out_path + ".tmp"
   with open(tmp, "w") as f:
     json.dump(rec, f)
@@ -170,13 +175,18 @@ def _probe_child_entry(cfg_json: str, out_path: str) -> None:
 
 def _subprocess_probe(batch_size: int, remat: bool = False,
                       s2d: bool = False,
-                      deadline: float = PROBE_DEADLINE_SEC) -> dict:
+                      deadline: float = PROBE_DEADLINE_SEC,
+                      extra_env: dict | None = None) -> dict:
   """Runs one TPU probe in a fresh subprocess; never signals it.
 
   Returns the child's record, {"ok": False, ...} on child error, or
   {"timeout": True} when the deadline passes (child left to finish or
   hang on its own — signalling a process that holds a TPU client is the
   documented tunnel-wedging trigger, PERFORMANCE.md rules #4/#5).
+  `extra_env` lands in the child's environment BEFORE interpreter start
+  — the axon sitecustomize reads its config (e.g.
+  PALLAS_AXON_REMOTE_COMPILE) at import time, so this is the only way
+  to vary it per probe.
   """
   cfg = {"platform": "tpu", "batch_size": batch_size, "remat": remat,
          "s2d": s2d}
@@ -186,7 +196,8 @@ def _subprocess_probe(batch_size: int, remat: bool = False,
   proc = subprocess.Popen(
       [sys.executable, os.path.abspath(__file__), "--probe",
        json.dumps(cfg), out_path],
-      stdout=sys.stderr, stderr=sys.stderr)
+      stdout=sys.stderr, stderr=sys.stderr,
+      env=(dict(os.environ, **extra_env) if extra_env else None))
   start = time.monotonic()
   while time.monotonic() - start < deadline:
     if proc.poll() is not None:
@@ -308,9 +319,33 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
   return best
 
 
+def _ab_local_compile(batch_size: int) -> None:
+  """A/B item for scripts/tpu_window.sh: one probe at the headline
+  config with the axon client compiling IN-PROCESS via the image's
+  libtpu (PALLAS_AXON_REMOTE_COMPILE=0) instead of the terminal's
+  /remote_compile endpoint (whose hour-long stall ate the round-5 s2d
+  probe). Follows the window-plan contract: health-gates itself,
+  bounds the probe with the standard deadline, and exits 2 when the
+  tunnel is down or the probe yields no number — so the plan's resume
+  logic re-runs it next window instead of marking it captured.
+  """
+  if not backend_lib.accelerator_healthy():
+    print("tunnel down; local-compile A/B not run", file=sys.stderr)
+    sys.exit(2)
+  rec = _subprocess_probe(
+      batch_size, extra_env={"PALLAS_AXON_REMOTE_COMPILE": "0"})
+  if rec.get("timeout") or not rec.get("ok"):
+    print(f"local-compile A/B probe failed: {rec}", file=sys.stderr)
+    sys.exit(2)
+  print(json.dumps(dict(rec, compile_mode="local")))
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--ab-local-compile":
+    _ab_local_compile(int(sys.argv[2]) if len(sys.argv) > 2 else BATCH_SIZE)
     return
   best = None
   if backend_lib.accelerator_healthy():

@@ -34,6 +34,14 @@ run() {
 date | tee -a "$OUT"
 # 1. The headline number first — never risk losing it to a later wedge.
 run python bench.py
+# 1b. Local-compile A/B at the headline config: the axon client
+#     compiles in-process via the image's libtpu (the round-4 AOT
+#     path) and only execution rides the relay — bypassing the
+#     /remote_compile endpoint whose hour-long stall ate the round-5
+#     s2d probe. If throughput matches, local compile becomes the
+#     default probe mode. Self-gating (health probe + deadline +
+#     exit 2), like every other plan item.
+run python bench.py --ab-local-compile 64
 # 2. Flash kernels on real hardware (round-1 weakness #2 close-out).
 run python scripts/tpu_flash_validate.py correctness
 run python scripts/tpu_flash_validate.py time 1024

@@ -22,6 +22,55 @@ bench = importlib.util.module_from_spec(_spec)
 _spec.loader.exec_module(bench)
 
 
+def test_probe_child_stdout_mode_prints_record(capsys):
+  """out_path == '-' (the window plan's A/B mode) must print the
+  record to stdout instead of writing a file — semantic: the record
+  round-trips as JSON and carries a real measured throughput."""
+  import json
+  bench._probe_child_entry(
+      json.dumps({"platform": "cpu", "batch_size": 4}), "-")
+  rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert rec["ok"] and rec["batch_size"] == 4
+  assert rec["examples_per_sec"] > 0 and rec["platform"] == "cpu"
+
+
+def test_probe_child_error_record_still_prints_in_stdout_mode(capsys):
+  import json
+  bench._probe_child_entry(json.dumps({"platform": "nope"}), "-")
+  rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+  assert rec["ok"] is False and "error" in rec
+
+
+def test_subprocess_probe_threads_extra_env_to_child(monkeypatch, tmp_path):
+  """PALLAS_AXON_REMOTE_COMPILE must reach the child's ENVIRONMENT
+  (the axon sitecustomize reads it at interpreter start; setting it
+  after import is too late)."""
+  import json
+  captured = {}
+
+  class FakeProc:
+    returncode = 0
+
+    def __init__(self, argv, env=None, **kw):
+      captured["env"] = env
+      # argv: [python, bench.py, --probe, cfg_json, out_path]
+      with open(argv[4], "w") as f:
+        json.dump({"ok": True, "examples_per_sec": 1.0,
+                   "batch_size": 64}, f)
+
+    def poll(self):
+      return 0
+
+  monkeypatch.setattr(bench.subprocess, "Popen", FakeProc)
+  rec = bench._subprocess_probe(
+      64, extra_env={"PALLAS_AXON_REMOTE_COMPILE": "0"})
+  assert rec["ok"]
+  assert captured["env"]["PALLAS_AXON_REMOTE_COMPILE"] == "0"
+  # Without extra_env the child inherits the parent env untouched.
+  rec = bench._subprocess_probe(64)
+  assert captured["env"] is None
+
+
 class FakeProbe:
   """Maps (batch, remat, s2d) -> ex/s, 'oom', 'timeout', or 'error'."""
 
