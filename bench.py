@@ -134,20 +134,28 @@ def probe_main(cfg: dict) -> dict:
   # round-2 A/B), so time the loop `reruns` times on the one compiled
   # step and keep the median. TPU runs stay single (50 steps amortize
   # noise; re-running costs tunnel time).
-  secs = []
+  # Steady-state discipline (round 5): the timed loop runs as two
+  # barrier-separated halves and the SECOND half is the reported
+  # number — one-time remote effects inside the window (first-touch
+  # allocation/defrag; the b128 cliff probe read 449 ms/step plain-
+  # mean) land in the first half, and a large half-to-half gap is
+  # recorded as its own diagnostic ("first_half_sec").
+  runs = []
   for _ in range(cfg.get("reruns", 1)):
-    sec, state = backend_lib.time_train_steps(
+    h1, h2, state = backend_lib.time_train_steps_halves(
         step, state, features, labels, iters=measure_steps,
         warmup=WARMUP_STEPS)
-    secs.append(sec)
-  sec = sorted(secs)[len(secs) // 2]
+    runs.append((h2, h1))
+  sec, first_half = sorted(runs)[len(runs) // 2]
   print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} -> "
-        f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step)",
+        f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step steady; "
+        f"first half {first_half * 1e3:.1f} ms/step)",
         file=sys.stderr)
   return {
       "ok": True,
       "examples_per_sec": batch_size / sec,
       "step_sec": sec,
+      "first_half_sec": first_half,
       "flops": None if math.isnan(flops) else flops,
       "bytes_accessed": (None if math.isnan(bytes_accessed)
                          else bytes_accessed),

@@ -116,6 +116,46 @@ def test_time_train_steps_runs_warmup_plus_iters_with_barriers():
   assert isinstance(out, _State)
 
 
+def test_time_train_steps_halves_reports_steady_state_separately():
+  """The split-halves timer must run exactly warmup+iters steps, split
+  the timed window into two barrier-separated halves, and report the
+  second (steady-state) half independently — the round-5 discipline
+  that keeps one-time remote allocation effects out of the headline
+  number. Semantic check: with a step whose first timed call is slow,
+  the first-half rate must come out slower than the second half."""
+  import time as _time
+
+  import numpy as np
+
+  calls = []
+
+  class _State:
+    params = {"w": np.zeros(3)}
+
+  def step(state, features, labels):
+    calls.append(1)
+    if len(calls) == 3:  # first TIMED step (after warmup=2)
+      _time.sleep(0.05)
+    return state, {}
+
+  h1, h2, out = backend.time_train_steps_halves(
+      step, _State(), "f", "l", iters=6, warmup=2)
+  assert len(calls) == 8
+  assert h1 > h2 > 0
+  assert isinstance(out, _State)
+
+
+def test_time_train_steps_halves_single_iter_degrades_gracefully():
+  import numpy as np
+
+  class _State:
+    params = {"w": np.zeros(1)}
+
+  h1, h2, _ = backend.time_train_steps_halves(
+      lambda s, f, l: (s, {}), _State(), "f", "l", iters=1, warmup=0)
+  assert h1 >= 0 and h2 == h1
+
+
 def test_state_barrier_fetches_smallest_param_leaf():
   import numpy as np
 
