@@ -124,12 +124,13 @@ def probe_main(cfg: dict) -> dict:
     print(f"bench: AOT cost analysis unavailable "
           f"({type(e).__name__}: {e}); efficiency fields will be null",
           file=sys.stderr)
-  # backend_lib.time_train_steps is the one shared tunnel-safe timing
-  # recipe: warmup -> host-fetch barrier on the smallest param leaf
-  # (block_until_ready returns early over the axon tunnel; the loss
-  # does not depend on the final step's optimizer/EMA update) ->
-  # timed loop -> barrier. The ~0.1 s fetch round-trip is amortized
-  # over measure_steps and biases throughput slightly LOW.
+  # backend_lib.time_train_steps_halves is the one shared tunnel-safe
+  # timing recipe: warmup -> host-fetch barrier on the smallest param
+  # leaf (block_until_ready returns early over the axon tunnel; the
+  # loss does not depend on the final step's optimizer/EMA update) ->
+  # two timed half-windows with barrier costs estimated and subtracted
+  # (pure step time; pre-round-5 captures read ~2 ms/step heavy by
+  # including one barrier — PERFORMANCE.md comparability notes).
   # CPU smoke: host-load noise swings this VM +-20% (PERFORMANCE.md
   # round-2 A/B), so time the loop `reruns` times on the one compiled
   # step and keep the median. TPU runs stay single (50 steps amortize

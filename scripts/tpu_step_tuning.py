@@ -51,8 +51,21 @@ def _setup(batch_size, remat=False):
 
 def _step_time(jax, state, step, features, labels, iters=20):
   del jax  # kept for call-site signature stability
-  return backend.time_train_steps(step, state, features, labels,
-                                  iters=iters)
+  h1, h2, state = backend.time_train_steps_halves(
+      step, state, features, labels, iters=iters)
+  if h1 > 1.2 * h2:
+    # The round-5 b128 cliff diagnostic: a slow FIRST half means
+    # one-time effects (first-touch allocation/defrag) inside the timed
+    # window; the second half is the steady state.
+    print(f"  [halves: first {h1 * 1e3:.1f} ms/step, "
+          f"second {h2 * 1e3:.1f} ms/step — steady-state is the second]")
+  elif h2 > 1.2 * h1:
+    # The opposite gap means the device/tunnel DEGRADED mid-window
+    # (thermal, contention); reporting the slower half is conservative.
+    print(f"  [halves: first {h1 * 1e3:.1f} ms/step, "
+          f"second {h2 * 1e3:.1f} ms/step — slowdown mid-window; "
+          f"reporting the slower second half]")
+  return h2, state
 
 
 def roofline(batch_size=64):

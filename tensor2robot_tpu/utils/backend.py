@@ -108,10 +108,9 @@ def time_train_steps(step, state, features, labels, iters,
   recipe lands everywhere at once."""
   h1, h2, state = time_train_steps_halves(step, state, features, labels,
                                           iters, warmup=warmup)
-  # Preserve this function's historical contract (mean over ALL timed
-  # steps, one closing-barrier fetch per window) by recombining the
-  # halves weighted by their step counts: h1 excludes its barrier
-  # (estimated and subtracted), h2 includes the closing one.
+  # Mean over ALL timed steps, both halves barrier-subtracted (pure
+  # step time; see time_train_steps_halves for the round-5 contract
+  # change vs pre-round-5 windows, which included one barrier fetch).
   n1 = iters - iters // 2
   return (h1 * n1 + h2 * (iters - n1)) / iters, state
 
@@ -127,12 +126,15 @@ def time_train_steps_halves(step, state, features, labels, iters,
   the round-5 b128 probe read 449 ms/step where a single multi-second
   anomaly in 50 steps could account for most of it. The second half is
   the steady-state number (what a days-long training run sees); a large
-  half-to-half gap is itself the diagnostic. The mid-loop barrier's
-  fetch cost is estimated (by a back-to-back second fetch on the
-  already-drained device) and subtracted from the first half, so the
-  halves carry ~zero and ~one barrier fetch respectively — recombined,
-  that is the historical one-barrier-per-window contract of
-  ``time_train_steps``."""
+  half-to-half gap is itself the diagnostic. The barrier fetch cost is
+  estimated (by a back-to-back second fetch on the already-drained
+  device) and subtracted from BOTH halves, so each is pure step time —
+  a barrier amortized over a short half (e.g. 2 steps in a 5-iter
+  profile window) would otherwise dominate it. Round-5 contract change:
+  pre-round-5 numbers included one un-subtracted barrier per window and
+  so read ~barrier/iters LOW (~2 ms/step at 50 tunnel iters) against
+  numbers produced by this discipline — noted in PERFORMANCE.md's
+  comparability notes."""
   import time
 
   for _ in range(warmup):
@@ -163,7 +165,8 @@ def time_train_steps_halves(step, state, features, labels, iters,
     state, _ = step(state, features, labels)
   state_barrier(state)
   end = time.perf_counter()
-  return sec_h1, (end - mid2) / n2, state
+  sec_h2 = max(end - mid2 - barrier_cost, 0.0) / n2
+  return sec_h1, sec_h2, state
 
 
 def accelerator_healthy(timeout: float = 120.0) -> bool:
