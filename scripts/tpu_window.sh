@@ -10,7 +10,52 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-tpu_window_results.txt}"
 
+# Per-item deadline (round 5): an item that stalls mid-RPC (the s2d
+# /remote_compile class — zero CPU, waiting on the tunnel forever)
+# would otherwise hang the WHOLE unattended plan. On deadline the item
+# is ABANDONED (never signalled — signalling an open TPU client is the
+# documented wedging trigger) and the plan stops with rc=2, exactly as
+# if the tunnel were seen down: the watchdog resumes polling and a
+# later healthy window re-runs only the un-captured items.
+ITEM_DEADLINE="${T2R_WINDOW_ITEM_DEADLINE:-1800}"
+ITEM_LOG="/tmp/t2r_window_item_current.log"
+ABANDONED="/tmp/t2r_window_abandoned.pids"
+
+# A previously-abandoned item may un-stall later and drive the tunnel
+# concurrently with this window, corrupting its timings (or re-wedging
+# the tunnel). Refuse to start while any recorded abandoned pid is
+# still alive; the watchdog will retry on its next healthy probe.
+if [ -f "$ABANDONED" ]; then
+  while read -r apid; do
+    if [ -n "$apid" ] && kill -0 "$apid" 2>/dev/null; then
+      echo "abandoned item pid $apid is still alive; refusing to start" \
+           "a concurrent window" | tee -a "$OUT"
+      exit 2
+    fi
+  done < "$ABANDONED"
+  rm -f "$ABANDONED"
+fi
+# If a previous window's bash died mid-item (OOM-kill, host reboot),
+# its partial item output is stranded in the fixed-name item log —
+# recover it into the results file instead of losing the diagnostics.
+if [ -s "$ITEM_LOG" ]; then
+  {
+    echo "=== recovered partial output from an interrupted item ==="
+    grep -v -E "^WARNING|^I0|^W0|^E0" "$ITEM_LOG"
+    echo
+  } >> "$OUT"
+  rm -f "$ITEM_LOG"
+fi
+
 run() {
+  # Optional per-item override: `run -t SECONDS cmd...` (bench.py gets
+  # a long one — it self-bounds each probe but can legitimately run
+  # tens of minutes of probes).
+  local deadline="$ITEM_DEADLINE"
+  if [ "$1" = "-t" ]; then
+    deadline="$2"
+    shift 2
+  fi
   # Resume support: items that already completed in an earlier (partial)
   # window are skipped, so a re-run after a mid-plan wedge finishes the
   # REMAINING items instead of re-exposing the tunnel to captured ones.
@@ -19,8 +64,34 @@ run() {
     return 0
   fi
   echo "=== $* ===" | tee -a "$OUT"
-  "$@" 2>&1 | grep -v -E "^WARNING|^I0|^W0|^E0" | tee -a "$OUT"
-  rc=${PIPESTATUS[0]}
+  # Fixed-name item log (not mktemp): if this script itself dies
+  # mid-item, the next window recovers the partial output (see top).
+  : > "$ITEM_LOG"
+  "$@" > "$ITEM_LOG" 2>&1 &
+  local pid=$! waited=0
+  while kill -0 "$pid" 2>/dev/null && [ "$waited" -lt "$deadline" ]
+  do
+    sleep 5
+    waited=$((waited + 5))
+  done
+  local rc
+  if kill -0 "$pid" 2>/dev/null; then
+    disown "$pid" 2>/dev/null || true
+    echo "$pid" >> "$ABANDONED"
+    grep -v -E "^WARNING|^I0|^W0|^E0" "$ITEM_LOG" | tee -a "$OUT"
+    # Unlink the log name; the abandoned child keeps writing to the
+    # open (now anonymous) inode harmlessly.
+    rm -f "$ITEM_LOG"
+    echo "ITEM EXCEEDED ${deadline}s — abandoned un-signalled" \
+         "(pid $pid, recorded in $ABANDONED); stopping the window plan" \
+         | tee -a "$OUT"
+    echo >> "$OUT"
+    exit 2
+  fi
+  wait "$pid"
+  rc=$?
+  grep -v -E "^WARNING|^I0|^W0|^E0" "$ITEM_LOG" | tee -a "$OUT"
+  rm -f "$ITEM_LOG"
   if [ "$rc" -eq 2 ]; then
     echo "TUNNEL DOWN — stopping the window plan" | tee -a "$OUT"
     exit 2
@@ -33,7 +104,7 @@ run() {
 
 date | tee -a "$OUT"
 # 1. The headline number first — never risk losing it to a later wedge.
-run python bench.py
+run -t 7200 python bench.py
 # 1b. Local-compile A/B at the headline config: the axon client
 #     compiles in-process via the image's libtpu (the round-4 AOT
 #     path) and only execution rides the relay — bypassing the

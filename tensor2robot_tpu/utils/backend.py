@@ -132,9 +132,9 @@ def time_train_steps_halves(step, state, features, labels, iters,
   a barrier amortized over a short half (e.g. 2 steps in a 5-iter
   profile window) would otherwise dominate it. Round-5 contract change:
   pre-round-5 numbers included one un-subtracted barrier per window and
-  so read ~barrier/iters LOW (~2 ms/step at 50 tunnel iters) against
-  numbers produced by this discipline — noted in PERFORMANCE.md's
-  comparability notes."""
+  so read ~barrier/iters HIGH (~2 ms/step HEAVY at 50 tunnel iters)
+  against numbers produced by this discipline — noted in
+  PERFORMANCE.md's comparability notes."""
   import time
 
   for _ in range(warmup):
@@ -147,26 +147,28 @@ def time_train_steps_halves(step, state, features, labels, iters,
     state, _ = step(state, features, labels)
   state_barrier(state)
   mid = time.perf_counter()
-  if n2 == 0:
-    return (mid - start) / n1, (mid - start) / n1, state
-  # The clock can only stop AFTER a barrier (dispatch is async), so the
-  # mid barrier's host-fetch cost is inside h1's window. Estimate it
-  # with a back-to-back second barrier (the device is already drained,
-  # so this times the pure fetch) and subtract — then each half carries
-  # ~zero and ~one barrier respectively, and the recombined
-  # ``time_train_steps`` mean carries one barrier per window, exactly
-  # the historical contract the tuning/baseline scripts compare
-  # against.
+  # The clock can only stop AFTER a barrier (dispatch is async), so a
+  # closing barrier's host-fetch cost is inside each half's window.
+  # Estimate it with a back-to-back second barrier (the device is
+  # already drained, so this times the pure fetch) and subtract it from
+  # BOTH halves — pure step time. If noise makes the estimate larger
+  # than a (tiny) window, fall back to the un-subtracted value rather
+  # than report a zero step time (downstream divides by it).
   state_barrier(state)
   barrier_cost = time.perf_counter() - mid
-  sec_h1 = max(mid - start - barrier_cost, 0.0) / n1
+
+  def _pure(window, n):
+    return (max(window - barrier_cost, 0.0) or window) / n
+
+  sec_h1 = _pure(mid - start, n1)
+  if n2 == 0:
+    return sec_h1, sec_h1, state
   mid2 = time.perf_counter()
   for _ in range(n2):
     state, _ = step(state, features, labels)
   state_barrier(state)
   end = time.perf_counter()
-  sec_h2 = max(end - mid2 - barrier_cost, 0.0) / n2
-  return sec_h1, sec_h2, state
+  return sec_h1, _pure(end - mid2, n2), state
 
 
 def accelerator_healthy(timeout: float = 120.0) -> bool:
