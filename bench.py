@@ -7,9 +7,12 @@ jitted train step (forward + backward + momentum update + weight decay +
 EMA) on the REFERENCE-SCALE network: Grasping44 (16 convs + BN, named
 grasp-param blocks, /root/reference/research/qtopt/networks.py:299-615)
 at 472x472x3 bfloat16 images. The per-chip config is auto-tuned: the
-bench measures batch 64, keeps doubling the batch while throughput
-improves (cap 512), then probes rematerialization and the
-space-to-depth stem at the winning batch. The config actually used
+bench measures batch 64, then doubles the batch to the 512 cap
+unconditionally keeping the best (round 5 showed a slow compiler
+VALLEY at b80-b128 with the fast regime returning at b256 — stopping
+at the first regression forfeits the winner), then probes
+rematerialization and the space-to-depth stem at the winning batch.
+The config actually used
 lands in the JSON ("batch_size", "remat", "space_to_depth");
 "value_batch64" keeps the fixed-batch non-remat number for
 round-over-round comparison.
@@ -91,26 +94,47 @@ def probe_main(cfg: dict) -> dict:
   batch_size = cfg["batch_size"]
   remat = cfg.get("remat", False)
   s2d = cfg.get("s2d", False)
+  # loop_steps > 1 measures the on-device K-step scan loop
+  # (train_step.make_train_loop — the TPUEstimator iterations_per_loop
+  # equivalent): K REAL train steps on K distinct pre-staged batches per
+  # host dispatch, dividing the per-dispatch transport overhead by K.
+  loop_steps = int(cfg.get("loop_steps", 1) or 1)
   measure_steps = MEASURE_STEPS if on_tpu else 5
 
   model = flagship.make_flagship_model(device.platform, remat=remat,
                                        space_to_depth=s2d)
-  features = specs_lib.make_random_numpy(
-      model.preprocessor.get_out_feature_specification(modes.TRAIN),
-      batch_size=batch_size, seed=0)
-  labels = specs_lib.make_random_numpy(
-      model.preprocessor.get_out_label_specification(modes.TRAIN),
-      batch_size=batch_size, seed=1)
-  features = jax.device_put(features, device)
-  labels = jax.device_put(labels, device)
-  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
+  import numpy as np
+
+  def _batches(spec, seed0, n):
+    outs = [specs_lib.make_random_numpy(spec, batch_size=batch_size,
+                                        seed=seed0 + i) for i in range(n)]
+    if n == 1:
+      return outs[0]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *outs)
+
+  feature_spec = model.preprocessor.get_out_feature_specification(
+      modes.TRAIN)
+  label_spec = model.preprocessor.get_out_label_specification(modes.TRAIN)
+  host_features = _batches(feature_spec, 0, loop_steps)
+  # Init consumes ONE batch; slice it on the host — indexing the
+  # device-resident stack would pay an eager per-leaf tunnel round trip
+  # (~1.5 s each, CLAUDE.md) for data numpy already holds.
+  init_features = (host_features if loop_steps == 1 else
+                   jax.tree_util.tree_map(lambda x: x[0], host_features))
+  features = jax.device_put(host_features, device)
+  labels = jax.device_put(_batches(label_spec, 100, loop_steps), device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                   init_features)
   # AOT-compile once: the executable is both the timed step and the
   # source of the XLA cost analysis (flops + bytes per step) — no
   # second trace/compile over the tunnel. The bench must emit its
   # number even when the backend lacks AOT/cost support, so both are
   # best-effort with the plain jitted step as fallback.
   flops = bytes_accessed = float("nan")
-  step = ts.make_train_step(model)
+  if loop_steps > 1:
+    step = ts.make_train_loop(model, loop_steps)
+  else:
+    step = ts.make_train_step(model)
   try:
     step = step.lower(state, features, labels).compile()
     cost = step.cost_analysis()
@@ -141,14 +165,22 @@ def probe_main(cfg: dict) -> dict:
   # allocation/defrag; the b128 cliff probe read 449 ms/step plain-
   # mean) land in the first half, and a large half-to-half gap is
   # recorded as its own diagnostic ("first_half_sec").
+  # In loop mode each dispatch runs K steps; shrink the dispatch count
+  # to keep probe wall-time comparable and divide per-dispatch results
+  # back to per-step for apples-to-apples records.
+  iters = (measure_steps if loop_steps == 1
+           else max(4, measure_steps // loop_steps))
   runs = []
   for _ in range(cfg.get("reruns", 1)):
     h1, h2, state = backend_lib.time_train_steps_halves(
-        step, state, features, labels, iters=measure_steps,
+        step, state, features, labels, iters=iters,
         warmup=WARMUP_STEPS)
     runs.append((h2, h1))
   sec, first_half = sorted(runs)[len(runs) // 2]
-  print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} -> "
+  sec /= loop_steps
+  first_half /= loop_steps
+  print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} "
+        f"loop={loop_steps} -> "
         f"{batch_size / sec:.1f} ex/s ({sec * 1e3:.1f} ms/step steady; "
         f"first half {first_half * 1e3:.1f} ms/step)",
         file=sys.stderr)
@@ -157,12 +189,17 @@ def probe_main(cfg: dict) -> dict:
       "examples_per_sec": batch_size / sec,
       "step_sec": sec,
       "first_half_sec": first_half,
+      # XLA cost analysis prices a lax.scan BODY once (trip count is not
+      # multiplied in) — measured: the K=8 loop executable reports the
+      # same flops as the single-step one — so loop-mode cost fields are
+      # already per-step.
       "flops": None if math.isnan(flops) else flops,
       "bytes_accessed": (None if math.isnan(bytes_accessed)
                          else bytes_accessed),
       "device_kind": device.device_kind,
       "platform": device.platform,
       "batch_size": batch_size,
+      "loop_steps": loop_steps,
   }
 
 
@@ -184,6 +221,7 @@ def _probe_child_entry(cfg_json: str, out_path: str) -> None:
 
 def _subprocess_probe(batch_size: int, remat: bool = False,
                       s2d: bool = False,
+                      loop_steps: int = 1,
                       deadline: float = PROBE_DEADLINE_SEC,
                       extra_env: dict | None = None) -> dict:
   """Runs one TPU probe in a fresh subprocess; never signals it.
@@ -198,7 +236,7 @@ def _subprocess_probe(batch_size: int, remat: bool = False,
   to vary it per probe.
   """
   cfg = {"platform": "tpu", "batch_size": batch_size, "remat": remat,
-         "s2d": s2d}
+         "s2d": s2d, "loop_steps": loop_steps}
   fd, out_path = tempfile.mkstemp(prefix="bench_probe_", suffix=".json")
   os.close(fd)
   os.unlink(out_path)  # child creates it atomically
@@ -242,9 +280,11 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
   {"timeout": True}). Returns the winning record extended with
   {"batch_size", "remat", "s2d", "value_batch64", "aborted"}; None when
   the very first probe yields no usable number (caller falls back).
-  Policy (unchanged from rounds 2-4, now timeout-aware):
+  Policy (round 5: doubling no longer stops at a regression — the chip
+  showed a slow VALLEY at b80-b128 with the fast regime returning at
+  b256, so stopping at the first cliff forfeits the winner):
     - OOM at the initial batch halves it (floor 4);
-    - batch doubles while throughput improves (cap `batch_cap`);
+    - batch doubles to `batch_cap` unconditionally, keeping the best;
     - remat, then space-to-depth, probed at the winning batch;
     - ANY timeout abandons all remaining probes (the tunnel is suspect
       and each further probe would hang the full deadline) but keeps
@@ -272,8 +312,10 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
                              if batch == BATCH_SIZE else None),
               aborted=False)
 
+  last_error = None
+
   def try_probe(b, remat, s2d, what):
-    nonlocal best
+    nonlocal best, last_error
     if best["aborted"]:
       return None
     r = probe(b, remat, s2d)
@@ -281,34 +323,31 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
       best["aborted"] = True
       return None
     if not r.get("ok"):
-      print(f"bench: {what} probe failed ({r.get('error')}); "
+      last_error = r.get("error", "")
+      print(f"bench: {what} probe failed ({last_error}); "
             f"keeping the current best", file=sys.stderr)
       return None
+    last_error = None
     return r
 
   # The step is HBM-bandwidth-bound (PERFORMANCE.md roofline) and the
   # optimizer/EMA traffic is per-STEP: larger batches amortize it per
-  # example. Keep doubling while throughput improves (cap bounds the
-  # window time); any failure keeps the last good number.
+  # example. Round-5 on-chip fact: throughput is NOT unimodal in batch —
+  # b80/b96/b128 fall into a flat ~10-27x-slow compiler valley while
+  # b256 lands back in the fast regime at 1.76x the b64 number (the AOT
+  # lever matrix's predicted knee). So the doubling probe runs to the
+  # cap unconditionally, tracking the best seen; OOM stops it (larger
+  # batches only OOM harder).
   if batch == initial_batch:
     probe_batch = 2 * batch
     while probe_batch <= batch_cap:
       r = try_probe(probe_batch, False, False, f"batch-{probe_batch}")
-      if r is None or r["examples_per_sec"] <= best["examples_per_sec"]:
-        # Round-5 on-chip fact: doubling can fall off a CLIFF, not a
-        # slope (b128 measured 5x slower than b64 against a 2x-better
-        # compiler ceiling). When the doubled batch lost >20%, the
-        # winner-batch..cliff midpoint may keep the winner's regime
-        # while amortizing more per-step traffic — one extra probe.
-        if (r is not None
-            and r["examples_per_sec"] < 0.8 * best["examples_per_sec"]):
-          mid = best["batch_size"] * 3 // 2
-          m = try_probe(mid, False, False, f"batch-{mid} midpoint")
-          if (m is not None
-              and m["examples_per_sec"] > best["examples_per_sec"]):
-            best.update(m, batch_size=mid)
+      if best["aborted"]:
         break
-      best.update(r, batch_size=probe_batch)
+      if r is None and "RESOURCE_EXHAUSTED" in (last_error or ""):
+        break
+      if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
+        best.update(r, batch_size=probe_batch)
       probe_batch *= 2
   # Rematerialization probe at the winning batch. The local v5e AOT
   # lever matrix (PERFORMANCE.md round 4) predicts remat HURTS here
@@ -343,9 +382,19 @@ def _ab_local_compile(batch_size: int) -> None:
     sys.exit(2)
   rec = _subprocess_probe(
       batch_size, extra_env={"PALLAS_AXON_REMOTE_COMPILE": "0"})
+  if "libtpu version mismatch" in rec.get("error", ""):
+    # Round-5 measured fact: the terminal runs an OLDER libtpu build
+    # than the image (Nov 2025 vs Jan 2026), so locally-AOT-compiled
+    # executables are refused. That is a permanent property of this
+    # environment, not a transient failure — record it as the A/B's
+    # answer (exit 0) so the window plan does not retry forever.
+    print(json.dumps({"compile_mode": "local", "supported": False,
+                      "reason": "libtpu version mismatch between image "
+                                "and terminal", "error": rec["error"]}))
+    return
   if rec.get("timeout") or not rec.get("ok"):
     print(f"local-compile A/B probe failed: {rec}", file=sys.stderr)
-    sys.exit(2)
+    sys.exit(1)  # item failed (retry next window) — NOT tunnel-down
   print(json.dumps(dict(rec, compile_mode="local")))
 
 

@@ -113,6 +113,14 @@ run -t 7200 python bench.py
 #     default probe mode. Self-gating (health probe + deadline +
 #     exit 2), like every other plan item.
 run python bench.py --ab-local-compile 64
+# 1c. Dispatch-overhead A/B: the K-step on-device scan loop
+#     (train_step.make_train_loop, the TPUEstimator iterations_per_loop
+#     equivalent) vs single-step dispatch at the same batch. Equal
+#     per-step times = the async dispatch queue already hides transport
+#     latency (measured so at b64/b128 on 2026-07-31); a loop win here
+#     would mean per-dispatch overhead returned and train_eval should
+#     raise iterations_per_loop.
+run python bench.py --probe '{"platform":"tpu","batch_size":256,"loop_steps":8}' -
 # 2. Flash kernels on real hardware (round-1 weakness #2 close-out).
 run python scripts/tpu_flash_validate.py correctness
 run python scripts/tpu_flash_validate.py time 1024

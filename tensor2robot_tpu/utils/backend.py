@@ -158,7 +158,15 @@ def time_train_steps_halves(step, state, features, labels, iters,
   barrier_cost = time.perf_counter() - mid
 
   def _pure(window, n):
-    return (max(window - barrier_cost, 0.0) or window) / n
+    # Fall back to the un-subtracted window when the estimated barrier
+    # cost swallows (nearly) all of it: a noisy barrier estimate close
+    # to a short half-window would otherwise leave a near-zero residual
+    # and report an absurdly small step time — and autotune keeps the
+    # MAX examples/sec, so one such probe would become the headline.
+    residual = window - barrier_cost
+    if residual < 0.2 * window:
+      return window / n
+    return residual / n
 
   sec_h1 = _pure(mid - start, n1)
   if n2 == 0:

@@ -93,11 +93,12 @@ class FakeProbe:
             "batch_size": batch}
 
 
-def test_doubling_stops_on_regression_and_probes_remat_s2d_at_winner():
+def test_doubling_runs_to_cap_and_probes_remat_s2d_at_winner():
   probe = FakeProbe({
       (64, False, False): 1000.0,
       (128, False, False): 1500.0,
-      (256, False, False): 1200.0,   # regression: stop doubling
+      (256, False, False): 1200.0,   # regression: doubling continues
+      (512, False, False): 1100.0,
       (128, True, False): 1400.0,    # remat loses
       (128, False, True): 1600.0,    # s2d wins
   })
@@ -115,6 +116,8 @@ def test_remat_win_carries_into_s2d_probe():
   probe = FakeProbe({
       (64, False, False): 1000.0,
       (128, False, False): 900.0,
+      (256, False, False): 800.0,
+      (512, False, False): 700.0,
       (64, True, False): 1100.0,
       (64, True, True): 1050.0,
   })
@@ -163,49 +166,49 @@ def test_oom_halves_initial_batch_and_skips_doubling():
   assert all(b <= 64 for b, _, _ in probe.calls)
 
 
-def test_cliff_regression_probes_the_midpoint_batch():
+def test_doubling_crosses_a_cliff_valley_to_the_far_winner():
+  """The round-5 on-chip shape: b128 falls into a ~5x-slow compiler
+  valley but b256 returns to the fast regime ABOVE the b64 number.
+  Stopping at the first regression would forfeit the real winner."""
   probe = FakeProbe({
       (64, False, False): 1478.0,
-      (128, False, False): 285.0,    # >20% cliff -> midpoint probed
-      (96, False, False): 1650.0,    # midpoint wins
-      (96, True, False): 1000.0,
-      (96, False, True): 1200.0,
+      (128, False, False): 285.0,    # valley
+      (256, False, False): 2480.0,   # fast regime returns — the winner
+      (512, False, False): 2000.0,
+      (256, True, False): 1000.0,
+      (256, False, True): 1200.0,
   })
   best = bench.autotune(probe)
-  assert best["batch_size"] == 96
-  assert best["examples_per_sec"] == 1650.0
+  assert best["batch_size"] == 256
+  assert best["examples_per_sec"] == 2480.0
   assert best["value_batch64"] == 1478.0
 
 
-def test_mild_regression_skips_the_midpoint_probe():
-  probe = FakeProbe({
-      (64, False, False): 1000.0,
-      (128, False, False): 950.0,    # <20% loss: plateau, no midpoint
-      (64, True, False): 900.0,
-      (64, False, True): 900.0,
-  })
-  best = bench.autotune(probe)
-  assert best["batch_size"] == 64
-  assert (96, False, False) not in probe.calls
-
-
-def test_midpoint_loss_keeps_the_doubling_winner():
+def test_oom_mid_doubling_stops_larger_probes():
+  """RESOURCE_EXHAUSTED at a doubled batch ends the doubling (larger
+  batches only OOM harder — measured: b512 OOMs where b256 wins) but
+  remat/s2d still probe at the winner."""
   probe = FakeProbe({
       (64, False, False): 1478.0,
       (128, False, False): 285.0,
-      (96, False, False): 1400.0,    # midpoint loses -> keep 64
-      (64, True, False): 1000.0,
-      (64, False, True): 1000.0,
+      (256, False, False): 2480.0,
+      (512, False, False): "oom",
+      (256, True, False): 1000.0,
+      (256, False, True): 1200.0,
   })
   best = bench.autotune(probe)
-  assert best["batch_size"] == 64
-  assert best["examples_per_sec"] == 1478.0
+  assert best["batch_size"] == 256
+  assert best["examples_per_sec"] == 2480.0
+  assert not best["aborted"]
+  assert (1024, False, False) not in probe.calls
 
 
 def test_probe_failure_mid_tune_keeps_best_without_abort():
   probe = FakeProbe({
       (64, False, False): 1000.0,
       (128, False, False): "error",
+      (256, False, False): "error",
+      (512, False, False): "error",
       (64, True, False): "error",
       (64, False, True): "error",
   })
