@@ -34,11 +34,14 @@ _SHRINK = [
     "DefaultRandomInputGenerator.batch_size = 2",
     "train_eval_model.mesh_shape = (1, 1, 1)",
 ]
+# Shared by the parity and tuned-throughput QT-Opt configs (same model).
+_QTOPT_SHRINK = ["QTOptModel.image_size = 108",
+                 "QTOptModel.num_convs = (2, 2, 1)",
+                 "QTOptModel.device_type = 'cpu'",
+                 "QTOptModel.use_bfloat16 = False"]
 _EXTRA = {
-    "train_qtopt.gin": ["QTOptModel.image_size = 108",
-                        "QTOptModel.num_convs = (2, 2, 1)",
-                        "QTOptModel.device_type = 'cpu'",
-                        "QTOptModel.use_bfloat16 = False"],
+    "train_qtopt.gin": _QTOPT_SHRINK,
+    "train_qtopt_tpu_tuned.gin": _QTOPT_SHRINK,
     "train_bcz.gin": ["BCZModel.image_size = 32",
                       "BCZModel.network = 'spatial_softmax'",
                       "BCZModel.num_waypoints = 3",
