@@ -190,7 +190,7 @@ def run(steps: int = 30) -> None:
   start = time.perf_counter()
   for _ in range(steps):
     batch = next(dataset)
-    f, l = mesh_lib.put_host_batch(mesh, batch)
+    f, l = mesh_lib.place_batch(mesh, batch)
     state, _ = step(state, f, l)
   barrier(state)
   serial = steps * BATCH_SIZE / (time.perf_counter() - start)

@@ -1,0 +1,85 @@
+"""On-chip flash-attention block-size duel at the shipped shape.
+
+The round-5 window measured the Mosaic kernel SLOWER than plain XLA
+attention in full-step wall-clock (T=4096: 27.7 vs 23.3 ms/step;
+T=8192: 86.0 vs 72.8) while moving ~10x fewer bytes at ~7% HBM util —
+stall-bound, not bandwidth-bound. Suspect: the default 128x128 blocks
+(tiny MXU matmuls, VPU-softmax dominated). This probe times the raw
+kernel fwd and fwd+bwd across block combinations on the real chip and
+prints the winner vs the XLA reference attention at the same shape.
+
+Usage (healthy tunnel, cwd=/root/repo):
+  python scripts/tpu_flash_tune.py [T]        # default 4096
+Tunnel rules apply (no shell timeout, no signals — PERFORMANCE.md).
+"""
+import sys
+
+sys.path.insert(0, ".")  # run from the repo root
+
+from tensor2robot_tpu.utils import backend  # noqa: E402
+
+
+def timed(fn, *args, iters=30):
+  """Shared fetch-cancel micro-op timer (see backend.time_op)."""
+  return backend.time_op(fn, *args, iters=iters)
+
+
+def main():
+  if not backend.accelerator_healthy(timeout=90):
+    print("tunnel unhealthy; refusing to run (would hang)", flush=True)
+    sys.exit(2)
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+
+  from tensor2robot_tpu.ops.attention import attention, flash_attention
+
+  t = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+  b, h, d = 2, 8, 64  # the shipped train_longcontext_flash.gin shape
+  rng = np.random.default_rng(0)
+  mk = lambda: jax.device_put(
+      rng.standard_normal((b, h, t, d), dtype=np.float32).astype(
+          jnp.bfloat16))
+  q, k, v = mk(), mk(), mk()
+
+  def fwd_bwd(fn):
+    def loss(q, k, v):
+      return fn(q, k, v).astype(jnp.float32).sum()
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    return lambda q, k, v: g(q, k, v)[0]
+
+  ref_fwd = jax.jit(lambda q, k, v: attention(q, k, v, causal=True))
+  ms = timed(ref_fwd, q, k, v) * 1e3
+  print(f"T={t} xla fwd: {ms:.2f} ms", flush=True)
+  ms_ref_fb = timed(fwd_bwd(lambda q, k, v: attention(q, k, v, causal=True)),
+                    q, k, v) * 1e3
+  print(f"T={t} xla fwd+bwd: {ms_ref_fb:.2f} ms", flush=True)
+
+  combos = [(128, 128), (256, 256), (512, 512), (256, 512), (512, 1024),
+            (1024, 1024)]
+  best = None
+  for bq, bk in combos:
+    if bq > t or bk > t:
+      continue
+    try:
+      f = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+          q, k, v, causal=True, block_q=bq, block_k=bk, interpret=False))
+      ms_f = timed(f, q, k, v) * 1e3
+      fb = fwd_bwd(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+          q, k, v, causal=True, block_q=bq, block_k=bk, interpret=False))
+      ms_fb = timed(fb, q, k, v) * 1e3
+      print(f"T={t} flash bq={bq} bk={bk}: fwd={ms_f:.2f} ms "
+            f"fwd+bwd={ms_fb:.2f} ms", flush=True)
+      if best is None or ms_fb < best[0]:
+        best = (ms_fb, bq, bk)
+    except Exception as e:  # compile failure at a combo is itself data
+      print(f"T={t} flash bq={bq} bk={bk}: FAILED {type(e).__name__}: {e}",
+            flush=True)
+  if best:
+    print(f"T={t} WINNER flash bq={best[1]} bk={best[2]}: {best[0]:.2f} ms "
+          f"fwd+bwd vs xla {ms_ref_fb:.2f} ms "
+          f"({ms_ref_fb / best[0]:.2f}x)", flush=True)
+
+
+if __name__ == "__main__":
+  main()

@@ -20,7 +20,6 @@ All timings use utils/backend.sync (host fetch) as the barrier — see the
 backend.sync docstring for why block_until_ready is not reliable here.
 """
 import sys
-import time
 
 sys.path.insert(0, ".")  # run from the repo root
 
@@ -28,30 +27,8 @@ from tensor2robot_tpu.utils import backend  # noqa: E402 (before jax use)
 
 
 def timed(fn, *args, iters=10):
-  """Per-iter wall time with the host-fetch barrier cost cancelled.
-
-  The tunnel has no cheap barrier: the only reliable one is a host fetch,
-  which costs real time that would otherwise be amortized into the
-  measurement. Time (1 iter + fetch) and (iters + fetch) and difference
-  them, so the fetch (and any fixed dispatch overhead) cancels.
-  """
-  if iters < 2:
-    raise ValueError("iters must be >= 2 (the fetch-cancel difference "
-                     "needs two run lengths)")
-  out = fn(*args)          # warmup / compile
-  backend.sync(out)
-
-  def run(n):
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n):
-      out = fn(*args)
-    backend.sync(out)
-    return time.perf_counter() - t0
-
-  t1 = run(1)
-  tn = run(iters)
-  return (tn - t1) / (iters - 1)
+  """Shared fetch-cancel micro-op timer (see backend.time_op)."""
+  return backend.time_op(fn, *args, iters=iters)
 
 
 def _qkv(shape, dtype, seed):
@@ -107,23 +84,37 @@ def time_at(t):
   h, d = 8, 64
   q, k, v = _qkv((b, h, t, d), jnp.bfloat16, t)
 
+  # Sub-ms kernels need a long loop leg: the fetch-cancel difference is
+  # noise-dominated otherwise (negative ms in the round-5 capture).
+  iters = 50 if t <= 4096 else 10
   f_flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, interpret=False))
-  ms_flash = timed(f_flash, q, k, v) * 1e3
+  ms_flash = timed(f_flash, q, k, v, iters=iters) * 1e3
   print(f"T={t} B={b}: flash_fwd={ms_flash:.2f} ms", flush=True)
 
-  def loss(q, k, v):
-    return flash_attention(q, k, v, interpret=False).astype(jnp.float32).sum()
-  f_grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-  ms_flash_bwd = timed(lambda q, k, v: f_grad(q, k, v)[0], q, k, v) * 1e3
-  print(f"T={t} B={b}: flash_fwd+bwd={ms_flash_bwd:.2f} ms", flush=True)
+  try:
+    def loss(q, k, v):
+      return flash_attention(q, k, v,
+                             interpret=False).astype(jnp.float32).sum()
+    f_grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    ms_flash_bwd = timed(lambda q, k, v: f_grad(q, k, v)[0], q, k, v,
+                         iters=iters) * 1e3
+    print(f"T={t} B={b}: flash_fwd+bwd={ms_flash_bwd:.2f} ms", flush=True)
+  except Exception as e:
+    # Round-5 captured fact: the T=16384 bwd dies in the terminal's
+    # REMOTE compiler (HTTP 500 from tpu_compile_helper — the
+    # scoped-VMEM ceiling the local compiler also needs a flag for).
+    # Record and continue: fwd + the XLA comparison are still captures.
+    print(f"T={t}: flash bwd failed: {type(e).__name__}: {e}", flush=True)
 
   try:
     f_ref = jax.jit(lambda q, k, v: attention(q, k, v))
     ms_ref = timed(f_ref, q, k, v) * 1e3
-    print(f"T={t} B={b}: xla_fwd={ms_ref:.2f} ms "
-          f"(flash speedup {ms_ref / ms_flash:.2f}x)", flush=True)
   except Exception as e:  # OOM at long T is expected
     print(f"T={t}: XLA reference failed: {type(e).__name__}", flush=True)
+    return
+  speedup = (f"(flash speedup {ms_ref / ms_flash:.2f}x)" if ms_flash > 0
+             else "(flash below measurement floor)")
+  print(f"T={t} B={b}: xla_fwd={ms_ref:.2f} ms {speedup}", flush=True)
 
 
 def main():

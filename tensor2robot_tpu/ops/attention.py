@@ -354,9 +354,29 @@ def _pow2_floor(n: int) -> int:
 _MIN_BLOCK = 8
 
 
+def _default_blocks(t: int) -> Tuple[int, int]:
+  """Measured-winner block sizes (v5e, 2026-07-31 on-chip duel,
+  scripts/tpu_flash_tune.py): the original 128x128 default LOSES to
+  plain XLA attention in fwd+bwd wall-clock (T=4096: 9.59 vs 7.00 ms;
+  T=8192: 40.25 vs 28.20) — tiny matmuls leave the MXU idle and
+  VPU-softmax dominates. Tuned blocks flip it decisively:
+  T=4096 bq=bk=1024 -> 2.98 ms (2.35x over XLA); T=8192 bq=256 bk=512
+  -> 14.28 ms (1.97x). VMEM ceilings bound the blocks: BLOCK_Q >= 512
+  at T > 4096 dies in compile (bwd block temporaries exceed the 16 MB
+  scoped-VMEM stack; block_k=512 with bq=256 is fine and is the T=8192
+  winner), and 1024x1024 at T=4096, which fits standalone, overflows
+  by 312 KB inside the full train-step graph — so the T<=4096 default
+  stays one notch safer (512x512 = 3.61 ms standalone, still 1.94x
+  over XLA)."""
+  if t <= 4096:
+    return (512, 512)
+  return (256, 512)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     causal: bool = False,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None) -> jnp.ndarray:
   """Pallas flash attention, [B, H, T, D]. Fully differentiable
   (custom FlashAttention-2 backward kernels).
@@ -366,9 +386,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
   auto-selects PER LOWERING PLATFORM: real kernels in TPU-target
   programs, the interpreter elsewhere (CPU tests). Cross-attention
   (Tq != Tk) falls back to the reference implementation (the kernels
-  assume self-attention layout).
+  assume self-attention layout). `block_q`/`block_k` default to the
+  on-chip measured winners for the sequence length (`_default_blocks`).
   """
   b, h, t, d = q.shape
+  if block_q is None or block_k is None:
+    auto_bq, auto_bk = _default_blocks(t)
+    block_q = auto_bq if block_q is None else block_q
+    block_k = auto_bk if block_k is None else block_k
   if not _HAS_PALLAS:
     return attention(q, k, v, causal=causal)
   if k.shape[2] != t:

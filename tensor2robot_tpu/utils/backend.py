@@ -99,6 +99,42 @@ def state_barrier(state):
                   key=lambda a: a.size))
 
 
+def time_op(fn, *args, iters: int = 30):
+  """Per-iter wall time of a (jitted) op with the host-fetch barrier
+  cost cancelled — the ONE shared micro-op timer for the tunnel scripts
+  (flash validate/tune), so the measurement methodology cannot drift
+  between scripts whose numbers are compared against each other.
+
+  The tunnel has no cheap barrier: the only reliable one is a host
+  fetch (see ``sync``), which costs real time. Time (1 iter + fetch)
+  and (iters + fetch) and difference them so the fetch and any fixed
+  dispatch overhead cancel. The 1-iter leg is the median of 3 — it is
+  ~pure fetch cost for sub-ms kernels and one noisy fetch makes the
+  difference negative (observed live: "flash_fwd=-0.30 ms" in the
+  round-5 window). A clamped-to-zero result means noise swamped the
+  kernel: report it as below the measurement floor, don't divide by it.
+  """
+  import time as _time
+
+  if iters < 2:
+    raise ValueError("iters must be >= 2 (the fetch-cancel difference "
+                     "needs two run lengths)")
+  out = fn(*args)  # warmup / compile
+  sync(out)
+
+  def run(n):
+    t0 = _time.perf_counter()
+    o = None
+    for _ in range(n):
+      o = fn(*args)
+    sync(o)
+    return _time.perf_counter() - t0
+
+  t1 = sorted(run(1) for _ in range(3))[1]
+  tn = run(iters)
+  return max(tn - t1, 0.0) / (iters - 1)
+
+
 def time_train_steps(step, state, features, labels, iters,
                      warmup: int = 3):
   """Times ``step(state, features, labels)`` with the tunnel-safe

@@ -374,7 +374,10 @@ class TestAOTCostPins:
   with the rationale in PERFORMANCE.md — the failure message prints the
   new record to make that a copy-paste."""
 
-  @pytest.mark.parametrize("batch", [64, 128])
+  # 256 is the SHIPPED batch (train_qtopt_tpu_tuned.gin): the chip
+  # measured 6.441 TF / 39.63 GB per step at b256 on 2026-07-31 —
+  # within 0.5% of this pin, so a pin breach is a real program change.
+  @pytest.mark.parametrize("batch", [64, 128, 256])
   def test_flagship_cost_within_10pct_of_committed(self, batch):
     scripts_dir = os.path.join(_REPO_ROOT, "scripts")
     if scripts_dir not in sys.path:
