@@ -54,8 +54,16 @@ FAMILIES = [
 ]
 
 
-def measure_family(name, config_file, overrides, on_tpu, steps):
+def measure_family(name, config_file, overrides, on_tpu, steps,
+                   loop_k: int = 1):
+  """`loop_k > 1` times the on-device K-step scan loop
+  (train_step.make_train_loop) instead of single-step dispatch: the
+  round-5 window measured small families flat at ~8 ms/step — the
+  tunnel's per-DISPATCH floor, not the chip (the same models step in
+  2-4 ms on a bare CPU core). K steps per dispatch divides that floor
+  by K; this mode prices the win per family."""
   import jax
+  import numpy as np
 
   from tensor2robot_tpu import modes, specs as specs_lib
   from tensor2robot_tpu.parallel import train_step as ts
@@ -69,23 +77,40 @@ def measure_family(name, config_file, overrides, on_tpu, steps):
   batch_size = int(config.query_parameter(
       "DefaultRandomInputGenerator.batch_size"))
   device = jax.devices()[0]
-  features = specs_lib.make_random_numpy(
-      model.preprocessor.get_out_feature_specification(modes.TRAIN),
-      batch_size=batch_size, seed=0)
-  labels = specs_lib.make_random_numpy(
-      model.preprocessor.get_out_label_specification(modes.TRAIN),
-      batch_size=batch_size, seed=1)
-  features = jax.device_put(features, device)
-  labels = jax.device_put(labels, device)
-  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0), features)
-  step = ts.make_train_step(model)
+
+  def batches(spec, seed0):
+    outs = [specs_lib.make_random_numpy(spec, batch_size=batch_size,
+                                        seed=seed0 + i)
+            for i in range(loop_k)]
+    if loop_k == 1:
+      return outs[0]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *outs)
+
+  feature_spec = model.preprocessor.get_out_feature_specification(
+      modes.TRAIN)
+  label_spec = model.preprocessor.get_out_label_specification(modes.TRAIN)
+  host_features = batches(feature_spec, 0)
+  init_features = (host_features if loop_k == 1 else
+                   jax.tree_util.tree_map(lambda x: x[0], host_features))
+  features = jax.device_put(host_features, device)
+  labels = jax.device_put(batches(label_spec, 100), device)
+  state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                   init_features)
+  if loop_k > 1:
+    step = ts.make_train_loop(model, loop_k)
+    iters = max(2, steps // loop_k)
+  else:
+    step = ts.make_train_step(model)
+    iters = steps
   sec, _ = backend.time_train_steps(step, state, features, labels,
-                                    iters=steps, warmup=2)
+                                    iters=iters, warmup=2)
+  sec /= loop_k
   print(json.dumps({
       "family": name,
       "config": config_file,
       "device": device.device_kind if on_tpu else "cpu_smoke_f32",
       "batch_size": batch_size,
+      "loop_steps": loop_k,
       "ms_per_step": round(sec * 1e3, 2),
       "steps_per_sec": round(1.0 / sec, 2),
       "examples_per_sec": round(batch_size / sec, 2),
@@ -94,7 +119,18 @@ def measure_family(name, config_file, overrides, on_tpu, steps):
 
 def main():
   mode = sys.argv[1] if len(sys.argv) > 1 else "cpu"
-  only = sys.argv[2] if len(sys.argv) > 2 else None
+  # Optional "loopK" token (e.g. "loop32") anywhere after the mode
+  # measures the K-step on-device scan loop instead of single-step
+  # dispatch; works with or without a family ("tpu loop32" = all
+  # families at K steps/dispatch).
+  loop_k = 1
+  rest = []
+  for arg in sys.argv[2:]:
+    if arg.startswith("loop"):
+      loop_k = int(arg[4:] or "32")
+    else:
+      rest.append(arg)
+  only = rest[0] if rest else None
   families = [f for f in FAMILIES if only is None or f[0] == only]
   if not families:
     raise SystemExit(f"unknown family {only!r}; "
@@ -112,16 +148,17 @@ def main():
 
       for family in FAMILIES:
         rc = subprocess.call(
-            [sys.executable, __file__, "tpu", family[0]])
+            [sys.executable, __file__, "tpu", family[0]]
+            + ([f"loop{loop_k}"] if loop_k > 1 else []))
         if rc == 2:
           sys.exit(2)
       return
-    on_tpu, steps = True, 20
+    on_tpu, steps = True, 20 if loop_k == 1 else 4 * loop_k
   else:
     backend.pin_cpu()
     on_tpu, steps = False, 5
   for name, config_file, overrides in families:
-    measure_family(name, config_file, overrides, on_tpu, steps)
+    measure_family(name, config_file, overrides, on_tpu, steps, loop_k)
 
 
 if __name__ == "__main__":

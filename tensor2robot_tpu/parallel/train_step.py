@@ -33,8 +33,8 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
-           "make_train_loop", "make_eval_step", "make_predict_fn",
-           "fsdp_rules", "state_shardings"]
+           "make_train_loop", "loop_batch_spec", "make_eval_step",
+           "make_predict_fn", "fsdp_rules", "state_shardings"]
 
 PartitionRules = Sequence[Tuple[str, PartitionSpec]]
 
@@ -302,6 +302,17 @@ def make_train_step(model,
       donate_argnums=(0,) if donate else ())
 
 
+def loop_batch_spec(batch_spec: Optional[PartitionSpec] = None,
+                    batch_axis: str = "data") -> PartitionSpec:
+  """The PartitionSpec for a staged [K, B, ...] loop batch: the per-step
+  batch sharding with the scan axis unsharded. The ONE derivation shared
+  by `make_train_loop`'s in_shardings and the trainer's `place_batch`
+  call, so placement can never silently desync from the jit's committed
+  shardings."""
+  return PartitionSpec(None, *(batch_spec if batch_spec is not None
+                               else PartitionSpec(batch_axis)))
+
+
 def make_train_loop(model,
                     num_steps: int,
                     mesh: Optional[Mesh] = None,
@@ -339,10 +350,7 @@ def make_train_loop(model,
 
   if mesh is None:
     return jax.jit(loop_fn, donate_argnums=(0,) if donate else ())
-  spec = batch_spec or PartitionSpec(batch_axis)
-  # The staged [K, B, ...] batches shard like the per-step batches with
-  # the scan axis unsharded.
-  loop_ns = NamedSharding(mesh, PartitionSpec(None, *spec))
+  loop_ns = NamedSharding(mesh, loop_batch_spec(batch_spec, batch_axis))
   replicated_ns = NamedSharding(mesh, PartitionSpec())
   return jax.jit(
       loop_fn,

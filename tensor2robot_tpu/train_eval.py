@@ -28,6 +28,7 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 import jax
 import numpy as np
 from absl import logging
+from jax.sharding import PartitionSpec
 
 from tensor2robot_tpu import checkpoints as checkpoints_lib
 from tensor2robot_tpu import modes as modes_lib
@@ -149,8 +150,21 @@ def train_eval_model(
     use_ema_for_eval: bool = True,
     log_every_n_steps: int = 100,
     device_prefetch_depth: int = 2,
+    iterations_per_loop: int = 1,
 ) -> dict:
-  """Runs the requested mode; returns final metrics."""
+  """Runs the requested mode; returns final metrics.
+
+  `iterations_per_loop` > 1 dispatches K train steps per host round trip
+  via the on-device scan loop (`train_step.make_train_loop`) — the
+  reference's TPUEstimator `iterations_per_loop`. Round-5 measured the
+  per-dispatch floor at ~8 ms on the tunnel; K=32 takes the small
+  driver families from ~8 ms/step to 1.1-1.8 ms/step (5-7x throughput).
+  Semantics: identical math to K single steps on the same batch stream
+  (pinned by tests/test_train_loop.py and the train_eval equality
+  test); logging/checkpoint/eval cadences fire when a loop CROSSES a
+  multiple of their interval (TPUEstimator-style quantization to loop
+  boundaries), and per-step hook metrics are preserved (the loop
+  returns each inner step's scalars)."""
   if mode not in ("train", "evaluate", "train_and_evaluate",
                   "continuous_eval"):
     raise ValueError(f"Unknown train_eval mode {mode!r}")
@@ -310,6 +324,13 @@ def train_eval_model(
   # -- training loop --------------------------------------------------------
   train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
                                   batch_spec=batch_spec)
+  loop_k = max(1, int(iterations_per_loop))
+  train_loop = loop_spec = None
+  if loop_k > 1:
+    train_loop = ts.make_train_loop(model, loop_k, mesh=mesh,
+                                    shardings=shardings,
+                                    batch_spec=batch_spec)
+    loop_spec = ts.loop_batch_spec(batch_spec)
   eval_step = None
   if mode == "train_and_evaluate":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
@@ -324,31 +345,95 @@ def train_eval_model(
   # serializes host work between dispatches (0 disables). Skipped when
   # resuming past max_train_steps (zero loop iterations).
   prefetcher = None
+
+  def _crossed(interval: int, prev: int, cur: int) -> bool:
+    """True when (prev, cur] contains a multiple of `interval` — the
+    loop-boundary cadence rule. For single-step dispatch (cur = prev+1)
+    this is exactly `cur % interval == 0`; for K-step dispatches the
+    event fires at the first boundary past the multiple (TPUEstimator
+    `iterations_per_loop` quantization)."""
+    return interval > 0 and (cur // interval) > (prev // interval)
+
+  def _stacked_group(stream, k):
+    """Stacks k consecutive host batches on a leading scan axis.
+    StopIteration propagates, matching the single-step path's contract
+    for exhausted finite train streams."""
+    group = [next(stream) for _ in range(k)]
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
+
+  use_loop_for = lambda remaining: (train_loop is not None
+                                    and remaining >= loop_k)
+
+  def _place_next(remaining, stream):
+    if use_loop_for(remaining):
+      return (mesh_lib.place_batch(mesh, _stacked_group(stream, loop_k),
+                                   batch_spec=loop_spec), loop_k)
+    return (mesh_lib.place_batch(mesh, next(stream), batch_spec=batch_spec),
+            1)
+
   try:
     if step < max_train_steps:
       # First placement BEFORE the worker starts: if it raises there is
       # no thread to leak; everything after is covered by the finally.
-      placed = _device_batch(mesh, first_batch, batch_spec)
-      if device_prefetch_depth:
-        prefetcher = mesh_lib.DevicePrefetcher(
-            train_dataset, mesh, batch_spec=batch_spec,
-            depth=device_prefetch_depth)
+      if use_loop_for(max_train_steps - step):
+        import itertools
+
+        # The init batch is step 1's data in the single-step path; the
+        # first loop group must start with it too.
+        train_dataset = itertools.chain([first_batch], train_dataset)
+        placed, placed_k = _place_next(max_train_steps - step,
+                                       train_dataset)
+      else:
+        placed = _device_batch(mesh, first_batch, batch_spec)
+        placed_k = 1
+        if device_prefetch_depth:
+          prefetcher = mesh_lib.DevicePrefetcher(
+              train_dataset, mesh, batch_spec=batch_spec,
+              depth=device_prefetch_depth)
+    last_log_step = step
     while step < max_train_steps:
       features, labels = placed
-      state, metrics = train_step(state, features, labels)
-      step += 1
-      for hook in hooks:
-        hook.after_step(ctx, step, metrics)
-      if step % log_every_n_steps == 0 or step == max_train_steps:
+      prev_step = step
+      if placed_k > 1:
+        state, stacked = train_loop(state, features, labels)
+      else:
+        state, metrics = train_step(state, features, labels)
+      step += placed_k
+      # Stage the NEXT batch/group while the device runs the (async)
+      # dispatch just issued — host parse/stack/place overlaps device
+      # compute instead of serializing after the metrics fetch below.
+      # (The single-step prefetcher path gets the same overlap from its
+      # worker thread.)
+      if step < max_train_steps:
+        if prefetcher is not None:
+          placed = next(prefetcher)
+          placed_k = 1
+        else:
+          placed, placed_k = _place_next(max_train_steps - step,
+                                         train_dataset)
+      if step - prev_step > 1:
+        # One host fetch for all K steps' scalars (vs one per step).
+        host = {k: np.asarray(v) for k, v in stacked.items()}
+        per_step = [{k: v[i] for k, v in host.items()}
+                    for i in range(step - prev_step)]
+      else:
+        per_step = [metrics]
+      for i, m in enumerate(per_step):
+        for hook in hooks:
+          hook.after_step(ctx, prev_step + i + 1, m)
+      metrics = per_step[-1]
+      if _crossed(log_every_n_steps, prev_step, step) \
+          or step == max_train_steps:
         scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
         writer.write_scalars(step, scalars)
         now = time.time()
         logging.info("step %d: loss=%.5f (%.1f steps/s)", step,
                      scalars.get("loss", float("nan")),
-                     log_every_n_steps / max(now - last_log, 1e-6))
+                     (step - last_log_step) / max(now - last_log, 1e-6))
         last_log = now
+        last_log_step = step
         final_metrics = scalars
-      if step % checkpoint_every_n_steps == 0:
+      if _crossed(checkpoint_every_n_steps, prev_step, step):
         _checkpoint(step)
       if manager.reached_preemption(step):
         logging.warning("Preemption signal at step %d: checkpoint + exit.",
@@ -356,8 +441,9 @@ def train_eval_model(
         _checkpoint(step, force=True)
         manager.wait_until_finished()
         raise SystemExit(42)
-      if eval_step is not None and (step % eval_every_n_steps == 0
-                                    or step == max_train_steps):
+      if eval_step is not None and (
+          _crossed(eval_every_n_steps, prev_step, step)
+          or step == max_train_steps):
         # Wall-clock throttle (reference eval_throttle default 600 s,
         # /root/reference/utils/train_eval.py:428-431): skip step-triggered
         # evals that come too soon after the previous one.
@@ -377,9 +463,6 @@ def train_eval_model(
           logging.info("eval @%d: %s", step, eval_metrics)
           final_metrics.update(
               {f"eval/{k}": v for k, v in eval_metrics.items()})
-      if step < max_train_steps:
-        placed = (next(prefetcher) if prefetcher is not None
-                  else _device_batch(mesh, next(train_dataset), batch_spec))
   finally:
     # Runs on SystemExit(42) preemption and any step/hook/eval failure
     # too: a daemon worker killed at interpreter shutdown mid device_put
