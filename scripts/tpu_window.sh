@@ -131,6 +131,13 @@ run python scripts/tpu_flash_validate.py time 16384
 #     (AOT_ANALYSIS_r05.json seqattn: flash ceiling 4.6x reference).
 run python scripts/tpu_seq_timing.py reference
 run python scripts/tpu_seq_timing.py flash
+# 2c. T=8192 pair + the block-size duels (round-5 additions: the tuned
+#     blocks flipped flash from a wall-clock loser to 1.7-2.3x; re-run
+#     each window so a kernel/regression shows up as a duel shift).
+run python scripts/tpu_seq_timing.py reference 8192
+run python scripts/tpu_seq_timing.py flash 8192
+run python scripts/tpu_flash_tune.py 4096
+run python scripts/tpu_flash_tune.py 8192
 # 3. Roofline after the bf16 fix + batch scaling + remat HBM lever.
 run python scripts/tpu_step_tuning.py roofline
 run python scripts/tpu_step_tuning.py batch 32
@@ -153,10 +160,23 @@ run python scripts/family_baselines.py tpu bcz_resnet_film
 run python scripts/family_baselines.py tpu grasp2vec
 run python scripts/family_baselines.py tpu vrgripper_mdn
 run python scripts/family_baselines.py tpu maml_pose_env
+# 5b. iterations_per_loop wins (round-5 addition): the K=32 on-device
+#     loop vs the ~8 ms per-dispatch floor, per family.
+run python scripts/family_baselines.py tpu pose_env loop32
+run python scripts/family_baselines.py tpu qtopt_grasping44 loop32
+run python scripts/family_baselines.py tpu bcz_resnet_film loop32
+run python scripts/family_baselines.py tpu grasp2vec loop32
+run python scripts/family_baselines.py tpu vrgripper_mdn loop32
+run python scripts/family_baselines.py tpu maml_pose_env loop32
 # 6. Serving-side: on-device CEM action rate at the reference cost
 #    (64x3, 10 elites) on the reference-scale critic.
 run python scripts/policy_latency.py tpu
-# 7. Profiler trace last (largest artifact, least critical).
+# 7. Profiler traces last (largest artifacts, least critical). 128 =
+#    the conv-emitter valley (one fusion = 89% of the step, see
+#    PERFORMANCE.md round-5 profiler diagnosis); 256 = the shipped
+#    batch.
 run python scripts/tpu_step_tuning.py profile
+run python scripts/tpu_step_tuning.py profile 128
+run python scripts/tpu_step_tuning.py profile 256
 date | tee -a "$OUT"
 echo "window complete: results in $OUT"
