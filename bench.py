@@ -6,13 +6,13 @@ Metric (TPU): grasps (examples) per second per chip through the full
 jitted train step (forward + backward + momentum update + weight decay +
 EMA) on the REFERENCE-SCALE network: Grasping44 (16 convs + BN, named
 grasp-param blocks, /root/reference/research/qtopt/networks.py:299-615)
-at 472x472x3 bfloat16 images. The per-chip config is auto-tuned: the
-bench measures batch 64, then doubles the batch to the 512 cap
-unconditionally keeping the best (round 5 showed a slow compiler
-VALLEY at b80-b128 with the fast regime returning at b256 — stopping
-at the first regression forfeits the winner), then probes
-rematerialization and the space-to-depth stem at the winning batch.
-The config actually used
+at 472x472x3 bfloat16 images. The per-chip config is auto-tuned over
+the batch ladder {256 first (the measured winner — headline secured
+even if the tunnel stalls mid-run), 64 (round-over-round comparison),
+128, 512} keeping the best (round 5 showed a slow compiler VALLEY at
+b80-b128 with the fast regime returning at b256 — throughput is not
+unimodal, so every rung is probed), then probes rematerialization and
+the space-to-depth stem at the winning batch. The config actually used
 lands in the JSON ("batch_size", "remat", "space_to_depth");
 "value_batch64" keeps the fixed-batch non-remat number for
 round-over-round comparison.
@@ -273,54 +273,45 @@ def _subprocess_probe(batch_size: int, remat: bool = False,
 
 
 def autotune(probe, initial_batch: int = BATCH_SIZE,
-             batch_cap: int = 512) -> dict | None:
+             batch_cap: int = 512,
+             priority_batch: int = 256) -> dict | None:
   """Batch/remat/s2d auto-tune over a probe callable; pure logic.
 
   `probe(batch_size, remat, s2d)` returns probe_main-style records (or
   {"timeout": True}). Returns the winning record extended with
   {"batch_size", "remat", "s2d", "value_batch64", "aborted"}; None when
-  the very first probe yields no usable number (caller falls back).
-  Policy (round 5: doubling no longer stops at a regression — the chip
-  showed a slow VALLEY at b80-b128 with the fast regime returning at
-  b256, so stopping at the first cliff forfeits the winner):
-    - OOM at the initial batch halves it (floor 4);
-    - batch doubles to `batch_cap` unconditionally, keeping the best;
+  no probe yields a usable number (caller falls back).
+  Policy (round 5: the chip showed throughput is NOT unimodal in batch
+  -- a flat ~10-27x-slow compiler valley at b80-b128 with the fast
+  regime returning at b256, the AOT knee -- so every batch in the
+  ladder is probed and the best kept):
+    - `priority_batch` (the measured winner, 256) is probed FIRST: if
+      the tunnel stalls mid-run, the best-so-far is the headline batch
+      rather than the b64 comparison probe (ascending order used to
+      cost exactly that);
+    - then the initial batch (keeps the round-over-round
+      `value_batch64` comparison) and the rest of the doubling ladder
+      up to `batch_cap`; an OOM skips every batch >= the OOMed one
+      (they only OOM harder);
+    - if the whole ladder OOMs, the initial batch halves down (floor 4;
+      degraded runs probe no ladder);
     - remat, then space-to-depth, probed at the winning batch;
     - ANY timeout abandons all remaining probes (the tunnel is suspect
       and each further probe would hang the full deadline) but keeps
       the best already-measured number.
   """
-  batch = initial_batch
-  rec = None
-  while True:
-    r = probe(batch, False, False)
-    if r.get("timeout"):
-      return None
-    if r.get("ok"):
-      rec = r
-      break
-    if "RESOURCE_EXHAUSTED" in r.get("error", "") and batch > 4:
-      print(f"bench: batch {batch} OOM; retrying at {batch // 2}",
-            file=sys.stderr)
-      batch //= 2
-      continue
-    print(f"bench: initial probe failed ({r.get('error')})",
-          file=sys.stderr)
-    return None
-  best = dict(rec, batch_size=batch, remat=False, s2d=False,
-              value_batch64=(rec["examples_per_sec"]
-                             if batch == BATCH_SIZE else None),
-              aborted=False)
-
+  best = None
   last_error = None
 
   def try_probe(b, remat, s2d, what):
     nonlocal best, last_error
-    if best["aborted"]:
+    if best is not None and best["aborted"]:
       return None
     r = probe(b, remat, s2d)
     if r.get("timeout"):
-      best["aborted"] = True
+      last_error = "timeout"
+      if best is not None:
+        best["aborted"] = True
       return None
     if not r.get("ok"):
       last_error = r.get("error", "")
@@ -330,25 +321,56 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
     last_error = None
     return r
 
-  # The step is HBM-bandwidth-bound (PERFORMANCE.md roofline) and the
-  # optimizer/EMA traffic is per-STEP: larger batches amortize it per
-  # example. Round-5 on-chip fact: throughput is NOT unimodal in batch —
-  # b80/b96/b128 fall into a flat ~10-27x-slow compiler valley while
-  # b256 lands back in the fast regime at 1.76x the b64 number (the AOT
-  # lever matrix's predicted knee). So the doubling probe runs to the
-  # cap unconditionally, tracking the best seen; OOM stops it (larger
-  # batches only OOM harder).
-  if batch == initial_batch:
-    probe_batch = 2 * batch
-    while probe_batch <= batch_cap:
-      r = try_probe(probe_batch, False, False, f"batch-{probe_batch}")
-      if best["aborted"]:
+  # Ladder in priority order: known winner, comparison batch, the rest
+  # of the doubling ladder ascending.
+  ladder = [priority_batch, initial_batch]
+  b = 2 * initial_batch
+  while b <= batch_cap:
+    ladder.append(b)
+    b *= 2
+  ladder = list(dict.fromkeys(b for b in ladder if 0 < b <= batch_cap))
+  oom_floor = None
+  value_batch64 = None
+  for b in ladder:
+    if best is not None and best["aborted"]:
+      break
+    if oom_floor is not None and b >= oom_floor:
+      continue
+    r = try_probe(b, False, False, f"batch-{b}")
+    if r is None:
+      if last_error == "timeout" and best is None:
+        return None
+      if "RESOURCE_EXHAUSTED" in (last_error or ""):
+        oom_floor = b if oom_floor is None else min(oom_floor, b)
+      continue
+    if b == BATCH_SIZE:
+      value_batch64 = r["examples_per_sec"]
+    if best is None or r["examples_per_sec"] > best["examples_per_sec"]:
+      # aborted cannot be True here: a timeout returns None from
+      # try_probe and breaks the ladder before another update.
+      best = dict(r, batch_size=b, remat=False, s2d=False,
+                  aborted=False)
+  if best is None and oom_floor is not None:
+    # The reference-scale batches do not fit: degrade by halving the
+    # initial batch (rounds 2-4 OOM policy; no ladder on degraded
+    # runs). Gated on an actual OOM — a ladder failing on generic
+    # errors fails fast to the caller's fallback instead of burning
+    # four more full-deadline probes that cannot succeed either.
+    b = initial_batch // 2
+    while b >= 4:
+      r = try_probe(b, False, False, f"degraded-batch-{b}")
+      if r is not None:
+        best = dict(r, batch_size=b, remat=False, s2d=False,
+                    aborted=False)
         break
-      if r is None and "RESOURCE_EXHAUSTED" in (last_error or ""):
-        break
-      if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
-        best.update(r, batch_size=probe_batch)
-      probe_batch *= 2
+      if last_error == "timeout":
+        return None
+      b //= 2
+  if best is None:
+    print(f"bench: no probe produced a number ({last_error})",
+          file=sys.stderr)
+    return None
+  best["value_batch64"] = value_batch64
   # Rematerialization probe at the winning batch. The local v5e AOT
   # lever matrix (PERFORMANCE.md round 4) predicts remat HURTS here
   # (more bytes AND more flops; the step is not activation-bound) —

@@ -127,43 +127,53 @@ def test_remat_win_carries_into_s2d_probe():
   assert (64, True, True) in probe.calls
 
 
-def test_timeout_mid_doubling_keeps_best_and_skips_all_remaining():
+def test_priority_batch_probed_first_secures_headline_on_timeout():
+  """The measured-winner batch is probed FIRST, so a tunnel stall on a
+  later probe keeps the HEADLINE number (the old ascending order kept
+  only the b64 comparison — below the north star)."""
   probe = FakeProbe({
-      (64, False, False): 1478.0,
-      (128, False, False): "timeout",
+      (256, False, False): 2480.0,
+      (64, False, False): "timeout",
   })
   best = bench.autotune(probe)
-  # The already-captured number survives; nothing else is probed
-  # (each further probe would hang the full deadline on a suspect
-  # tunnel — the round-5 incident this policy exists for).
-  assert best["examples_per_sec"] == 1478.0
+  assert probe.calls[0] == (256, False, False)
+  assert best["examples_per_sec"] == 2480.0
+  assert best["batch_size"] == 256
   assert best["aborted"]
-  assert probe.calls == [(64, False, False), (128, False, False)]
+  assert best["value_batch64"] is None  # the b64 probe never landed
+  # Nothing further probed on a suspect tunnel.
+  assert probe.calls == [(256, False, False), (64, False, False)]
 
 
 def test_timeout_on_first_probe_returns_none_for_fallback():
-  probe = FakeProbe({(64, False, False): "timeout"})
+  probe = FakeProbe({(256, False, False): "timeout"})
   assert bench.autotune(probe) is None
 
 
-def test_error_on_first_probe_returns_none_for_fallback():
-  probe = FakeProbe({(64, False, False): "error"})
+def test_error_everywhere_fails_fast_without_degraded_probes():
+  """Generic (non-OOM) failures across the ladder must NOT trigger the
+  degraded halving — four more full-deadline probes can't succeed
+  either; fall back to the caller immediately."""
+  errs = {(b, False, False): "error" for b in (256, 64, 128, 512)}
+  probe = FakeProbe(errs)
   assert bench.autotune(probe) is None
+  assert all(b >= 64 for b, _, _ in probe.calls)  # no 32/16/8/4 probes
 
 
-def test_oom_halves_initial_batch_and_skips_doubling():
+def test_oom_everywhere_halves_initial_batch_without_doubling():
   probe = FakeProbe({
-      (64, False, False): "oom",
-      (32, False, False): 800.0,
+      (256, False, False): "oom",   # floor=256
+      (64, False, False): "oom",    # floor=64 -> 128/512 skipped
+      (32, False, False): 800.0,    # degraded winner
       (32, True, False): 700.0,
       (32, False, True): 750.0,
   })
   best = bench.autotune(probe)
   assert best["batch_size"] == 32
   assert best["value_batch64"] is None
-  # Degraded-batch runs do not double (matches rounds 2-4 policy).
-  assert (64, False, False) in probe.calls
-  assert all(b <= 64 for b, _, _ in probe.calls)
+  # An OOMed floor skips every larger rung (they only OOM harder).
+  assert (128, False, False) not in probe.calls
+  assert (512, False, False) not in probe.calls
 
 
 def test_doubling_crosses_a_cliff_valley_to_the_far_winner():
