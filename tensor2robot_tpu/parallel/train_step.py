@@ -34,7 +34,8 @@ from tensor2robot_tpu import specs as specs_lib
 
 __all__ = ["TrainState", "create_train_state", "make_train_step",
            "make_train_loop", "loop_batch_spec", "make_eval_step",
-           "make_predict_fn", "fsdp_rules", "state_shardings"]
+           "make_eval_loop", "make_predict_fn", "fsdp_rules",
+           "state_shardings"]
 
 PartitionRules = Sequence[Tuple[str, PartitionSpec]]
 
@@ -359,13 +360,9 @@ def make_train_loop(model,
       donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(model,
-                   mesh: Optional[Mesh] = None,
-                   shardings: Any = None,
-                   batch_axis: str = "data",
-                   batch_spec: Optional[PartitionSpec] = None,
-                   use_ema: bool = True) -> Callable:
-  """Jitted eval step: (state, features, labels) -> metric scalars."""
+def _build_eval_fn(model, use_ema: bool) -> Callable:
+  """The un-jitted eval body shared by `make_eval_step` and
+  `make_eval_loop`."""
 
   def eval_fn(state: TrainState, features, labels):
     params = state.eval_params(use_ema=use_ema)
@@ -378,10 +375,59 @@ def make_eval_step(model,
         if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 else x, outputs)
     return model.model_eval_fn(features, labels, outputs)
 
+  return eval_fn
+
+
+def make_eval_step(model,
+                   mesh: Optional[Mesh] = None,
+                   shardings: Any = None,
+                   batch_axis: str = "data",
+                   batch_spec: Optional[PartitionSpec] = None,
+                   use_ema: bool = True) -> Callable:
+  """Jitted eval step: (state, features, labels) -> metric scalars."""
+  eval_fn = _build_eval_fn(model, use_ema)
   if mesh is None:
     return jax.jit(eval_fn)
   batch_ns = NamedSharding(mesh, batch_spec or PartitionSpec(batch_axis))
   return jax.jit(eval_fn, in_shardings=(shardings, batch_ns, batch_ns))
+
+
+def make_eval_loop(model,
+                   num_steps: int,
+                   mesh: Optional[Mesh] = None,
+                   shardings: Any = None,
+                   batch_axis: str = "data",
+                   batch_spec: Optional[PartitionSpec] = None,
+                   use_ema: bool = True) -> Callable:
+  """Jitted K-batch eval LOOP: (state, features, labels) -> metric
+  scalars SUMMED over the K batches (divide by K for the mean), with
+  features/labels carrying a leading `num_steps` axis.
+
+  The eval twin of `make_train_loop`: in iterations_per_loop training
+  the ~8 ms per-dispatch transport floor (PERFORMANCE.md round 5)
+  would otherwise make a 100-batch eval cost more wall-clock than the
+  500 train steps between evals. Summing on device keeps the host
+  transfer to one scalar dict per K batches."""
+  if num_steps < 1:
+    raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+  eval_fn = _build_eval_fn(model, use_ema)
+
+  def loop_fn(state: TrainState, features, labels):
+    def body(carry, batch):
+      f, l = batch
+      return carry, eval_fn(state, f, l)
+
+    _, metrics = jax.lax.scan(body, None, (features, labels),
+                              length=num_steps)
+    return jax.tree_util.tree_map(lambda x: jnp.sum(x, axis=0), metrics)
+
+  if mesh is None:
+    return jax.jit(loop_fn)
+  loop_ns = NamedSharding(mesh, loop_batch_spec(batch_spec, batch_axis))
+  replicated_ns = NamedSharding(mesh, PartitionSpec())
+  return jax.jit(loop_fn,
+                 in_shardings=(shardings, loop_ns, loop_ns),
+                 out_shardings=replicated_ns)
 
 
 def make_predict_fn(model, use_ema: bool = True) -> Callable:

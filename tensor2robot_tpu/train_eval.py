@@ -92,32 +92,64 @@ def _device_batch(mesh, batch, batch_spec=None):
 
 
 def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
-              batch_spec=None, prefetch_depth: int = 2):
+              batch_spec=None, prefetch_depth: int = 2,
+              eval_loop=None, eval_loop_k: int = 1):
   """Runs eval_steps batches, averaging metric scalars.
 
   Accumulation stays ON DEVICE (async dispatch): a per-batch host
   float() would synchronize every eval step and stall the TPU pipeline
   (VERDICT r1 weakness #10); the only host transfer is the final
-  read-back of the averaged scalars.
+  read-back of the averaged scalars. With `eval_loop` (a compiled
+  `make_eval_loop` over `eval_loop_k` batches), full groups of K
+  batches run as ONE dispatch each (summed on device) and only the
+  tail single-steps — the eval twin of iterations_per_loop.
   """
   totals: dict = {}
   count = 0
+
+  def _accumulate(metrics, n):
+    nonlocal count
+    for key, value in metrics.items():
+      totals[key] = (totals[key] + value) if key in totals else value
+    count += n
+
+  remaining = eval_steps
+  if eval_loop is not None and eval_loop_k > 1:
+    loop_spec = ts.loop_batch_spec(batch_spec)
+    while remaining >= eval_loop_k:
+      group = []
+      try:
+        for _ in range(eval_loop_k):
+          group.append(next(dataset))
+      except StopIteration:
+        # Finite eval stream ended mid-group: the already-consumed
+        # batches still count — single-step them instead of dropping,
+        # then fall through to the (now zero-iteration) tail and the
+        # single averaging exit below.
+        for b in group:
+          f, l = mesh_lib.place_batch(mesh, b, batch_spec=batch_spec)
+          _accumulate(eval_step(state, f, l), 1)
+        remaining = 0
+        break
+      stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
+      f, l = mesh_lib.place_batch(mesh, stacked, batch_spec=loop_spec)
+      _accumulate(eval_loop(state, f, l), eval_loop_k)
+      remaining -= eval_loop_k
+    prefetch_depth = 0  # the tail below is at most K-1 batches
   if prefetch_depth:
     batches = mesh_lib.DevicePrefetcher(
         dataset, mesh, batch_spec=batch_spec, depth=prefetch_depth,
-        max_batches=eval_steps)
+        max_batches=remaining)
   else:
     batches = (_device_batch(mesh, b, batch_spec) for b in dataset)
   try:
-    for _ in range(eval_steps):
+    for _ in range(remaining):
       try:
         features, labels = next(batches)
       except StopIteration:
         break
       metrics = eval_step(state, features, labels)
-      for key, value in metrics.items():
-        totals[key] = (totals[key] + value) if key in totals else value
-      count += 1
+      _accumulate(metrics, 1)
   finally:
     if prefetch_depth:
       batches.close()
@@ -262,6 +294,20 @@ def train_eval_model(
 
   # -- evaluate-only modes --------------------------------------------------
   batch_spec = getattr(model, "batch_partition_spec", None)
+  # Eval twin of iterations_per_loop: K eval batches per dispatch,
+  # summed on device (built lazily so train-only runs pay no compile).
+  eval_loop_k = max(1, min(int(iterations_per_loop), int(eval_steps)))
+  _eval_loop_cache: list = []
+
+  def _eval_loop():
+    if eval_loop_k <= 1:
+      return None
+    if not _eval_loop_cache:
+      _eval_loop_cache.append(ts.make_eval_loop(
+          model, eval_loop_k, mesh=mesh, shardings=shardings,
+          batch_spec=batch_spec, use_ema=use_ema_for_eval))
+    return _eval_loop_cache[0]
+
   if mode == "evaluate":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
                                   batch_spec=batch_spec,
@@ -269,7 +315,9 @@ def train_eval_model(
     eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
     final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
                               eval_steps, batch_spec,
-                              prefetch_depth=device_prefetch_depth)
+                              prefetch_depth=device_prefetch_depth,
+                              eval_loop=_eval_loop(),
+                              eval_loop_k=eval_loop_k)
     writer.write_scalars(int(state.step), final_metrics)
     for hook in hooks:
       hook.after_eval(ctx, int(state.step), final_metrics)
@@ -303,7 +351,9 @@ def train_eval_model(
         eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
         final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
                                   eval_steps, batch_spec,
-                                  prefetch_depth=device_prefetch_depth)
+                                  prefetch_depth=device_prefetch_depth,
+                                  eval_loop=_eval_loop(),
+                                  eval_loop_k=eval_loop_k)
       finally:
         if backup is not None:
           import shutil
@@ -455,7 +505,9 @@ def train_eval_model(
           eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
           eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
                                    eval_steps, batch_spec,
-                                   prefetch_depth=device_prefetch_depth)
+                                   prefetch_depth=device_prefetch_depth,
+                                   eval_loop=_eval_loop(),
+                                   eval_loop_k=eval_loop_k)
           writer.write_scalars(step, {f"eval/{k}": v
                                       for k, v in eval_metrics.items()})
           for hook in hooks:

@@ -212,6 +212,44 @@ def _compile_step_for_mesh(model, mesh, batch, rules=None):
                     _uniform_shapes(labels, batch_sh)).compile()
 
 
+def _compile_loop_for_mesh(model, mesh, batch, loop_k, rules=None):
+  """Same production layout as `_compile_step_for_mesh` but through
+  `make_train_loop`: the K-step scan loop must compile with the same
+  sharded state + the scan-axis-extended batch sharding."""
+  import numpy as np
+  from jax.sharding import NamedSharding
+
+  from tensor2robot_tpu import specs as specs_lib
+  from tensor2robot_tpu.parallel import train_step as ts
+
+  features = specs_lib.make_random_numpy(
+      model.get_feature_specification("train"), batch_size=batch, seed=0)
+  labels = specs_lib.make_random_numpy(
+      model.get_label_specification("train"), batch_size=batch, seed=1)
+  stack = lambda tree: jax.tree_util.tree_map(
+      lambda x: np.stack([x] * loop_k), tree)
+  features, labels = stack(features), stack(labels)
+  state_shape = jax.eval_shape(
+      lambda rng, f: ts.create_train_state(
+          model, rng, jax.tree_util.tree_map(lambda x: x[0], f))[0],
+      jax.random.PRNGKey(0), features)
+  shardings = ts.state_shardings(state_shape, mesh, rules=rules)
+  batch_spec = getattr(model, "batch_partition_spec", None)
+  loop_sh = NamedSharding(mesh, ts.loop_batch_spec(batch_spec))
+
+  def shapes(tree, sharding_tree):
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        tree, sharding_tree,
+        is_leaf=lambda x: hasattr(x, "shape"))
+
+  loop = ts.make_train_loop(model, loop_k, mesh=mesh, shardings=shardings,
+                            batch_spec=batch_spec, donate=False)
+  return loop.lower(shapes(state_shape, shardings),
+                    _uniform_shapes(features, loop_sh),
+                    _uniform_shapes(labels, loop_sh)).compile()
+
+
 class TestServingCompilesForV5e:
   """The on-device CEM action-selection loop (the serving hot path:
   Grasping44 critic scored over 64 samples x 3 iterations inside one
@@ -394,6 +432,65 @@ class TestAOTCostPins:
           f"{key} at batch {batch} drifted >10% from the committed pin: "
           f"pinned={want}, now={got[key]}. If intentional, re-baseline "
           f"AOT_ANALYSIS_r04.json with this record: {got}")
+
+
+class TestTrainLoopCompilesForV5e:
+  """The iterations_per_loop scan loop, certified by the real v5e
+  compiler under production dp x fsdp shardings (the same discipline as
+  every other stack): the measured 4.8-7.3x small-family win
+  (PERFORMANCE.md round 5) rides this exact program shape."""
+
+  def test_flagship_loop_compiles_sharded(self):
+    from jax.sharding import Mesh
+
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.research.qtopt import flagship
+
+    model = flagship.make_flagship_model("tpu", image_size=128)
+    mesh = Mesh(_v5e_devices().reshape(2, 2), ("data", "fsdp"))
+    # Compile success IS the assertion (XLA may or may not unroll the
+    # tiny trip count, so the HLO text carries no stable marker); the
+    # cost analysis must price the real program.
+    compiled = _compile_loop_for_mesh(model, mesh, batch=8, loop_k=4,
+                                      rules=ts.fsdp_rules())
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+    assert cost.get("flops", 0) > 0
+
+  def test_flagship_eval_loop_compiles_sharded(self):
+    """The EVAL loop has its own jit signature (replicated summed
+    metrics out, no donation) — certify it separately."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.parallel import train_step as ts
+    from tensor2robot_tpu.research.qtopt import flagship
+
+    model = flagship.make_flagship_model("tpu", image_size=128)
+    mesh = Mesh(_v5e_devices().reshape(2, 2), ("data", "fsdp"))
+    k = 4
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification("train"), batch_size=8, seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification("train"), batch_size=8, seed=1)
+    stack = lambda tree: jax.tree_util.tree_map(
+        lambda x: np.stack([x] * k), tree)
+    features, labels = stack(features), stack(labels)
+    state_shape = jax.eval_shape(
+        lambda rng, f: ts.create_train_state(
+            model, rng, jax.tree_util.tree_map(lambda x: x[0], f))[0],
+        jax.random.PRNGKey(0), features)
+    shardings = ts.state_shardings(state_shape, mesh,
+                                   rules=ts.fsdp_rules())
+    loop_sh = NamedSharding(mesh, ts.loop_batch_spec())
+    shapes = lambda tree, sh_tree: jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        tree, sh_tree, is_leaf=lambda x: hasattr(x, "shape"))
+    loop = ts.make_eval_loop(model, k, mesh=mesh, shardings=shardings)
+    loop.lower(shapes(state_shape, shardings),
+               _uniform_shapes(features, loop_sh),
+               _uniform_shapes(labels, loop_sh)).compile()
 
 
 class TestSpaceToDepthStemCompilesForV5e:

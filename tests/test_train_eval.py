@@ -81,6 +81,58 @@ class TestTrainEval:
                     jax.tree_util.tree_leaves(s4.params)):
       np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+  def test_eval_loop_matches_single_step_eval(self, tmp_path):
+    """evaluate mode with the K-batch eval loop (incl. a non-divisible
+    tail: 10 = 2x4 + 2) must average the same metrics as single-step
+    dispatch over the same deterministic stream."""
+    results = {}
+    for k in (1, 4):
+      metrics = train_eval.train_eval_model(
+          model=self._model(),
+          model_dir=str(tmp_path / f"eval{k}"),
+          mode="evaluate",
+          eval_steps=10,
+          input_generator_eval=mocks.MockInputGenerator(batch_size=8),
+          iterations_per_loop=k)
+      results[k] = metrics
+    assert results[1].keys() == results[4].keys()
+    for key in results[1]:
+      np.testing.assert_allclose(results[1][key], results[4][key],
+                                 rtol=1e-6)
+
+  def test_eval_loop_partial_group_counts_consumed_batches(self):
+    """A finite eval stream ending mid-group must still average the
+    already-consumed batches (single-stepped), not drop them: 6
+    batches with K=4 = one full group + a 2-batch partial."""
+    import itertools
+
+    import jax
+
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+    from tensor2robot_tpu.parallel import train_step as ts
+
+    model = self._model()
+    gen = mocks.MockInputGenerator(batch_size=8)
+    train_eval.provide_input_generator_with_model_information(
+        gen, model, "eval")
+    mesh = mesh_lib.create_mesh(mesh_shape=(1, 1, 1))
+    first = next(gen.create_dataset("eval"))
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(0), first["features"], mesh=mesh)
+    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings)
+    eval_loop = ts.make_eval_loop(model, 4, mesh=mesh,
+                                  shardings=shardings)
+
+    finite = lambda: itertools.islice(gen.create_dataset("eval"), 6)
+    want = train_eval._run_eval(eval_step, state, finite(), mesh,
+                                eval_steps=10, prefetch_depth=0)
+    got = train_eval._run_eval(eval_step, state, finite(), mesh,
+                               eval_steps=10, prefetch_depth=0,
+                               eval_loop=eval_loop, eval_loop_k=4)
+    assert want.keys() == got.keys()
+    for key in want:
+      np.testing.assert_allclose(got[key], want[key], rtol=1e-6)
+
   def test_train_and_evaluate_end_to_end(self, tmp_path):
     model_dir = str(tmp_path / "m")
     metrics = train_eval.train_eval_model(
