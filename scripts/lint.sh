@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# graftlint wrapper: static analysis over the repo, CPU-pinned.
+#
+# The analyzers never use a JAX backend, but this machine's environment
+# forces JAX_PLATFORMS=axon (TPU tunnel) and a wedged tunnel hangs any
+# accidental backend init forever. The env var alone is NOT enough under
+# the axon hook (CLAUDE.md), so pin through the one shared
+# implementation, utils.backend.pin_cpu (env var + jax.config.update).
+# Non-zero exit iff findings (the tier-1 suite enforces the same via
+# tests/test_static_analysis.py::test_repo_clean).
+#
+# Usage: scripts/lint.sh [paths...]   (default: tensor2robot_tpu scripts)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -c '
+import sys
+from tensor2robot_tpu.utils import backend
+backend.pin_cpu()
+from tensor2robot_tpu.analysis import lint
+sys.exit(lint.main(sys.argv[1:]))
+' "$@"

@@ -1,0 +1,153 @@
+"""graftlint CLI: run every static analyzer over configs and sources.
+
+Usage (from the repo root):
+
+  python -m tensor2robot_tpu.analysis.lint tensor2robot_tpu scripts
+  python -m tensor2robot_tpu.analysis.lint --json some/file.py
+  python -m tensor2robot_tpu.analysis.lint --list-rules
+
+Walks the given files/directories: `.gin` files go through the config
+checker, `.py` files through the tracer-hygiene and spec/sharding
+checkers. Mesh axis names are collected from ALL discovered configs
+before any Python file is checked, so spec annotations are validated
+against the full declared vocabulary. Exits non-zero iff findings
+remain after `# graftlint: disable=` suppressions.
+
+No JAX backend is ever initialized (tests/test_static_analysis.py runs
+this CLI under a poisoned JAX_PLATFORMS to prove it); `scripts/lint.sh`
+additionally pins JAX_PLATFORMS=cpu as belt-and-braces for interactive
+use on the tunnel machine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from tensor2robot_tpu.analysis import config_check, spec_check, tracer_check
+from tensor2robot_tpu.analysis.findings import Finding
+
+__all__ = ["run", "main"]
+
+_RULE_CATALOG = """\
+config rules (.gin):
+  parse-error            file does not parse
+  broken-import          an `import a.b.c` line fails to import
+  unknown-configurable   Name.param / @Name resolves to no configurable
+  missing-import         Name resolves, but only via import pollution —
+                         no import line (nor entry binary) covers its
+                         defining module in a fresh process
+  unknown-parameter      Name has no parameter `param`
+  duplicate-binding      same (scope, Name, param) bound twice in one
+                         file (include-then-override is idiomatic)
+  undefined-macro        %MACRO referenced but never defined
+  type-mismatch          literal value contradicts annotation/default
+
+tracer rules (.py):
+  block-until-ready      jax.block_until_ready outside utils/backend.py
+  import-time-backend    backend-touching call at module import level
+  host-sync-in-jit       .item() / float() / np.asarray() on traced
+                         values inside a jitted function
+  impure-in-jit          time.time / stateful np.random inside a jitted
+                         function
+
+spec rules (.py):
+  unknown-mesh-axis      TensorSpec.sharding names an undeclared axis
+  duplicate-sharding-axis  same axis twice in one annotation
+  sharding-rank-mismatch more sharding entries than spec dims
+  sharding-conflict      feature vs label sharding disagreement
+                         (structure-level API only)
+
+Suppress a finding with a trailing `# graftlint: disable=<rule>`.
+"""
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
+
+
+def _discover(paths: List[str]) -> Tuple[List[str], List[str]]:
+  """(.py files, .gin files) under the given files/directories."""
+  py_files: List[str] = []
+  gin_files: List[str] = []
+  for path in paths:
+    if os.path.isfile(path):
+      (py_files if path.endswith(".py") else
+       gin_files if path.endswith(".gin") else []).append(path)
+      continue
+    for dirpath, dirnames, filenames in os.walk(path):
+      dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+      for name in sorted(filenames):
+        if name.endswith(".py"):
+          py_files.append(os.path.join(dirpath, name))
+        elif name.endswith(".gin"):
+          gin_files.append(os.path.join(dirpath, name))
+  return py_files, gin_files
+
+
+def run(paths: List[str]) -> List[Finding]:
+  """Runs all analyzers; returns every unsuppressed finding."""
+  py_files, gin_files = _discover(paths)
+  findings: List[Finding] = []
+  # The axis vocabulary always includes the repo's own shipped configs,
+  # not just configs under `paths` — otherwise linting a single .py file
+  # would flag axes (e.g. 'sp', 'pp') that a config elsewhere declares.
+  package_dir = os.path.dirname(os.path.abspath(__file__))
+  _, repo_gin = _discover([os.path.dirname(package_dir)])
+  mesh_axes = spec_check.known_mesh_axes(
+      sorted(set(gin_files) | set(repo_gin)))
+  for path in gin_files:
+    findings.extend(config_check.check_config_file(path))
+  for path in py_files:
+    findings.extend(tracer_check.check_python_file(path))
+    findings.extend(spec_check.check_python_file(path, mesh_axes))
+  return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv: List[str] = None) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.analysis.lint",
+      description="graftlint: static analysis for configs, specs, and "
+                  "tracer hygiene (no JAX backend use).")
+  parser.add_argument("paths", nargs="*",
+                      default=["tensor2robot_tpu", "scripts"],
+                      help="files or directories to lint "
+                           "(default: tensor2robot_tpu scripts)")
+  parser.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit findings as JSON lines")
+  parser.add_argument("--list-rules", action="store_true",
+                      help="print the rule catalog and exit")
+  args = parser.parse_args(argv)
+  if args.list_rules:
+    print(_RULE_CATALOG, end="")
+    return 0
+  missing = [p for p in args.paths if not os.path.exists(p)]
+  if missing:
+    print(f"graftlint: no such path: {', '.join(missing)}",
+          file=sys.stderr)
+    return 2
+  # An explicitly named file the analyzers would silently skip is an
+  # operator error, not a clean result.
+  unsupported = [p for p in args.paths
+                 if os.path.isfile(p) and not p.endswith((".py", ".gin"))]
+  if unsupported:
+    print("graftlint: unsupported file type (want .py or .gin): "
+          f"{', '.join(unsupported)}", file=sys.stderr)
+    return 2
+  findings = run(list(args.paths))
+  for finding in findings:
+    if args.as_json:
+      print(json.dumps({"path": finding.path, "line": finding.line,
+                        "rule": finding.rule,
+                        "message": finding.message}))
+    else:
+      print(finding)
+  if findings:
+    print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
+    return 1
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -19,6 +19,7 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tensor2robot_tpu.layers import tec as tec_lib
 
@@ -114,8 +115,12 @@ def npairs_loss_multilabel(pregrasp_embedding, goal_embedding,
   return one_direction(pair_a, pair_b) + one_direction(pair_b, pair_a)
 
 
-_QUADRANT_CENTERS = jnp.array(
-    [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]], jnp.float32)
+# Host constant on purpose: a module-level `jnp.array` initializes the JAX
+# backend at import time — over the axon tunnel that means ANY import of
+# this module touches (and can wedge) TPU hardware. numpy converts to a
+# device constant at trace time instead (graftlint: import-time-backend).
+_QUADRANT_CENTERS = np.array(
+    [[0.5, -0.5], [-0.5, -0.5], [0.5, 0.5], [-0.5, 0.5]], np.float32)
 
 
 def keypoint_accuracy(keypoints, labels

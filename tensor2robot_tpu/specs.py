@@ -63,6 +63,7 @@ __all__ = [
     "make_random_numpy",
     "make_constant_numpy",
     "partition_specs",
+    "sharding_axes",
     "Assets",
     "write_assets",
     "load_assets",
@@ -748,6 +749,22 @@ def make_constant_numpy(spec_structure: SpecStructLike,
 # ---------------------------------------------------------------------------
 # Sharding helpers (new TPU-first capability)
 # ---------------------------------------------------------------------------
+
+
+def sharding_axes(spec_structure: SpecStructLike
+                  ) -> "OrderedDict[str, Tuple[Optional[str], ...]]":
+  """Flat key -> `TensorSpec.sharding` tuple, for annotated leaves only.
+
+  The spec-introspection hook used by the static analyzer
+  (`tensor2robot_tpu.analysis.spec_check`): it lets sharding annotations
+  be audited against declared mesh axis names without building a mesh or
+  touching a backend. Leaves without a sharding annotation are omitted.
+  """
+  out: "OrderedDict[str, Tuple[Optional[str], ...]]" = OrderedDict()
+  for key, spec in flatten_spec_structure(spec_structure).items():
+    if isinstance(spec, TensorSpec) and spec.sharding is not None:
+      out[key] = spec.sharding
+  return out
 
 
 def partition_specs(spec_structure: SpecStructLike,

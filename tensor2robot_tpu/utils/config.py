@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import ast
 import contextlib
+import dataclasses
 import functools
 import importlib
 import inspect
@@ -48,6 +49,8 @@ __all__ = [
     "get_configurable",
     "REQUIRED",
     "ConfigError",
+    "ConfigStatement",
+    "iter_config_statements",
 ]
 
 
@@ -66,18 +69,30 @@ REQUIRED = _Required()
 
 
 class _ConfigurableReference:
-  """`@Name` (pass the callable) or `@Name()` (call it at injection time)."""
+  """`@Name` (pass the callable) or `@Name()` (call it at injection time).
 
-  def __init__(self, name: str, evaluate: bool):
+  `location` ("path:line" of the config text that produced the reference)
+  rides along so resolution errors point at the config file, not at the
+  distant call site where injection happens.
+  """
+
+  def __init__(self, name: str, evaluate: bool,
+               location: Optional[str] = None):
     self.name = name
     self.evaluate = evaluate
+    self.location = location
 
   def resolve(self) -> Any:
     scope = ""
     name = self.name
     if "/" in name:
       scope, name = name.rsplit("/", 1)
-    fn = get_configurable(name)
+    try:
+      fn = get_configurable(name)
+    except ConfigError as e:
+      if self.location:
+        raise ConfigError(f"{self.location}: {e}") from e
+      raise
     if self.evaluate:
       with config_scope(scope):
         return fn()
@@ -99,8 +114,9 @@ class _ConfigurableReference:
 
 
 class _MacroReference:
-  def __init__(self, name: str):
+  def __init__(self, name: str, location: Optional[str] = None):
     self.name = name
+    self.location = location
 
   def __repr__(self):
     return f"%{self.name}"
@@ -117,6 +133,9 @@ class _Registry:
     self.macros: Dict[str, Any] = {}
     self.operative: Dict[Tuple[str, str], Any] = {}
     self.imports: List[str] = []
+    # (scope, configurable_name, param) -> "path:line" of the binding,
+    # so call-time errors can point back at the config file.
+    self.locations: Dict[Tuple[str, str, str], str] = {}
 
 
 _REGISTRY = _Registry()
@@ -146,7 +165,28 @@ def clear_config() -> None:
   _REGISTRY.bindings.clear()
   _REGISTRY.macros.clear()
   _REGISTRY.operative.clear()
+  _REGISTRY.locations.clear()
   _SCOPE.stack = []
+
+
+def _binding_location(name: str, param: str) -> str:
+  """' (bound at path:line)' suffix for error messages, if known.
+
+  Prefers the binding that is actually active: innermost active scope
+  first, then the unscoped binding, then any scope as a last resort (so
+  a scoped config file is never blamed for another scope's binding).
+  """
+  candidates = [(scope, name, param)
+                for scope in reversed(_scope_stack())]
+  candidates.append(("", name, param))
+  for key in candidates:
+    location = _REGISTRY.locations.get(key)
+    if location:
+      return f" (bound at {location})"
+  for (_, conf, p), location in _REGISTRY.locations.items():
+    if conf == name and p == param and location:
+      return f" (bound at {location})"
+  return ""
 
 
 def _register(name: str, wrapped: Callable, allow_override: bool = False):
@@ -180,7 +220,8 @@ def _resolve_value(value: Any) -> Any:
     return value.resolve()
   if isinstance(value, _MacroReference):
     if value.name not in _REGISTRY.macros:
-      raise ConfigError(f"Undefined macro %{value.name}")
+      where = f"{value.location}: " if value.location else ""
+      raise ConfigError(f"{where}Undefined macro %{value.name}")
     return _resolve_value(_REGISTRY.macros[value.name])
   if isinstance(value, list):
     return [_resolve_value(v) for v in value]
@@ -241,7 +282,8 @@ def configurable(fn_or_name=None, *, name: Optional[str] = None,
               f"Parameter {param!r} of {reg_name!r} may not be configured.")
         if not has_var_kw and param not in param_names:
           raise ConfigError(
-              f"Configurable {reg_name!r} has no parameter {param!r}.")
+              f"Configurable {reg_name!r} has no parameter {param!r}."
+              f"{_binding_location(reg_name, param)}")
         if param in kwargs or param in bound_positional:
           continue  # explicit call-site args win over config
         injected[param] = _resolve_value(raw)
@@ -306,7 +348,8 @@ def _decorate_class(cls: type, reg_name: str,
               f"Parameter {param!r} of {reg_name!r} may not be configured.")
         if not has_var_kw and param not in param_names:
           raise ConfigError(
-              f"Configurable {reg_name!r} has no parameter {param!r}.")
+              f"Configurable {reg_name!r} has no parameter {param!r}."
+              f"{_binding_location(reg_name, param)}")
         if param in kwargs or param in bound_positional:
           continue
         kwargs[param] = _resolve_value(raw)
@@ -330,8 +373,11 @@ def external_configurable(fn: Callable, name: Optional[str] = None) -> Callable:
 
 
 def bind(configurable_name: str, param: str, value: Any,
-         scope: str = "") -> None:
-  _REGISTRY.bindings[(scope, configurable_name, param)] = value
+         scope: str = "", location: Optional[str] = None) -> None:
+  key = (scope, configurable_name, param)
+  _REGISTRY.bindings[key] = value
+  if location:
+    _REGISTRY.locations[key] = location
 
 
 def macro(name: str, value: Any) -> None:
@@ -366,7 +412,7 @@ class _ValueTransformer(ast.NodeTransformer):
   """Rewrites @ref / %macro placeholders back out of a parsed literal."""
 
 
-def _parse_value(text: str) -> Any:
+def _parse_value(text: str, location: Optional[str] = None) -> Any:
   """Parses a gin RHS: python literal with @references and %macros."""
   text = text.strip()
   # Tokenize @references and %macros into placeholder strings, parse the
@@ -377,12 +423,13 @@ def _parse_value(text: str) -> Any:
     key = f"__t2r_ref_{len(placeholders)}__"
     name = m.group("name")
     evaluate = m.group("call") is not None
-    placeholders[key] = _ConfigurableReference(name, evaluate)
+    placeholders[key] = _ConfigurableReference(name, evaluate,
+                                               location=location)
     return repr(key)
 
   def _sub_macro(m: re.Match) -> str:
     key = f"__t2r_macro_{len(placeholders)}__"
-    placeholders[key] = _MacroReference(m.group("name"))
+    placeholders[key] = _MacroReference(m.group("name"), location=location)
     return repr(key)
 
   substituted = re.sub(
@@ -407,60 +454,179 @@ def _parse_value(text: str) -> Any:
   return _restore(value)
 
 
+def _strip_comment(line: str) -> Tuple[str, str]:
+  """(line with any unquoted `#`-comment removed, same with string
+  contents masked to spaces). `#` and brackets inside quoted strings are
+  data, not syntax — the mask lets callers count brackets safely."""
+  out = []
+  masked = []
+  quote = None
+  i = 0
+  while i < len(line):
+    ch = line[i]
+    if quote:
+      if ch == "\\" and i + 1 < len(line):
+        out.append(line[i:i + 2])
+        masked.append("  ")
+        i += 2
+        continue
+      out.append(ch)
+      if ch == quote:
+        masked.append(ch)
+        quote = None
+      else:
+        masked.append(" ")
+    elif ch in "'\"":
+      quote = ch
+      out.append(ch)
+      masked.append(ch)
+    elif ch == "#":
+      break
+    else:
+      out.append(ch)
+      masked.append(ch)
+    i += 1
+  return "".join(out), "".join(masked)
+
+
 def _logical_lines(text: str):
-  """Yields logical config lines, joining bracket/paren continuations."""
+  """Yields (start_lineno, end_lineno, logical_line), joining bracket
+  continuations. Comment stripping and bracket counting are
+  quote-aware: `#`, `(`, `[` … inside string values are data."""
   buffer = ""
+  masked_buffer = ""
   depth = 0
-  for raw_line in text.splitlines():
-    line = raw_line.split("#", 1)[0].rstrip()
+  start = end = 0
+  for lineno, raw_line in enumerate(text.splitlines(), start=1):
+    line, masked = _strip_comment(raw_line)
+    line, masked = line.rstrip(), masked.rstrip()
     if not line.strip() and depth == 0:
       continue
+    if not buffer:
+      start = lineno
+    end = lineno
     buffer = (buffer + " " + line.strip()) if buffer else line.strip()
-    depth = (buffer.count("(") - buffer.count(")")
-             + buffer.count("[") - buffer.count("]")
-             + buffer.count("{") - buffer.count("}"))
-    if depth <= 0 and buffer and not buffer.endswith(("=", ",")):
-      yield buffer
+    masked_buffer = ((masked_buffer + " " + masked.strip())
+                     if masked_buffer else masked.strip())
+    depth = (masked_buffer.count("(") - masked_buffer.count(")")
+             + masked_buffer.count("[") - masked_buffer.count("]")
+             + masked_buffer.count("{") - masked_buffer.count("}"))
+    if depth <= 0 and buffer and not masked_buffer.endswith(("=", ",")):
+      yield start, end, buffer
       buffer = ""
+      masked_buffer = ""
       depth = 0
   if buffer.strip():
-    yield buffer
+    yield start, end, buffer
 
 
-def parse_config(text: str, base_dir: Optional[str] = None) -> None:
-  """Parses config text: bindings, macros, imports, includes."""
-  for line in _logical_lines(text):
+@dataclasses.dataclass
+class ConfigStatement:
+  """One parsed logical config line, nothing executed.
+
+  The no-execute face of the parser: `iter_config_statements` yields these
+  without importing modules, following includes, or touching the registry —
+  the hook the static analyzer (`tensor2robot_tpu.analysis`) builds on.
+  `kind` is one of 'import' | 'include' | 'binding' | 'macro'; for bindings
+  `value` still holds unresolved `_ConfigurableReference`/`_MacroReference`
+  placeholders.
+  """
+
+  kind: str
+  line: int
+  path: Optional[str] = None
+  end_line: int = 0         # last physical line (continuations); 0 = line
+  module: str = ""          # kind == 'import'
+  include_target: str = ""  # kind == 'include' (base_dir-resolved path)
+  scope: str = ""           # kind == 'binding'
+  name: str = ""            # binding configurable name / macro name
+  param: str = ""           # kind == 'binding'
+  value: Any = None         # kind in ('binding', 'macro')
+
+  def __post_init__(self):
+    if not self.end_line:
+      self.end_line = self.line
+
+  @property
+  def location(self) -> str:
+    return f"{self.path or '<config string>'}:{self.line}"
+
+
+def iter_config_statements(text: str,
+                           path: Optional[str] = None,
+                           base_dir: Optional[str] = None):
+  """Parses config text into `ConfigStatement`s WITHOUT executing anything.
+
+  No module imports, no include recursion (the include target path is
+  resolved against `base_dir` but not opened), no registry mutation. Parse
+  errors raise ConfigError prefixed with `path:line`.
+  """
+  if base_dir is None and path is not None:
+    base_dir = os.path.dirname(path)
+  for lineno, end_line, line in _logical_lines(text):
+    location = f"{path or '<config string>'}:{lineno}"
     if line.startswith("import "):
-      module = line[len("import "):].strip()
-      _REGISTRY.imports.append(module)
-      importlib.import_module(module)
+      yield ConfigStatement(kind="import", line=lineno, end_line=end_line,
+                            path=path,
+                            module=line[len("import "):].strip())
       continue
     if line.startswith("include "):
       target = line[len("include "):].strip().strip("'\"")
-      path = target
+      resolved = target
       if base_dir and not os.path.isabs(target):
-        path = os.path.join(base_dir, target)
-      parse_config_file(path)
+        resolved = os.path.join(base_dir, target)
+      yield ConfigStatement(kind="include", line=lineno, end_line=end_line,
+                            path=path, include_target=resolved)
       continue
     if "=" not in line:
-      raise ConfigError(f"Cannot parse config line: {line!r}")
+      raise ConfigError(f"{location}: Cannot parse config line: {line!r}")
     lhs, rhs = line.split("=", 1)
     lhs = lhs.strip()
-    value = _parse_value(rhs)
-    if re.match(r"^[A-Z_][A-Z0-9_]*$", lhs):  # MACRO = value
-      macro(lhs, value)
+    try:
+      value = _parse_value(rhs, location=location)
+    except ConfigError as e:
+      raise ConfigError(f"{location}: {e}") from e
+    if re.match(r"^[A-Z_][A-Z0-9_]*$", lhs) or "." not in lhs:
+      # MACRO = value (gin allows lowercase macros too)
+      yield ConfigStatement(kind="macro", line=lineno, end_line=end_line,
+                            path=path, name=lhs, value=value)
       continue
-    if "." not in lhs:
-      # bare-name macro (gin allows lowercase macros too)
-      macro(lhs, value)
-      continue
-    scope, name, param = _parse_lhs(lhs)
-    bind(name, param, value, scope=scope)
+    try:
+      scope, name, param = _parse_lhs(lhs)
+    except ConfigError as e:
+      raise ConfigError(f"{location}: {e}") from e
+    yield ConfigStatement(kind="binding", line=lineno, end_line=end_line,
+                          path=path, scope=scope, name=name, param=param,
+                          value=value)
+
+
+def parse_config(text: str, base_dir: Optional[str] = None,
+                 path: Optional[str] = None) -> None:
+  """Parses config text: bindings, macros, imports, includes."""
+  for st in iter_config_statements(text, path=path, base_dir=base_dir):
+    if st.kind == "import":
+      _REGISTRY.imports.append(st.module)
+      try:
+        importlib.import_module(st.module)
+      except Exception as e:
+        # Any import-time failure (ImportError, a module's own
+        # RuntimeError, ...) gets the config location — these are the
+        # errors most likely on a fresh machine.
+        raise ConfigError(
+            f"{st.location}: cannot import {st.module!r}: "
+            f"{type(e).__name__}: {e}") from e
+    elif st.kind == "include":
+      parse_config_file(st.include_target)
+    elif st.kind == "macro":
+      macro(st.name, st.value)
+    else:
+      bind(st.name, st.param, st.value, scope=st.scope,
+           location=st.location if path else None)
 
 
 def parse_config_file(path: str) -> None:
   with open(path) as f:
-    parse_config(f.read(), base_dir=os.path.dirname(path))
+    parse_config(f.read(), base_dir=os.path.dirname(path), path=path)
 
 
 def parse_config_files_and_bindings(
@@ -509,7 +675,10 @@ def _format_value(value: Any) -> str:
     return f"@{value._configurable_name}"
   if isinstance(value, (list, tuple)):
     inner = ", ".join(_format_value(v) for v in value)
-    return f"[{inner}]" if isinstance(value, list) else f"({inner})"
+    if isinstance(value, list):
+      return f"[{inner}]"
+    # 1-tuples need the trailing comma or they re-parse as a bare value.
+    return f"({inner},)" if len(value) == 1 else f"({inner})"
   if isinstance(value, dict):
     inner = ", ".join(f"{_format_value(k)}: {_format_value(v)}"
                       for k, v in value.items())

@@ -168,3 +168,127 @@ class TestFilesAndOperative:
   def test_query_parameter(self):
     config.parse_config("lr_schedule.base_lr = 0.5")
     assert config.query_parameter("lr_schedule.base_lr") == 0.5
+
+  def test_operative_round_trip(self):
+    """Dump -> fresh registry -> re-parse -> identical bindings AND an
+    identical second dump (the reproducibility contract behind saving
+    the operative config next to checkpoints)."""
+    config.parse_config("""
+lr_schedule.base_lr = 0.25
+make_optimizer.lr_fn = @lr_schedule
+make_optimizer.momentum = 0.5
+""")
+    make_optimizer()
+    lr_schedule()
+    first = config.operative_config_str()
+    config.clear_config()
+    config.parse_config(first)
+    assert config.query_parameter("lr_schedule.base_lr") == 0.25
+    assert config.query_parameter("make_optimizer.momentum") == 0.5
+    out = make_optimizer()
+    assert out["momentum"] == 0.5
+    assert out["lr_fn"]() == (0.25, 0.99)
+    lr_schedule()
+    second = config.operative_config_str()
+    assert first == second
+
+  def test_operative_round_trip_hash_in_string(self):
+    """'#' inside a quoted string value is data, not a comment — both
+    when parsing and when re-parsing an operative dump."""
+    config.parse_config("lr_schedule.base_lr = 0.5  # real comment")
+    config.parse_config("make_optimizer.lr_fn = '/tmp/run#1'")
+    assert config.query_parameter("make_optimizer.lr_fn") == "/tmp/run#1"
+    make_optimizer()
+    text = config.operative_config_str()
+    config.clear_config()
+    config.parse_config(text)
+    assert make_optimizer()["lr_fn"] == "/tmp/run#1"
+
+  def test_brackets_inside_strings_do_not_continue_lines(self):
+    config.parse_config(
+        "lr_schedule.base_lr = 0.5\nmake_optimizer.lr_fn = '(['\n")
+    assert config.query_parameter("make_optimizer.lr_fn") == "(["
+
+  def test_operative_round_trip_one_tuple(self):
+    """1-tuples must dump with a trailing comma — '(x)' re-parses as a
+    bare value and silently changes the bound type."""
+    config.parse_config("make_optimizer.momentum = ('data',)")
+    make_optimizer()
+    text = config.operative_config_str()
+    config.clear_config()
+    config.parse_config(text)
+    assert make_optimizer()["momentum"] == ("data",)
+
+
+class TestErrorLocations:
+  """ConfigError messages carry config file path:line (shared format
+  with the static analyzer's findings)."""
+
+  def test_parse_error_includes_path_line(self, tmp_path):
+    path = tmp_path / "bad.gin"
+    path.write_text("lr_schedule.base_lr = 0.5\nthis is not a binding\n")
+    with pytest.raises(config.ConfigError,
+                       match=r"bad\.gin:2: Cannot parse"):
+      config.parse_config_file(str(path))
+
+  def test_undefined_macro_error_includes_location(self, tmp_path):
+    path = tmp_path / "macros.gin"
+    path.write_text("\nlr_schedule.base_lr = %MISSING\n")
+    config.parse_config_file(str(path))
+    with pytest.raises(config.ConfigError,
+                       match=r"macros\.gin:2.*Undefined macro %MISSING"):
+      lr_schedule()
+
+  def test_unknown_reference_error_includes_location(self, tmp_path):
+    path = tmp_path / "refs.gin"
+    path.write_text("make_optimizer.lr_fn = @NoSuchConfigurable\n")
+    config.parse_config_file(str(path))
+    with pytest.raises(config.ConfigError, match=r"refs\.gin:1"):
+      make_optimizer()
+
+  def test_unknown_binding_error_includes_location(self, tmp_path):
+    path = tmp_path / "params.gin"
+    path.write_text("# header\nlr_schedule.not_a_param = 1\n")
+    config.parse_config_file(str(path))
+    with pytest.raises(config.ConfigError,
+                       match=r"no parameter.*params\.gin:2"):
+      lr_schedule()
+
+  def test_broken_import_error_includes_location(self, tmp_path):
+    path = tmp_path / "imports.gin"
+    path.write_text("lr_schedule.base_lr = 0.5\nimport not.a.module\n")
+    with pytest.raises(config.ConfigError,
+                       match=r"imports\.gin:2: cannot import"):
+      config.parse_config_file(str(path))
+
+  def test_failing_module_import_error_includes_location(self, tmp_path,
+                                                         monkeypatch):
+    """Not just ImportError: a module whose body raises at import time
+    (the likely failure on a fresh machine) also gets the location."""
+    import sys
+    (tmp_path / "t2r_exploding_mod.py").write_text(
+        "raise RuntimeError('boom at import')\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    path = tmp_path / "imports.gin"
+    path.write_text("import t2r_exploding_mod\n")
+    sys.modules.pop("t2r_exploding_mod", None)
+    with pytest.raises(
+        config.ConfigError,
+        match=r"imports\.gin:1: cannot import .*RuntimeError: boom"):
+      config.parse_config_file(str(path))
+
+  def test_unknown_binding_location_honors_scope(self, tmp_path):
+    """The cited binding is the one active in the current scope, not
+    whichever scope happened to be parsed first."""
+    a = tmp_path / "a.gin"
+    a.write_text("train/lr_schedule.bogus = 1\n")
+    b = tmp_path / "b.gin"
+    b.write_text("eval/lr_schedule.bogus = 2\n")
+    config.parse_config_file(str(a))
+    config.parse_config_file(str(b))
+    with config.config_scope("eval"):
+      with pytest.raises(config.ConfigError, match=r"b\.gin:1"):
+        lr_schedule()
+    with config.config_scope("train"):
+      with pytest.raises(config.ConfigError, match=r"a\.gin:1"):
+        lr_schedule()

@@ -1,0 +1,506 @@
+"""Tests for the graftlint static-analysis subsystem.
+
+Three contracts:
+
+* the repo itself is permanently clean (`test_repo_clean` — tier-1, so
+  any future violation fails the suite);
+* each rule family actually fires on violating fixtures (config /
+  tracer-hygiene / spec-sharding), and the CLI exits non-zero on them;
+* analysis NEVER initializes a JAX backend: the CLI runs over the whole
+  repo in a subprocess whose JAX_PLATFORMS names a nonexistent platform
+  — any backend init raises immediately (and over the real axon tunnel
+  would instead risk wedging TPU hardware).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import specs
+from tensor2robot_tpu.analysis import (config_check, findings as findings_lib,
+                                       lint, spec_check, tracer_check)
+from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils import mocks  # registers MockT2RModel  # noqa: F401
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT_PATHS = [os.path.join(REPO_ROOT, "tensor2robot_tpu"),
+              os.path.join(REPO_ROOT, "scripts")]
+
+MESH_AXES = {"data", "fsdp", "model"}
+
+
+def _rules(findings):
+  return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# The repo is clean, and stays clean.
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean():
+  findings = lint.run(LINT_PATHS)
+  assert not findings, "graftlint findings in the repo:\n" + "\n".join(
+      str(f) for f in findings)
+
+
+def test_list_rules_runs():
+  assert lint.main(["--list-rules"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Config rule family.
+# ---------------------------------------------------------------------------
+
+
+def _check_gin(tmp_path, text, name="fixture.gin"):
+  path = tmp_path / name
+  path.write_text(text)
+  return config_check.check_config_file(str(path))
+
+
+def test_config_unknown_configurable(tmp_path):
+  out = _check_gin(tmp_path, "TotallyUnknownThing.param = 1\n")
+  assert _rules(out) == {"unknown-configurable"}
+  assert out[0].line == 1
+
+
+def test_config_missing_import(tmp_path):
+  # MockT2RModel IS registered in this test process (imported above), but
+  # the config has no import line covering utils.mocks — a fresh trainer
+  # process would fail to resolve it. The static closure catches that.
+  out = _check_gin(tmp_path, "MockT2RModel.device_type = 'cpu'\n")
+  assert _rules(out) == {"missing-import"}
+  assert "tensor2robot_tpu.utils.mocks" in out[0].message
+
+
+def test_config_import_line_covers(tmp_path):
+  out = _check_gin(tmp_path,
+                   "import tensor2robot_tpu.utils.mocks\n"
+                   "MockT2RModel.device_type = 'cpu'\n")
+  assert not out
+
+
+def test_config_unknown_parameter(tmp_path):
+  # MockInputGenerator has a closed signature; MockT2RModel would NOT
+  # flag (it forwards **kwargs, so any parameter name is plausible).
+  out = _check_gin(tmp_path,
+                   "import tensor2robot_tpu.utils.mocks\n"
+                   "MockInputGenerator.not_a_real_parameter = 3\n")
+  assert _rules(out) == {"unknown-parameter"}
+  assert out[0].line == 2
+  out = _check_gin(tmp_path,
+                   "import tensor2robot_tpu.utils.mocks\n"
+                   "MockT2RModel.not_a_real_parameter = 3\n",
+                   name="kwargs.gin")
+  assert not out
+
+
+def test_config_duplicate_binding(tmp_path):
+  out = _check_gin(tmp_path,
+                   "train_eval_model.max_train_steps = 5\n"
+                   "train_eval_model.max_train_steps = 9\n")
+  assert _rules(out) == {"duplicate-binding"}
+  assert out[0].line == 2
+  assert ":1" in out[0].message  # points at the shadowed first binding
+
+
+def test_config_undefined_macro(tmp_path):
+  out = _check_gin(tmp_path,
+                   "train_eval_model.max_train_steps = %NOT_DEFINED\n")
+  assert _rules(out) == {"undefined-macro"}
+
+
+def test_config_defined_macro_ok(tmp_path):
+  out = _check_gin(tmp_path,
+                   "NUM_STEPS = 7\n"
+                   "train_eval_model.max_train_steps = %NUM_STEPS\n")
+  assert not out
+
+
+def test_config_reference_inside_macro_value_checked(tmp_path):
+  # A bad @reference (or %macro) hidden behind a macro definition fails
+  # at resolve time just the same — the checker must look inside macro
+  # values, not only binding RHSs.
+  out = _check_gin(tmp_path,
+                   "MODEL = @NoSuchModelAnywhere\n"
+                   "train_eval_model.model = %MODEL\n")
+  assert _rules(out) == {"unknown-configurable"}
+  out = _check_gin(tmp_path,
+                   "OTHER = %NEVER_DEFINED\n"
+                   "train_eval_model.max_train_steps = %OTHER\n",
+                   name="chain.gin")
+  assert _rules(out) == {"undefined-macro"}
+
+
+def test_config_type_mismatch(tmp_path):
+  out = _check_gin(tmp_path,
+                   "train_eval_model.max_train_steps = 'lots'\n")
+  assert _rules(out) == {"type-mismatch"}
+  out = _check_gin(tmp_path, "train_eval_model.model_dir = 3\n",
+                   name="fixture2.gin")
+  assert _rules(out) == {"type-mismatch"}
+
+
+def test_config_type_ok_int_for_float_and_refs(tmp_path):
+  out = _check_gin(tmp_path,
+                   "train_eval_model.eval_throttle_secs = 5\n"
+                   "train_eval_model.model = @MockT2RModel()\n"
+                   "import tensor2robot_tpu.utils.mocks\n")
+  assert not out
+
+
+def test_config_broken_import(tmp_path):
+  out = _check_gin(tmp_path, "import tensor2robot_tpu.no_such_module\n")
+  assert "broken-import" in _rules(out)
+
+
+def test_config_suppression(tmp_path):
+  out = _check_gin(
+      tmp_path,
+      "TotallyUnknownThing.param = 1  # graftlint: disable=unknown-configurable\n")
+  assert not out
+
+
+def test_config_suppression_multiline_statement(tmp_path):
+  # The finding anchors at the statement's first line; the disable
+  # comment may sit on ANY physical line of the statement.
+  out = _check_gin(
+      tmp_path,
+      "TotallyUnknownThing.param = [\n"
+      "    1,\n"
+      "]  # graftlint: disable=unknown-configurable\n")
+  assert not out
+
+
+def test_config_include_followed(tmp_path):
+  (tmp_path / "base.gin").write_text("UnknownInBase.param = 1\n")
+  out = _check_gin(tmp_path, "include 'base.gin'\n")
+  assert _rules(out) == {"unknown-configurable"}
+  assert out[0].path.endswith("base.gin")
+
+
+def test_config_include_then_override_not_duplicate(tmp_path):
+  # gin's standard idiom: include a base, override its bindings. Only
+  # same-file rebinds are mistakes.
+  (tmp_path / "base.gin").write_text(
+      "train_eval_model.max_train_steps = 5\n")
+  out = _check_gin(tmp_path,
+                   "include 'base.gin'\n"
+                   "train_eval_model.max_train_steps = 9\n")
+  assert not out
+
+
+# ---------------------------------------------------------------------------
+# Tracer-hygiene rule family.
+# ---------------------------------------------------------------------------
+
+
+_TRACER_FIXTURE = """
+import time
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_CENTERS = jnp.array([[1.0]])
+_DEVICES = jax.devices()
+
+def barrier(x):
+  return jax.block_until_ready(x)
+
+@jax.jit
+def step(x, y):
+  t = time.time()
+  z = np.random.rand(3)
+  v = float(x)
+  w = np.asarray(y)
+  return x.sum().item()
+
+def _wrapped(a):
+  return int(a)
+
+wrapped = jax.jit(_wrapped)
+
+@functools.partial(jax.jit, static_argnums=0)
+def step2(n, x):
+  return np.random.randint(0, n)
+"""
+
+
+def test_tracer_rules_fire():
+  out = tracer_check.check_python_source(_TRACER_FIXTURE, "fixture.py")
+  assert _rules(out) == {"import-time-backend", "block-until-ready",
+                         "impure-in-jit", "host-sync-in-jit"}
+  by_rule = {}
+  for f in out:
+    by_rule.setdefault(f.rule, []).append(f)
+  assert len(by_rule["import-time-backend"]) == 2
+  # float(x), np.asarray(y), .item(), int(a) in the jit-wrapped fn.
+  assert len(by_rule["host-sync-in-jit"]) == 4
+  # time.time, np.random.rand, np.random.randint (partial(jax.jit) form).
+  assert len(by_rule["impure-in-jit"]) == 3
+
+
+def test_tracer_clean_outside_jit():
+  src = """
+import jax
+import numpy as np
+
+def fine(x):
+  return float(np.asarray(x).item())
+
+def also_fine():
+  return jax.devices()
+
+if __name__ == "__main__":
+  print(jax.default_backend())
+"""
+  assert not tracer_check.check_python_source(src, "fixture.py")
+
+
+def test_tracer_suppression():
+  src = "import jax\n_D = jax.devices()  # graftlint: disable=import-time-backend\n"
+  assert not tracer_check.check_python_source(src, "fixture.py")
+  src_all = "import jax\n_D = jax.devices()  # graftlint: disable\n"
+  assert not tracer_check.check_python_source(src_all, "fixture.py")
+
+
+def test_tracer_backend_py_exempt():
+  backend_py = os.path.join(REPO_ROOT, "tensor2robot_tpu", "utils",
+                            "backend.py")
+  assert not tracer_check.check_python_file(backend_py)
+  # The same source under any other path WOULD flag block_until_ready if
+  # it called it; prove the exemption is the path, not the content.
+  src = "import jax\ndef f(x):\n  return jax.block_until_ready(x)\n"
+  assert _rules(tracer_check.check_python_source(src, "other.py")) == {
+      "block-until-ready"}
+
+
+def test_tracer_import_time_default_arg():
+  src = "import jax.numpy as jnp\ndef f(x=jnp.zeros(3)):\n  return x\n"
+  out = tracer_check.check_python_source(src, "fixture.py")
+  assert _rules(out) == {"import-time-backend"}
+
+
+def test_tracer_import_time_decorator():
+  # Decorator expressions execute at import time, exactly like the
+  # grasp2vec module constant this PR fixed.
+  src = ("import functools\n"
+         "import jax.numpy as jnp\n"
+         "def register(fn, table):\n"
+         "  return fn\n"
+         "@functools.partial(register, table=jnp.eye(3))\n"
+         "def f(x):\n"
+         "  return x\n")
+  out = tracer_check.check_python_source(src, "fixture.py")
+  assert _rules(out) == {"import-time-backend"}
+  # ...but a plain @jax.jit decorator is lazy and must NOT flag.
+  src_ok = "import jax\n@jax.jit\ndef f(x):\n  return x\n"
+  assert not tracer_check.check_python_source(src_ok, "fixture.py")
+
+
+def test_tracer_suppression_multiline_call():
+  src = ("import jax\n"
+         "_D = jax.devices(\n"
+         ")  # graftlint: disable=import-time-backend\n")
+  assert not tracer_check.check_python_source(src, "fixture.py")
+
+
+# ---------------------------------------------------------------------------
+# Spec/sharding rule family.
+# ---------------------------------------------------------------------------
+
+
+def test_spec_static_rules():
+  src = """
+from tensor2robot_tpu import specs
+
+GOOD = specs.TensorSpec(shape=(8, 4), sharding=(None, 'model'))
+BAD_AXIS = specs.TensorSpec(shape=(8, 4), sharding=(None, 'modle'))
+DUP = specs.TensorSpec(shape=(8, 4), sharding=('model', 'model'))
+LONG = specs.TensorSpec(shape=(8,), sharding=('data', 'model'))
+"""
+  out = spec_check.check_python_source(src, "fixture.py", MESH_AXES)
+  assert _rules(out) == {"unknown-mesh-axis", "duplicate-sharding-axis",
+                         "sharding-rank-mismatch"}
+  assert len(out) == 3
+
+
+def test_spec_suppression_multiline_call():
+  src = ("from tensor2robot_tpu import specs\n"
+         "S = specs.TensorSpec(\n"
+         "    shape=(4,),\n"
+         "    sharding=('custom',))  # graftlint: disable=unknown-mesh-axis\n")
+  assert not spec_check.check_python_source(src, "fixture.py", MESH_AXES)
+
+
+def test_spec_axes_from_configs_extend_vocabulary(tmp_path):
+  gin = tmp_path / "mesh.gin"
+  gin.write_text("train_eval_model.mesh_axis_names = ('data', 'sp', 'model')\n")
+  axes = spec_check.known_mesh_axes([str(gin)])
+  assert {"data", "fsdp", "model", "sp"} <= axes
+  src = "from tensor2robot_tpu import specs\n" \
+        "S = specs.TensorSpec(shape=(4, 4), sharding=('sp', None))\n"
+  assert not spec_check.check_python_source(src, "fixture.py", axes)
+
+
+def test_spec_structure_checker_conflict():
+  feature = specs.SpecStruct()
+  feature["state/obs"] = specs.TensorSpec(shape=(8, 4),
+                                          sharding=(None, "model"))
+  label = specs.SpecStruct()
+  label["state/obs"] = specs.TensorSpec(shape=(8, 4),
+                                        sharding=("model", None))
+  out = spec_check.check_spec_structures(feature, label,
+                                         mesh_axes=MESH_AXES)
+  assert _rules(out) == {"sharding-conflict"}
+  ok = spec_check.check_spec_structures(feature, feature,
+                                        mesh_axes=MESH_AXES)
+  assert not ok
+
+
+def test_spec_structure_checker_unknown_axis():
+  feature = specs.SpecStruct()
+  feature["x"] = specs.TensorSpec(shape=(4,), sharding=("bogus",))
+  out = spec_check.check_spec_structures(feature, mesh_axes=MESH_AXES)
+  assert _rules(out) == {"unknown-mesh-axis"}
+
+
+def test_sharding_axes_helper():
+  struct = specs.SpecStruct()
+  struct["a"] = specs.TensorSpec(shape=(4, 2), sharding=(None, "model"))
+  struct["b/c"] = specs.TensorSpec(shape=(3,))
+  axes = specs.sharding_axes(struct)
+  assert dict(axes) == {"a": (None, "model")}
+
+
+# ---------------------------------------------------------------------------
+# CLI contract: exit codes + no backend init.
+# ---------------------------------------------------------------------------
+
+
+def test_cli_nonzero_on_violations(tmp_path, capsys):
+  bad_dir = tmp_path / "badcode"
+  bad_dir.mkdir()
+  (bad_dir / "bad_config.gin").write_text("NopeNotAThing.x = 1\n")
+  (bad_dir / "bad_tracer.py").write_text(
+      "import jax\n_D = jax.devices()\n")
+  (bad_dir / "bad_spec.py").write_text(
+      "from tensor2robot_tpu import specs\n"
+      "S = specs.TensorSpec(shape=(4,), sharding=('nope',))\n")
+  rc = lint.main([str(bad_dir)])
+  assert rc == 1
+  printed = capsys.readouterr().out
+  for rule in ("unknown-configurable", "import-time-backend",
+               "unknown-mesh-axis"):
+    assert rule in printed, printed
+
+
+def test_cli_zero_on_clean_file(tmp_path):
+  clean = tmp_path / "clean.py"
+  clean.write_text("import numpy as np\n\nX = np.zeros(3)\n")
+  assert lint.main([str(clean)]) == 0
+
+
+def test_cli_single_file_sees_repo_axis_vocabulary(tmp_path):
+  """Linting one .py must validate sharding against the axes the repo's
+  shipped configs declare (e.g. 'sp'), not just DEFAULT_AXES — a
+  per-file run may not contradict the full-repo run."""
+  model = tmp_path / "model.py"
+  model.write_text(
+      "from tensor2robot_tpu import specs\n"
+      "S = specs.TensorSpec(shape=(4, 4), sharding=('sp', None))\n")
+  assert lint.main([str(model)]) == 0
+
+
+def test_cli_missing_path(tmp_path):
+  assert lint.main([str(tmp_path / "nope")]) == 2
+
+
+def test_cli_unsupported_file_type_is_an_error(tmp_path):
+  """An explicitly named non-.py/.gin file must not silently read as
+  'clean'."""
+  script = tmp_path / "thing.sh"
+  script.write_text("echo hi\n")
+  assert lint.main([str(script)]) == 2
+
+
+def test_lint_never_initializes_backend():
+  """Acceptance: full-repo lint in a fresh process must create NO jax
+  backend. Two independent layers: (a) the child asserts jax's live
+  backend cache is still empty after the full run — direct evidence,
+  valid even where env-var pinning is unreliable (CLAUDE.md: the axon
+  hook can override it); (b) JAX_PLATFORMS names a nonexistent platform
+  so any init that does slip through raises instead of ever touching
+  hardware (and the child can therefore never hang mid TPU-client-init,
+  making the subprocess timeout safe)."""
+  code = """
+import sys
+from tensor2robot_tpu.analysis import lint
+rc = lint.main(["tensor2robot_tpu", "scripts"])
+from jax._src import xla_bridge
+live = getattr(xla_bridge, "_backends", None)
+assert not live, f"jax backends were initialized: {sorted(live)}"
+print("NO_BACKEND_OK")
+sys.exit(rc)
+"""
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "graftlint_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run(
+      [sys.executable, "-c", code],
+      capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "NO_BACKEND_OK" in result.stdout
+
+
+def test_package_import_is_backend_free():
+  """Regression for the grasp2vec losses import-time jnp.array: every
+  package module must import without initializing a backend."""
+  code = """
+import importlib, pkgutil, sys
+import tensor2robot_tpu
+skip = {"tensor2robot_tpu.bin", "tensor2robot_tpu.native"}
+failed = []
+for m in pkgutil.walk_packages(tensor2robot_tpu.__path__, "tensor2robot_tpu."):
+    if any(m.name == s or m.name.startswith(s + ".") for s in skip):
+        continue  # bins re-define absl flags; native .so is not importable
+    try:
+        importlib.import_module(m.name)
+    except Exception as e:
+        failed.append(f"{m.name}: {type(e).__name__}: {e}")
+assert not failed, "\\n".join(failed)
+from jax._src import xla_bridge
+live = getattr(xla_bridge, "_backends", None)
+assert not live, f"jax backends were initialized: {sorted(live)}"
+print("OK")
+"""
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "graftlint_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=REPO_ROOT, env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "OK" in result.stdout
+
+
+def test_grasp2vec_quadrant_centers_is_host_constant():
+  """The fixed violation stays fixed in-process too: the module constant
+  must be a host numpy array, not a device array."""
+  from tensor2robot_tpu.research.grasp2vec import losses
+
+  assert type(losses._QUADRANT_CENTERS) is np.ndarray
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
