@@ -49,6 +49,7 @@ import sys
 import tempfile
 import time
 
+from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.utils import backend as backend_lib
 
 BASELINE_PER_CHIP = 400.0  # est. V100-class grasps/sec/device (see docstring)
@@ -420,6 +421,37 @@ def _ab_local_compile(batch_size: int) -> None:
   print(json.dumps(dict(rec, compile_mode="local")))
 
 
+def _record_probe(rec: dict) -> dict:
+  """Feeds one probe outcome through the graftscope metrics registry.
+
+  Every BENCH_*.json record since this landed carries the same
+  `graftscope` block (see `_graftscope_block`), so driver-side tooling
+  can consume probe accounting without parsing stderr.
+  """
+  if rec.get("timeout"):
+    obs_metrics.counter("bench/probes_timeout").inc()
+  elif rec.get("ok"):
+    obs_metrics.counter("bench/probes_ok").inc()
+    obs_metrics.histogram("bench/probe_examples_per_sec").record(
+        rec["examples_per_sec"])
+    obs_metrics.histogram("bench/probe_step_ms").record(
+        rec["step_sec"] * 1e3)
+  else:
+    obs_metrics.counter("bench/probes_failed").inc()
+  return rec
+
+
+def _graftscope_block() -> dict:
+  """Stable telemetry schema for the headline JSON: probe counters are
+  pre-created so the keys exist even on a zero-probe (CPU-fallback)
+  run."""
+  for name in ("bench/probes_ok", "bench/probes_failed",
+               "bench/probes_timeout"):
+    obs_metrics.counter(name)
+  return {"schema": "graftscope-bench-v1",
+          "metrics": obs_metrics.snapshot(prefix="bench/")}
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
@@ -429,7 +461,8 @@ def main() -> None:
     return
   best = None
   if backend_lib.accelerator_healthy():
-    best = autotune(_subprocess_probe)
+    best = autotune(lambda b, remat, s2d: _record_probe(
+        _subprocess_probe(b, remat, s2d)))
   if best is not None:
     # Efficiency accounting: achieved model FLOP/s over the device peak
     # (MFU a.k.a. MXU utilization) and HBM bytes per step, both from the
@@ -462,6 +495,7 @@ def main() -> None:
         "bytes_per_step": best.get("bytes_accessed"),
         "device_kind": best.get("device_kind"),
         "probes_aborted": best["aborted"],
+        "graftscope": _graftscope_block(),
     }))
     return
   # Device backend unreachable (or every TPU probe failed): CPU smoke
@@ -471,7 +505,8 @@ def main() -> None:
   # measured for this exact config on this host during round 1
   # (3643 examples/sec), so vs_baseline ~= 1.0 means "no regression vs
   # the recorded CPU baseline", nothing more.
-  rec = probe_main({"platform": "cpu", "batch_size": 16, "reruns": 3})
+  rec = _record_probe(
+      probe_main({"platform": "cpu", "batch_size": 16, "reruns": 3}))
   cpu_anchor = 3643.0  # recorded for this exact config at batch 16
   print(json.dumps({
       "metric": "qtopt_grasps_per_sec_cpu_smoke",
@@ -479,6 +514,7 @@ def main() -> None:
       "unit": "examples/sec",
       "vs_baseline": round(rec["examples_per_sec"] / cpu_anchor, 3),
       "batch_size": rec["batch_size"],
+      "graftscope": _graftscope_block(),
   }))
 
 

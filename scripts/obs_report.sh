@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# graftscope reader wrapper: summarize a model_dir's telemetry, CPU-pinned.
+#
+# The reader never uses a JAX backend, but this machine's environment
+# forces JAX_PLATFORMS=axon (TPU tunnel) and a wedged tunnel hangs any
+# accidental backend init forever. The env var alone is NOT enough under
+# the axon hook (CLAUDE.md), so pin through the one shared
+# implementation, utils.backend.pin_cpu (env var + jax.config.update) —
+# the same belt-and-braces recipe as scripts/lint.sh.
+#
+# Usage: scripts/obs_report.sh <model_dir> [--top N]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -c '
+import sys
+from tensor2robot_tpu.utils import backend
+backend.pin_cpu()
+from tensor2robot_tpu.bin import graftscope
+sys.exit(graftscope.main(sys.argv[1:]))
+' "$@"

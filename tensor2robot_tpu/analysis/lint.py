@@ -53,6 +53,10 @@ tracer rules (.py):
                          values inside a jitted function
   impure-in-jit          time.time / stateful np.random inside a jitted
                          function
+  device-timing          time.time/perf_counter window around device
+                         dispatch without a host-fetch barrier (measures
+                         dispatch, not execution, over the tunnel);
+                         obs/ and utils/backend.py are exempt
 
 spec rules (.py):
   unknown-mesh-axis      TensorSpec.sharding names an undeclared axis

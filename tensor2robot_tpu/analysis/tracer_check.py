@@ -19,6 +19,16 @@ Mechanizes the CLAUDE.md tunnel rules so they are enforced, not remembered:
   constant-folds under tracing.
 * `impure-in-jit`       — `time.time`-family calls or stateful global
   `np.random.*` inside a traced function: traced once, frozen forever.
+* `device-timing`       — a `time.time()`/`time.perf_counter()` clock
+  pair (``t0 = time.perf_counter()`` … ``time.perf_counter() - t0``)
+  whose window contains a device-dispatching call (`jnp.*`,
+  `jax.lax.*`, `jax.device_put`, …) but no host-fetch barrier
+  (`backend.sync`/`state_barrier`, `np.asarray`, `jax.device_get`,
+  `.item()`, `float()`): over the axon tunnel that measures DISPATCH,
+  not execution (NOTES_r2.md: a 58 ms step "completed" in 0.9 ms).
+  `obs/` and `utils/backend.py` are exempt — they are the two places
+  allowed to own clocks around device code (the barrier discipline
+  lives there).
 
 A function is "traced" when decorated with `jax.jit`/`pjit` (directly or
 via `functools.partial`), or passed by name/lambda to a `jax.jit(...)` /
@@ -65,6 +75,16 @@ _NP_RANDOM_SAFE = {
 }
 _HOST_CONVERTERS = {"float", "int", "bool"}
 _NP_HOST_CONVERTERS = {"numpy.asarray", "numpy.array", "numpy.asanyarray"}
+
+# device-timing rule vocabulary: calls that dispatch device work (async
+# over the tunnel) vs calls that establish device completion on the host.
+_DISPATCH_CALLS = {"jax.device_put"}
+_BARRIER_CALLS = _NP_HOST_CONVERTERS | {"jax.device_get", "float", "int"}
+# Method/attribute names that barrier regardless of the object they hang
+# off (backend.sync, backend_lib.state_barrier, arr.item(), and the
+# backend timing helpers, which barrier internally).
+_BARRIER_ATTRS = {"sync", "state_barrier", "block_until_ready", "item",
+                  "time_op", "time_train_steps", "time_train_steps_halves"}
 
 
 def _import_aliases(tree: ast.AST) -> Dict[str, str]:
@@ -261,8 +281,79 @@ def _check_import_time(tree: ast.Module, aliases: Dict[str, str],
     _flag_calls(stmt)
 
 
+def _check_device_timing(tree: ast.Module, aliases: Dict[str, str],
+                         path: str, findings: List[Finding]) -> None:
+  """Flags host-clock windows around un-barriered device dispatches.
+
+  Pattern: ``t0 = time.perf_counter()`` … ``time.perf_counter() - t0``
+  within one scope, with a device-dispatching call between the two clock
+  reads and no host-fetch barrier. Each function is its own scope
+  (nested defs do not execute inside the enclosing window)."""
+
+  def _is_clock_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _qualified(node.func, aliases) in _TIME_CALLS)
+
+  def _scope_statements(scope: ast.AST):
+    """Yields every node in the scope, skipping nested function bodies."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+      node = stack.pop()
+      yield node
+      if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+        stack.extend(ast.iter_child_nodes(node))
+
+  def _check_scope(scope: ast.AST) -> None:
+    clock_assigns: Dict[str, List[int]] = {}
+    closes: List[tuple] = []  # (varname, line, end_line)
+    calls: List[tuple] = []  # (line, qualified, attr_name)
+    for node in _scope_statements(scope):
+      if (isinstance(node, ast.Assign) and _is_clock_call(node.value)
+          and len(node.targets) == 1
+          and isinstance(node.targets[0], ast.Name)):
+        clock_assigns.setdefault(node.targets[0].id, []).append(node.lineno)
+      elif (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)
+            and isinstance(node.right, ast.Name)
+            and (_is_clock_call(node.left)
+                 or isinstance(node.left, ast.Name))):
+        closes.append((node.right.id, node.lineno,
+                       getattr(node, "end_lineno", 0) or node.lineno))
+      if isinstance(node, ast.Call):
+        attr = (node.func.attr
+                if isinstance(node.func, ast.Attribute) else None)
+        calls.append((node.lineno, _qualified(node.func, aliases), attr))
+    for var, line, end_line in closes:
+      starts = [s for s in clock_assigns.get(var, []) if s < line]
+      if not starts:
+        continue
+      start = max(starts)
+      window = [(q, attr) for (call_line, q, attr) in calls
+                if start < call_line <= end_line]
+      dispatches = [q for q, _ in window if q is not None
+                    and (q in _DISPATCH_CALLS
+                         or q.startswith(_BACKEND_PREFIXES))]
+      barriered = any((q in _BARRIER_CALLS if q is not None else False)
+                      or attr in _BARRIER_ATTRS for q, attr in window)
+      if dispatches and not barriered:
+        findings.append(Finding(
+            path, line, "device-timing",
+            f"host-clock window (since line {start}) times "
+            f"{dispatches[0]}() without a host-fetch barrier — over the "
+            "axon tunnel this measures dispatch, not execution; use "
+            "tensor2robot_tpu.utils.backend.time_op / "
+            "time_train_steps (or end the window with backend.sync / "
+            "np.asarray)", end_line=end_line))
+
+  _check_scope(tree)
+  for node in ast.walk(tree):
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+      _check_scope(node)
+
+
 def check_python_source(text: str, path: str,
-                        allow_block_until_ready: bool = False
+                        allow_block_until_ready: bool = False,
+                        allow_device_timing: bool = False
                         ) -> List[Finding]:
   """Lints one Python source; returns (suppression-filtered) findings."""
   try:
@@ -272,6 +363,9 @@ def check_python_source(text: str, path: str,
                     f"syntax error: {e.msg}")]
   aliases = _import_aliases(tree)
   findings: List[Finding] = []
+
+  if not allow_device_timing:
+    _check_device_timing(tree, aliases, path, findings)
 
   if not allow_block_until_ready:
     for node in ast.walk(tree):
@@ -305,6 +399,11 @@ def check_python_source(text: str, path: str,
 
 
 def check_python_file(path: str) -> List[Finding]:
-  allow = path.replace("\\", "/").endswith("utils/backend.py")
+  norm = path.replace("\\", "/")
+  allow = norm.endswith("utils/backend.py")
+  # obs/ owns the instrumentation clocks (its windows end in barriers by
+  # design); backend.py owns the shared timing recipes.
+  allow_timing = allow or "/obs/" in norm or norm.startswith("obs/")
   with open(path) as f:
-    return check_python_source(f.read(), path, allow_block_until_ready=allow)
+    return check_python_source(f.read(), path, allow_block_until_ready=allow,
+                               allow_device_timing=allow_timing)

@@ -1,0 +1,245 @@
+"""graftscope reader CLI: summarize a model_dir's telemetry as text.
+
+The write side lives in `tensor2robot_tpu/obs/` (span tracer, metrics
+registry, step stats — see docs/ARCHITECTURE.md "Observability"); this
+is the read side: it walks a model_dir for `metrics.jsonl` event
+streams, Chrome trace JSONs (`trace.graftscope.json`), and
+`jax.profiler` dirs, and renders a step-time breakdown table, counter
+totals, and the slowest spans.
+
+Usage:
+  python -m tensor2robot_tpu.bin.graftscope <model_dir>
+  python -m tensor2robot_tpu.bin.graftscope <model_dir> --top 20
+  scripts/obs_report.sh <model_dir>      # CPU-pinned wrapper
+
+Backend-free by construction (argparse, stdlib + numpy only): like the
+`analysis/` CLIs it must be safe to run on the tunnel machine while a
+training job owns the TPU — tests/test_observability.py runs it under a
+poisoned JAX_PLATFORMS to prove no backend is touched.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from tensor2robot_tpu.obs import metrics as metrics_lib
+
+__all__ = ["build_report", "main"]
+
+_SKIP_DIRS = {"checkpoints", "__pycache__", ".git"}
+# Per-step record signature written by obs.stepstats via StepStatsHook.
+_STEP_KEYS = ("data_wait_ms", "device_ms", "examples_per_sec")
+_BREAKDOWN_ROWS = ("step_ms", "device_ms", "data_wait_ms", "host_ms",
+                   "dispatch_ms")
+
+
+def _discover(model_dir: str) -> Tuple[List[str], List[str], List[str]]:
+  """(metrics.jsonl files, chrome-trace JSONs, jax.profiler dirs)."""
+  metrics_files: List[str] = []
+  trace_files: List[str] = []
+  profile_dirs: List[str] = []
+  for dirpath, dirnames, filenames in os.walk(model_dir):
+    dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+    for name in sorted(filenames):
+      path = os.path.join(dirpath, name)
+      if name == "metrics.jsonl":
+        metrics_files.append(path)
+      elif name.endswith(".json") and "trace" in name:
+        trace_files.append(path)
+    if (os.path.basename(dirpath) == "profile"
+        or "plugins" in dirnames):  # jax.profiler writes plugins/profile
+      profile_dirs.append(dirpath)
+  return metrics_files, trace_files, sorted(set(profile_dirs))
+
+
+def _load_jsonl(path: str) -> List[dict]:
+  records = []
+  with open(path) as f:
+    for line in f:
+      line = line.strip()
+      if not line:
+        continue
+      try:
+        records.append(json.loads(line))
+      except ValueError:
+        continue  # torn tail line of a live run
+  return records
+
+
+def _split_records(records: List[dict]
+                   ) -> Tuple[List[dict], Dict[str, float]]:
+  """(step-stats records, merged registry-snapshot values)."""
+  step_records = []
+  snapshot: Dict[str, float] = {}
+  for record in records:
+    if all(k in record for k in _STEP_KEYS):
+      step_records.append(record)
+    for key, value in record.items():
+      if key.startswith(("counter/", "gauge/", "hist/")):
+        snapshot[key] = value  # later snapshots win (counters grow)
+  return step_records, snapshot
+
+
+def _breakdown_table(step_records: List[dict]) -> List[str]:
+  steps = [r.get("step") for r in step_records if "step" in r]
+  lines = [f"step-time breakdown ({len(step_records)} records, "
+           f"steps {min(steps)}..{max(steps)})" if steps else
+           "step-time breakdown (no step records)"]
+  header = f"  {'metric':<14}{'mean':>10}{'p50':>10}{'p90':>10}{'p99':>10}"
+  lines.append(header)
+  for key in _BREAKDOWN_ROWS:
+    values = [float(r[key]) for r in step_records if key in r]
+    if not values:
+      continue
+    p50, p90, p99 = metrics_lib.percentiles(values)
+    mean = sum(values) / len(values)
+    lines.append(f"  {key:<14}{mean:>10.2f}{p50:>10.2f}{p90:>10.2f}"
+                 f"{p99:>10.2f}")
+  eps = [float(r["examples_per_sec"]) for r in step_records
+         if "examples_per_sec" in r]
+  if eps:
+    lines.append(f"  throughput: mean {sum(eps) / len(eps):.1f} "
+                 f"examples/sec (max {max(eps):.1f})")
+  compiles = sum(int(r.get("compile", 0)) for r in step_records)
+  lines.append(f"  compile events: {compiles}")
+  return lines
+
+
+def _counter_lines(snapshot: Dict[str, float]) -> List[str]:
+  counters = {k[len("counter/"):]: v for k, v in snapshot.items()
+              if k.startswith("counter/")}
+  if not counters:
+    return []
+  lines = ["counter totals"]
+  for name in sorted(counters):
+    lines.append(f"  {name:<36}{counters[name]:>12.0f}")
+  return lines
+
+
+def _gauge_lines(snapshot: Dict[str, float]) -> List[str]:
+  gauges = {k[len("gauge/"):]: v for k, v in snapshot.items()
+            if k.startswith("gauge/")}
+  if not gauges:
+    return []
+  lines = ["gauges (last value)"]
+  for name in sorted(gauges):
+    lines.append(f"  {name:<36}{gauges[name]:>14.2f}")
+  return lines
+
+
+def _hist_lines(snapshot: Dict[str, float]) -> List[str]:
+  """hist/<name>/<stat> snapshot entries regrouped per histogram."""
+  hists: Dict[str, Dict[str, float]] = {}
+  for key, value in snapshot.items():
+    if key.startswith("hist/"):
+      name, _, stat = key[len("hist/"):].rpartition("/")
+      hists.setdefault(name, {})[stat] = value
+  if not hists:
+    return []
+  lines = ["histograms",
+           f"  {'name':<28}{'count':>8}{'mean':>10}{'p50':>10}"
+           f"{'p90':>10}{'p99':>10}"]
+  for name in sorted(hists):
+    h = hists[name]
+    lines.append(
+        f"  {name:<28}{h.get('count', 0):>8.0f}{h.get('mean', 0):>10.2f}"
+        f"{h.get('p50', 0):>10.2f}{h.get('p90', 0):>10.2f}"
+        f"{h.get('p99', 0):>10.2f}")
+  return lines
+
+
+def _span_lines(trace_files: List[str], top: int) -> List[str]:
+  spans: Dict[str, List[float]] = {}
+  loaded = []
+  for path in trace_files:
+    try:
+      with open(path) as f:
+        payload = json.load(f)
+    except (OSError, ValueError):
+      continue
+    events = payload.get("traceEvents", payload) \
+        if isinstance(payload, dict) else payload
+    if not isinstance(events, list):
+      continue
+    loaded.append(path)
+    for event in events:
+      if isinstance(event, dict) and event.get("ph") == "X":
+        spans.setdefault(event.get("name", "?"), []).append(
+            float(event.get("dur", 0.0)) / 1e3)  # us -> ms
+  if not loaded:
+    return []
+  lines = [f"slowest spans (by total time, {len(loaded)} trace file(s) — "
+           "open in https://ui.perfetto.dev)"]
+  lines.append(f"  {'span':<28}{'count':>8}{'total_ms':>12}{'max_ms':>10}")
+  ranked = sorted(spans.items(), key=lambda kv: -sum(kv[1]))[:top]
+  for name, durs in ranked:
+    lines.append(f"  {name:<28}{len(durs):>8}{sum(durs):>12.2f}"
+                 f"{max(durs):>10.2f}")
+  return lines
+
+
+def build_report(model_dir: str, top: int = 10) -> Optional[str]:
+  """Renders the text report; None when no telemetry exists at all."""
+  metrics_files, trace_files, profile_dirs = _discover(model_dir)
+  sections: List[List[str]] = []
+  all_records: List[dict] = []
+  for path in metrics_files:
+    all_records.extend(_load_jsonl(path))
+  step_records, snapshot = _split_records(all_records)
+  if step_records:
+    sections.append(_breakdown_table(step_records))
+  counter_sec = _counter_lines(snapshot)
+  if counter_sec:
+    sections.append(counter_sec)
+  gauge_sec = _gauge_lines(snapshot)
+  if gauge_sec:
+    sections.append(gauge_sec)
+  hist_sec = _hist_lines(snapshot)
+  if hist_sec:
+    sections.append(hist_sec)
+  span_sec = _span_lines(trace_files, top)
+  if span_sec:
+    sections.append(span_sec)
+  if profile_dirs:
+    sections.append(["jax.profiler traces (TensorBoard/Perfetto)"]
+                    + [f"  {d}" for d in profile_dirs])
+  if not metrics_files and not trace_files and not profile_dirs:
+    return None
+  head = [f"graftscope report: {model_dir}",
+          f"  {len(metrics_files)} metrics.jsonl file(s), "
+          f"{len(all_records)} records, {len(trace_files)} trace file(s)"]
+  if not sections:
+    sections = [["(telemetry files present but no graftscope records — "
+                 "was the run made with step_stats_every_n_steps=0?)"]]
+  return "\n\n".join("\n".join(s) for s in [head] + sections) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope",
+      description="Summarize graftscope telemetry (metrics.jsonl + "
+                  "trace JSON) under a model_dir into a text report.")
+  parser.add_argument("model_dir", help="train/eval output directory")
+  parser.add_argument("--top", type=int, default=10,
+                      help="span rows in the slowest-spans table")
+  args = parser.parse_args(argv)
+  if not os.path.isdir(args.model_dir):
+    print(f"graftscope: no such directory: {args.model_dir}",
+          file=sys.stderr)
+    return 2
+  report = build_report(args.model_dir, top=args.top)
+  if report is None:
+    print(f"graftscope: no telemetry under {args.model_dir} "
+          "(no metrics.jsonl, trace JSON, or profiler dirs)",
+          file=sys.stderr)
+    return 1
+  print(report, end="")
+  return 0
+
+
+if __name__ == "__main__":
+  sys.exit(main())

@@ -29,6 +29,8 @@ import numpy as np
 
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import parsing, tfrecord
+from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
 
 __all__ = ["resolve_file_patterns", "RecordBatchPipeline", "prefetch",
@@ -176,13 +178,20 @@ def prefetch(stream: Iterator[Any], size: int = 2) -> Iterator[Any]:
 
   thread = threading.Thread(target=_worker, daemon=True)
   thread.start()
+  # graftscope: how long the consumer stalls on the queue is THE input
+  # pipeline health number (empty queue = host parse can't keep up).
+  wait_hist = obs_metrics.histogram("data/prefetch_wait_ms")
+  batch_counter = obs_metrics.counter("data/batches")
   try:
     while True:
-      item = q.get()
+      with obs_trace.span("data/prefetch_wait", cat="data"), \
+          wait_hist.time_ms():
+        item = q.get()
       if item is _END:
         if error:
           raise error[0]
         return
+      batch_counter.inc()
       yield item
   finally:
     stop.set()

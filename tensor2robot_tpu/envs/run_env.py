@@ -23,6 +23,8 @@ import numpy as np
 from absl import logging
 
 from tensor2robot_tpu.data import replay_writer as writer_lib
+from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import summaries as summaries_lib
 
@@ -50,27 +52,34 @@ def run_env(env=config.REQUIRED,
   episode_lengths: List[int] = []
   q_values: List[float] = []
   for episode_idx in range(num_episodes):
-    policy.reset()
-    obs, _ = env.reset()
-    episode: List[Dict[str, Any]] = []
-    total_reward, steps, done = 0.0, 0, False
-    while not done:
-      action = policy.sample_action(obs, explore_prob=explore_prob)
-      q = getattr(policy, "last_q_value", None)
-      if q is not None:
-        q_values.append(float(q))
-      next_obs, reward, terminated, truncated, info = env.step(action)
-      episode.append({"obs": obs, "action": action, "reward": reward,
-                      "done": terminated or truncated, "info": info})
-      total_reward += float(reward)
-      obs = next_obs
-      steps += 1
-      done = terminated or truncated or (
-          max_episode_steps is not None and steps >= max_episode_steps)
-    episode_rewards.append(total_reward)
-    episode_lengths.append(steps)
-    if replay_writer is not None and episode_to_transitions_fn is not None:
-      replay_writer.write(episode_to_transitions_fn(episode))
+    # graftscope episode-collection span + counters: the 1-10 Hz actor
+    # hot loop is the serving-side twin of the train-step window.
+    with obs_trace.span("env/episode", cat="env", tag=tag,
+                        episode=episode_idx), \
+        obs_metrics.histogram("env/episode_ms").time_ms():
+      policy.reset()
+      obs, _ = env.reset()
+      episode: List[Dict[str, Any]] = []
+      total_reward, steps, done = 0.0, 0, False
+      while not done:
+        action = policy.sample_action(obs, explore_prob=explore_prob)
+        q = getattr(policy, "last_q_value", None)
+        if q is not None:
+          q_values.append(float(q))
+        next_obs, reward, terminated, truncated, info = env.step(action)
+        episode.append({"obs": obs, "action": action, "reward": reward,
+                        "done": terminated or truncated, "info": info})
+        total_reward += float(reward)
+        obs = next_obs
+        steps += 1
+        done = terminated or truncated or (
+            max_episode_steps is not None and steps >= max_episode_steps)
+      episode_rewards.append(total_reward)
+      episode_lengths.append(steps)
+      if replay_writer is not None and episode_to_transitions_fn is not None:
+        replay_writer.write(episode_to_transitions_fn(episode))
+    obs_metrics.counter("env/episodes").inc()
+    obs_metrics.counter("env/steps").inc(steps)
   stats = {
       f"{tag}/episode_reward_mean": float(np.mean(episode_rewards)),
       f"{tag}/episode_reward_std": float(np.std(episode_rewards)),

@@ -29,20 +29,25 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["Hook", "HookBuilder", "ConfigSaverHook", "GoldenValuesHook",
            "VariableLoggerHook", "ExportHook", "DefaultHookBuilder",
-           "AsyncExportHookBuilder", "BestExportHook", "add_golden_outputs"]
+           "AsyncExportHookBuilder", "BestExportHook", "StepStatsHook",
+           "add_golden_outputs"]
 
 
 class TrainContext:
-  """What hooks see: model, dirs, and accessors into the live loop."""
+  """What hooks see: model, dirs, and accessors into the live loop.
+
+  `step_stats` is the loop's live `obs.stepstats.StepStatsRecorder`
+  (None when step telemetry is disabled)."""
 
   def __init__(self, model, model_dir: str,
                get_state: Callable[[], Any],
-               summary_writer=None, mesh=None):
+               summary_writer=None, mesh=None, step_stats=None):
     self.model = model
     self.model_dir = model_dir
     self.get_state = get_state
     self.summary_writer = summary_writer
     self.mesh = mesh
+    self.step_stats = step_stats
 
 
 class Hook:
@@ -147,6 +152,47 @@ class VariableLoggerHook(Hook):
     for path, leaf in leaves[:self._max]:
       logging.info("  %s %s |x|=%.4f", jax.tree_util.keystr(path),
                    tuple(leaf.shape), float(jax.numpy.linalg.norm(leaf)))
+
+
+@config.configurable
+class StepStatsHook(Hook):
+  """Emits graftscope step records through the run's `SummaryWriter`.
+
+  The loop-side measurement lives in `obs.stepstats.StepStatsRecorder`
+  (attached to `TrainContext.step_stats` by `train_eval_model`); this
+  hook is the write path: per-step records into `metrics.jsonl`, a final
+  metrics-registry snapshot, and the Chrome trace JSON next to it
+  (`trace.graftscope.json` — open in Perfetto). Replaces the reference's
+  host_call summary plumbing
+  (/root/reference/models/abstract_model.py:873-936)."""
+
+  def __init__(self, trace_filename: str = "trace.graftscope.json"):
+    self._trace_filename = trace_filename
+
+  def _flush(self, ctx: TrainContext) -> None:
+    if ctx.step_stats is None or ctx.summary_writer is None:
+      return
+    for step, record in ctx.step_stats.drain():
+      ctx.summary_writer.write_scalars(step, record)
+
+  def after_step(self, ctx: TrainContext, step: int, metrics) -> None:
+    self._flush(ctx)
+
+  def end(self, ctx: TrainContext) -> None:
+    from tensor2robot_tpu.obs import metrics as metrics_lib
+    from tensor2robot_tpu.obs import trace as trace_lib
+
+    self._flush(ctx)
+    if ctx.summary_writer is None:
+      return
+    snapshot = metrics_lib.snapshot()
+    if snapshot:
+      step = int(np.asarray(ctx.get_state().step))
+      ctx.summary_writer.write_scalars(step, snapshot)
+    tracer = trace_lib.get_tracer()
+    if tracer.events():
+      log_dir = os.path.dirname(ctx.summary_writer.path)
+      tracer.save(os.path.join(log_dir, self._trace_filename))
 
 
 @config.configurable

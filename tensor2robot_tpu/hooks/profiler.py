@@ -4,6 +4,15 @@ The reference has no in-repo tracing (SURVEY.md §5: only TF summaries +
 TPU host_call). This is the TPU-native upgrade: a windowed
 `jax.profiler` trace (XPlane, viewable in TensorBoard / Perfetto) taken
 after compilation has settled.
+
+Over the axon tunnel the profiler service may simply not exist on the
+remote end — `start_trace` failing must degrade to "no trace", never
+kill a training run: failures are caught, logged ONCE, counted in the
+metrics registry (`counter/profiler/start_failures`), and the hook
+disarms itself. The trace directory is surfaced in the end-of-run
+report: logged at `end()`, recorded as `gauge/profiler/trace_captured`,
+and picked up by `python -m tensor2robot_tpu.bin.graftscope`, which
+lists profiler dirs found under the model_dir.
 """
 
 from __future__ import annotations
@@ -12,6 +21,7 @@ import os
 from typing import Optional
 
 from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.utils import config
 
 __all__ = ["ProfilerHook", "ProfilerHookBuilder"]
@@ -27,25 +37,63 @@ class ProfilerHook(hooks_lib.Hook):
     self._end_step = start_step + num_steps
     self._subdir = subdir
     self._active = False
+    self._failed = False
+    self._trace_dir: Optional[str] = None
+
+  def _stop_trace(self) -> None:
+    import jax
+
+    try:
+      jax.profiler.stop_trace()
+    except Exception as e:  # noqa: BLE001 - a half-started trace must
+      # not kill the run at the stop edge either.
+      from absl import logging
+
+      logging.warning("ProfilerHook: stop_trace failed (%s: %s)",
+                      type(e).__name__, e)
+      self._trace_dir = None
+    self._active = False
 
   def after_step(self, ctx, step, metrics) -> None:
     import jax
 
-    if step == self._start_step and not self._active:
+    if step == self._start_step and not self._active and not self._failed:
       log_dir = os.path.join(ctx.model_dir, self._subdir)
       os.makedirs(log_dir, exist_ok=True)
-      jax.profiler.start_trace(log_dir)
+      try:
+        jax.profiler.start_trace(log_dir)
+      except Exception as e:  # noqa: BLE001 - profiler unavailable over
+        # the tunnel: log once, count it, keep training.
+        from absl import logging
+
+        self._failed = True
+        obs_metrics.counter("profiler/start_failures").inc()
+        logging.warning(
+            "ProfilerHook: jax.profiler.start_trace failed (%s: %s); "
+            "continuing WITHOUT a profiler trace — the profiler service "
+            "may be unavailable over the axon tunnel",
+            type(e).__name__, e)
+        return
       self._active = True
+      self._trace_dir = log_dir
     elif self._active and step >= self._end_step:
-      jax.profiler.stop_trace()
-      self._active = False
+      self._stop_trace()
 
   def end(self, ctx) -> None:
     if self._active:
-      import jax
+      self._stop_trace()
+    from absl import logging
 
-      jax.profiler.stop_trace()
-      self._active = False
+    obs_metrics.gauge("profiler/trace_captured").set(
+        1.0 if self._trace_dir else 0.0)
+    if self._trace_dir:
+      logging.info(
+          "ProfilerHook: profiler trace in %s (open in TensorBoard or "
+          "Perfetto; `python -m tensor2robot_tpu.bin.graftscope %s` "
+          "lists it)", self._trace_dir, ctx.model_dir)
+    elif self._failed:
+      logging.info("ProfilerHook: no trace captured (start_trace "
+                   "unavailable this run)")
 
 
 @config.configurable

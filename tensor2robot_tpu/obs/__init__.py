@@ -1,0 +1,28 @@
+"""graftscope: unified tracing, metrics, and step-time telemetry.
+
+The reference's only observability is TF summaries plumbed through TPU
+`host_call` (/root/reference/models/abstract_model.py:873-936). This
+package is the permanent instrumentation layer replacing the ad-hoc
+timing that diagnosed every perf round by hand (PERFORMANCE.md):
+
+* `trace`     — low-overhead span tracer exporting Chrome-trace-event
+  JSON (Perfetto-loadable);
+* `metrics`   — process-wide counters / gauges / streaming histograms,
+  snapshotted into the JSONL event stream (`utils/summaries.py`);
+* `stepstats` — per-train-step breakdown (data-wait vs device time via
+  `utils/backend.sync` semantics, compile-event detection, throughput,
+  live-array gauges).
+
+Backend-free by construction: importing this package (and using trace /
+metrics) never touches a JAX backend — the same discipline as
+`analysis/` (tests/test_observability.py proves it under a poisoned
+JAX_PLATFORMS). Only `stepstats` touches the backend, lazily, from
+inside a live train loop where the backend is already up.
+
+Read telemetry back with `python -m tensor2robot_tpu.bin.graftscope
+<model_dir>` (or `scripts/obs_report.sh`).
+"""
+
+from tensor2robot_tpu.obs import metrics, stepstats, trace
+
+__all__ = ["metrics", "stepstats", "trace"]

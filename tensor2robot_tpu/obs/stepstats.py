@@ -1,0 +1,248 @@
+"""Per-train-step telemetry: data-wait vs device time, compile events.
+
+The reference's TPUEstimator hid the step economics inside
+`iterations_per_loop` host calls (/root/reference/models/
+abstract_model.py:662-834); our explicit loop can measure them — but ONLY
+with the tunnel barrier discipline: `jax.block_until_ready` is NOT a
+barrier over the axon tunnel (returns before the remote computation
+finishes, NOTES_r2.md), so device completion is established the one
+dependable way, a host fetch through `utils.backend.state_barrier`
+(the smallest param leaf depends on the full fwd+bwd+update).
+
+Accounting per measured window (`every_n_steps` dispatches, default 1):
+
+* `data_wait_ms`  — host time staging batches (`data_wait()` windows);
+* `device_ms`     — un-overlapped device wait: dispatch-call time plus
+  the closing barrier fetch. Host staging that overlaps device compute
+  is deliberately NOT charged to the device — the split answers "what
+  is the loop's wall clock spent waiting on";
+* `host_ms`       — the remainder (hooks, metric fetch, logging);
+* `step_ms`       — full window wall time / steps;
+* `examples_per_sec`, `compile` (first dispatch, or a dispatch-time
+  spike: re-trace/re-compile), `live_arrays` / `live_bytes` gauges.
+
+The barrier costs a real host fetch per measured window (~0.1 s over
+the tunnel): use `every_n_steps=1` only for CPU/debug runs and a
+coarser cadence for tunnel training so the fetch amortizes (the
+windowed averages stay exact) — `train_eval_model`'s default picks
+per-step vs log-cadence by backend. Importing this module never
+touches jax (backend access is lazy, from inside a live loop); the
+train-loop integration lives in `train_eval.py` +
+`hooks.core.StepStatsHook`.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import trace as trace_lib
+
+__all__ = ["StepStatsRecorder"]
+
+# A dispatch call taking longer than BOTH this floor and 10x the running
+# median is counted as a compile event (tracing + XLA compile happen
+# synchronously inside the dispatch call; execution is async).
+COMPILE_FLOOR_MS = 50.0
+_COMPILE_SPIKE_FACTOR = 10.0
+_DISPATCH_HISTORY = 32
+
+
+class _WaitTimer:
+  """Accumulates one staging window into the recorder (+ trace span)."""
+
+  __slots__ = ("_rec", "_start_ns")
+
+  def __init__(self, rec: "StepStatsRecorder"):
+    self._rec = rec
+    self._start_ns = 0
+
+  def __enter__(self) -> "_WaitTimer":
+    self._start_ns = time.perf_counter_ns()
+    return self
+
+  def __exit__(self, exc_type, exc, tb) -> None:
+    dur_ns = time.perf_counter_ns() - self._start_ns
+    self._rec._data_wait_ns += dur_ns
+    self._rec._tracer.add_complete("train/data_wait", self._start_ns,
+                                   dur_ns, cat="train")
+
+
+class _NullTimer:
+  __slots__ = ()
+
+  def __enter__(self):
+    return self
+
+  def __exit__(self, exc_type, exc, tb):
+    return None
+
+
+_NULL_TIMER = _NullTimer()
+
+
+def _default_barrier(state) -> None:
+  from tensor2robot_tpu.utils import backend
+
+  backend.state_barrier(state)
+
+
+class StepStatsRecorder:
+  """Train-loop step accountant; all clock reads live in this module.
+
+  Protocol (see `train_eval.py`):
+
+    rec.start()                       # after data/state bring-up
+    with rec.data_wait(): batch = next(...)
+    rec.before_dispatch(); state, m = step(...); rec.after_dispatch()
+    with rec.data_wait(): next_batch = next(...)   # overlapped staging
+    rec.end_step(step, state, num_steps=k)         # barrier at cadence
+    for step, record in rec.drain(): writer.write_scalars(step, record)
+
+  A disabled recorder (`every_n_steps=0`) keeps the call sites
+  unconditional and no-ops at one attribute check per call.
+  """
+
+  def __init__(self,
+               batch_size: int,
+               every_n_steps: int = 1,
+               barrier: Optional[Callable[[Any], None]] = None,
+               registry: Optional[metrics_lib.Registry] = None,
+               tracer: Optional[trace_lib.Tracer] = None,
+               device_gauges: bool = True):
+    self._enabled = every_n_steps > 0
+    self._batch_size = int(batch_size)
+    self._every_n = max(int(every_n_steps), 1)
+    self._barrier = barrier or _default_barrier
+    self._registry = registry or metrics_lib.get_registry()
+    self._tracer = tracer or trace_lib.get_tracer()
+    self._device_gauges = device_gauges
+    self._records: List[Tuple[int, Dict[str, float]]] = []
+    self._window_start_ns = 0
+    self._data_wait_ns = 0
+    self._dispatch_ns = 0
+    self._barrier_ns = 0
+    self._steps_in_window = 0
+    self._dispatches_in_window = 0
+    self._last_record_step: Optional[int] = None
+    self._dispatch_history_ms: List[float] = []
+    self._t_dispatch_ns = 0
+    self._compile_in_window = 0
+
+  @property
+  def enabled(self) -> bool:
+    return self._enabled
+
+  def start(self) -> None:
+    """Marks the start of the first measurement window."""
+    if self._enabled:
+      self._window_start_ns = time.perf_counter_ns()
+
+  def data_wait(self):
+    """Context manager charging its window to `data_wait_ms`."""
+    return _WaitTimer(self) if self._enabled else _NULL_TIMER
+
+  def before_dispatch(self) -> None:
+    if self._enabled:
+      self._t_dispatch_ns = time.perf_counter_ns()
+
+  def after_dispatch(self) -> None:
+    """Call immediately after the (async) step dispatch returns."""
+    if not self._enabled:
+      return
+    dur_ns = time.perf_counter_ns() - self._t_dispatch_ns
+    self._dispatch_ns += dur_ns
+    self._dispatches_in_window += 1
+    dispatch_ms = dur_ns / 1e6
+    history = self._dispatch_history_ms
+    median = sorted(history)[len(history) // 2] if history else 0.0
+    if not history or dispatch_ms > max(COMPILE_FLOOR_MS,
+                                        _COMPILE_SPIKE_FACTOR * median):
+      # First dispatch always compiles; later spikes are re-traces.
+      self._compile_in_window += 1
+      self._registry.counter("stepstats/compile_events").inc()
+      self._tracer.add_complete("train/compile_dispatch",
+                                self._t_dispatch_ns, dur_ns, cat="train")
+    history.append(dispatch_ms)
+    if len(history) > _DISPATCH_HISTORY:
+      history.pop(0)
+
+  def end_step(self, step: int, state: Any, num_steps: int = 1) -> None:
+    """Closes the step; at the cadence, barriers and emits a record."""
+    if not self._enabled:
+      return
+    self._steps_in_window += num_steps
+    if self._steps_in_window < self._every_n:
+      return
+    barrier_start_ns = time.perf_counter_ns()
+    self._barrier(state)
+    now_ns = time.perf_counter_ns()
+    self._barrier_ns += now_ns - barrier_start_ns
+    self._tracer.add_complete("train/barrier", barrier_start_ns,
+                              now_ns - barrier_start_ns, cat="train")
+    self._emit(step, now_ns)
+
+  def _emit(self, step: int, now_ns: int) -> None:
+    n = self._steps_in_window
+    window_s = max((now_ns - self._window_start_ns) / 1e9, 1e-9)
+    data_wait_ms = self._data_wait_ns / 1e6 / n
+    device_ms = (self._dispatch_ns + self._barrier_ns) / 1e6 / n
+    step_ms = window_s * 1e3 / n
+    record: Dict[str, float] = {
+        "step_ms": step_ms,
+        "device_ms": device_ms,
+        "data_wait_ms": data_wait_ms,
+        "host_ms": max(step_ms - device_ms - data_wait_ms, 0.0),
+        "dispatch_ms": self._dispatch_ns / 1e6 / n,
+        "examples_per_sec": n * self._batch_size / window_s,
+        "compile": float(self._compile_in_window > 0),
+        "steps_in_window": float(n),
+    }
+    record.update(self._read_device_gauges())
+    self._records.append((int(step), record))
+    reg = self._registry
+    reg.histogram("stepstats/step_ms").record(step_ms)
+    reg.histogram("stepstats/device_ms").record(device_ms)
+    reg.histogram("stepstats/data_wait_ms").record(data_wait_ms)
+    reg.gauge("stepstats/examples_per_sec").set(record["examples_per_sec"])
+    first_step = int(step) - n + 1
+    self._tracer.add_complete(
+        "train/step_window", self._window_start_ns,
+        now_ns - self._window_start_ns, cat="train",
+        args={"first_step": first_step, "last_step": int(step), "steps": n})
+    self._window_start_ns = now_ns
+    self._data_wait_ns = self._dispatch_ns = self._barrier_ns = 0
+    self._steps_in_window = self._dispatches_in_window = 0
+    self._compile_in_window = 0
+    self._last_record_step = int(step)
+
+  def _read_device_gauges(self) -> Dict[str, float]:
+    """Live-array count/bytes (+ allocator bytes when the backend
+    reports them). Latches off on first failure — telemetry must never
+    take down a train loop."""
+    if not self._device_gauges:
+      return {}
+    try:
+      import jax
+
+      arrays = [a for a in jax.live_arrays() if not a.is_deleted()]
+      live_bytes = float(sum(getattr(a, "nbytes", 0) for a in arrays))
+      out = {"live_arrays": float(len(arrays)), "live_bytes": live_bytes}
+      try:
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        if stats and "bytes_in_use" in stats:
+          out["device_bytes_in_use"] = float(stats["bytes_in_use"])
+      except Exception:  # noqa: BLE001 - allocator stats are optional
+        pass
+      self._registry.gauge("device/live_arrays").set(out["live_arrays"])
+      self._registry.gauge("device/live_bytes").set(live_bytes)
+      return out
+    except Exception:  # noqa: BLE001 - gauges are best-effort
+      self._device_gauges = False
+      return {}
+
+  def drain(self) -> List[Tuple[int, Dict[str, float]]]:
+    """Pops every completed (step, record) pair, oldest first."""
+    records, self._records = self._records, []
+    return records

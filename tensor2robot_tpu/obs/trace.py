@@ -1,0 +1,218 @@
+"""Low-overhead span tracer exporting Chrome trace-event JSON.
+
+The reference has no in-repo tracing (SURVEY.md §5: TF summaries through
+TPU `host_call`, /root/reference/models/abstract_model.py:873-936); over
+the axon tunnel every perf incident so far was diagnosed with hand-rolled
+prints. This tracer makes those windows permanent: context-manager /
+decorator spans on monotonic clocks (`time.perf_counter_ns`), one ring
+buffer per tracer (bounded memory, oldest events dropped), thread-aware
+(per-thread `tid` + thread-name metadata), exported in the Chrome
+trace-event format that `chrome://tracing` and https://ui.perfetto.dev
+load directly.
+
+Backend-free by construction: this module never imports jax and a
+disabled tracer costs a single attribute check per span
+(tests/test_observability.py runs it under a poisoned JAX_PLATFORMS).
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Tracer", "Span", "get_tracer", "enable", "disable", "span",
+           "traced", "instant", "add_complete", "save", "clear"]
+
+# Chrome trace events use microsecond timestamps; perf_counter_ns is the
+# monotonic source (wall clocks can step backwards mid-span).
+_NS_PER_US = 1000.0
+
+
+class Span:
+  """One in-flight span; records a complete ('X') event on exit.
+
+  Re-entrant use is wrong (one Span = one window); allocate via
+  `Tracer.span`. A span created while the tracer is disabled is the
+  shared no-op instance and records nothing.
+  """
+
+  __slots__ = ("_tracer", "_name", "_cat", "_args", "_start_ns")
+
+  def __init__(self, tracer: Optional["Tracer"], name: str, cat: str,
+               args: Optional[Dict[str, Any]]):
+    self._tracer = tracer
+    self._name = name
+    self._cat = cat
+    self._args = args
+    self._start_ns = 0
+
+  def __enter__(self) -> "Span":
+    if self._tracer is not None:
+      self._start_ns = time.perf_counter_ns()
+    return self
+
+  def __exit__(self, exc_type, exc, tb) -> None:
+    if self._tracer is not None:
+      end_ns = time.perf_counter_ns()
+      self._tracer._record(self._name, self._cat, self._start_ns,
+                           end_ns - self._start_ns, self._args)
+
+
+_NULL_SPAN = Span(None, "", "", None)
+
+
+class Tracer:
+  """Bounded in-memory event buffer with Chrome-trace JSON export."""
+
+  def __init__(self, max_events: int = 200_000):
+    self._events: "collections.deque" = collections.deque(maxlen=max_events)
+    self._lock = threading.Lock()
+    self._thread_names: Dict[int, str] = {}
+    self._enabled = False
+
+  # -- lifecycle ------------------------------------------------------------
+
+  @property
+  def enabled(self) -> bool:
+    return self._enabled
+
+  def enable(self) -> None:
+    self._enabled = True
+
+  def disable(self) -> None:
+    self._enabled = False
+
+  def clear(self) -> None:
+    with self._lock:
+      self._events.clear()
+      self._thread_names.clear()
+
+  # -- recording ------------------------------------------------------------
+
+  def span(self, name: str, cat: str = "span", **args: Any) -> Span:
+    """Context manager timing a code window as one complete event."""
+    if not self._enabled:
+      return _NULL_SPAN
+    return Span(self, name, cat, args or None)
+
+  def traced(self, name: Optional[str] = None, cat: str = "span"):
+    """Decorator form of `span` (one event per call)."""
+
+    def wrap(fn):
+      span_name = name or getattr(fn, "__qualname__", fn.__name__)
+
+      @functools.wraps(fn)
+      def inner(*a, **kw):
+        with self.span(span_name, cat=cat):
+          return fn(*a, **kw)
+
+      return inner
+
+    return wrap
+
+  def instant(self, name: str, cat: str = "instant", **args: Any) -> None:
+    """Zero-duration marker event."""
+    if not self._enabled:
+      return
+    now = time.perf_counter_ns()
+    self._append({"name": name, "cat": cat, "ph": "i",
+                  "ts": now / _NS_PER_US, "s": "t",
+                  "pid": os.getpid(), "tid": threading.get_ident(),
+                  **({"args": args} if args else {})})
+
+  def add_complete(self, name: str, start_ns: int, dur_ns: int,
+                   cat: str = "span",
+                   args: Optional[Dict[str, Any]] = None) -> None:
+    """Records an externally timed window (clock reads already taken by
+    the caller — e.g. stepstats' barrier-bounded step windows)."""
+    if not self._enabled:
+      return
+    self._record(name, cat, start_ns, dur_ns, args)
+
+  def _record(self, name: str, cat: str, start_ns: int, dur_ns: int,
+              args: Optional[Dict[str, Any]]) -> None:
+    self._append({"name": name, "cat": cat, "ph": "X",
+                  "ts": start_ns / _NS_PER_US,
+                  "dur": max(dur_ns, 0) / _NS_PER_US,
+                  "pid": os.getpid(), "tid": threading.get_ident(),
+                  **({"args": args} if args else {})})
+
+  def _append(self, event: Dict[str, Any]) -> None:
+    tid = event["tid"]
+    if tid not in self._thread_names:
+      with self._lock:
+        self._thread_names[tid] = threading.current_thread().name
+    self._events.append(event)  # deque.append is atomic under the GIL
+
+  # -- export ---------------------------------------------------------------
+
+  def events(self) -> List[Dict[str, Any]]:
+    """Snapshot of buffered events plus thread-name metadata events."""
+    with self._lock:
+      events = list(self._events)
+      names = dict(self._thread_names)
+    pid = os.getpid()
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": thread_name}}
+            for tid, thread_name in sorted(names.items())]
+    return meta + events
+
+  def save(self, path: str) -> str:
+    """Writes the Chrome trace-event JSON object format; returns path.
+
+    Open the file in Perfetto (https://ui.perfetto.dev) or
+    chrome://tracing — both consume this format unmodified.
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    payload = {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+      json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+  """The process-wide tracer the shipped instrumentation records into."""
+  return _GLOBAL
+
+
+def enable() -> None:
+  _GLOBAL.enable()
+
+
+def disable() -> None:
+  _GLOBAL.disable()
+
+
+def span(name: str, cat: str = "span", **args: Any) -> Span:
+  return _GLOBAL.span(name, cat=cat, **args)
+
+
+def traced(name: Optional[str] = None, cat: str = "span"):
+  return _GLOBAL.traced(name, cat=cat)
+
+
+def instant(name: str, cat: str = "instant", **args: Any) -> None:
+  _GLOBAL.instant(name, cat=cat, **args)
+
+
+def add_complete(name: str, start_ns: int, dur_ns: int, cat: str = "span",
+                 args: Optional[Dict[str, Any]] = None) -> None:
+  _GLOBAL.add_complete(name, start_ns, dur_ns, cat=cat, args=args)
+
+
+def save(path: str) -> str:
+  return _GLOBAL.save(path)
+
+
+def clear() -> None:
+  _GLOBAL.clear()

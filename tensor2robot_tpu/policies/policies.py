@@ -17,6 +17,8 @@ from typing import Any, Dict, Mapping, Optional, Sequence
 
 import numpy as np
 
+from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.ops import cem as cem_lib
 from tensor2robot_tpu.utils import config
 
@@ -46,8 +48,14 @@ class Policy(abc.ABC):
     return self.select_action(obs)
 
   def sample_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
-    """Adapter used by collect loops (reference :95-102)."""
-    return self.select_action(obs, explore_prob=explore_prob)
+    """Adapter used by collect loops (reference :95-102).
+
+    graftscope instruments THIS adapter (not select_action, which
+    subclasses override) so every env loop gets an action-latency
+    histogram — the actor-side control-rate number — for free."""
+    with obs_trace.span("policy/select_action", cat="serve"), \
+        obs_metrics.histogram("policy/select_action_ms").time_ms():
+      return self.select_action(obs, explore_prob=explore_prob)
 
   def reset(self) -> None:
     """Per-episode state reset."""

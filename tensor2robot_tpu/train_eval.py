@@ -34,6 +34,9 @@ from tensor2robot_tpu import checkpoints as checkpoints_lib
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.obs import metrics as metrics_registry_lib
+from tensor2robot_tpu.obs import stepstats as stepstats_lib
+from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import train_step as ts
 from tensor2robot_tpu.utils import config
@@ -183,6 +186,7 @@ def train_eval_model(
     log_every_n_steps: int = 100,
     device_prefetch_depth: int = 2,
     iterations_per_loop: int = 1,
+    step_stats_every_n_steps: Optional[int] = None,
 ) -> dict:
   """Runs the requested mode; returns final metrics.
 
@@ -196,7 +200,20 @@ def train_eval_model(
   test); logging/checkpoint/eval cadences fire when a loop CROSSES a
   multiple of their interval (TPUEstimator-style quantization to loop
   boundaries), and per-step hook metrics are preserved (the loop
-  returns each inner step's scalars)."""
+  returns each inner step's scalars).
+
+  `step_stats_every_n_steps` > 0 turns on graftscope step telemetry
+  (`obs.stepstats`): per-step `data_wait_ms` / `device_ms` /
+  `examples_per_sec` records in `metrics.jsonl` plus a Perfetto trace
+  (`trace.graftscope.json`), emitted via an auto-appended
+  `StepStatsHook`. Each measured window ends in a tunnel-safe barrier
+  (a host fetch, ~0.1 s over the axon tunnel), so the default (None)
+  is backend-aware: per-step on CPU, the log cadence on an accelerator
+  (windowed per-step averages stay exact and the dispatch/prefetch
+  overlap between barriers is preserved); 0 disables. The process-
+  global trace buffer AND metrics registry are reset at run start so
+  the saved trace and the final registry snapshot cover exactly this
+  run."""
   if mode not in ("train", "evaluate", "train_and_evaluate",
                   "continuous_eval"):
     raise ValueError(f"Unknown train_eval mode {mode!r}")
@@ -275,9 +292,30 @@ def train_eval_model(
     state = manager.restore(restored_step, abstract_state=abstract)
     logging.info("Resumed from checkpoint step %d", restored_step)
 
+  if step_stats_every_n_steps is None:
+    # Per-step barriers are ~free on CPU; over the axon tunnel each
+    # measured window costs a ~0.1 s host fetch AND serializes the
+    # dispatch/prefetch overlap, so default to the log cadence there.
+    step_stats_every_n_steps = (
+        1 if jax.devices()[0].platform == "cpu"
+        else max(int(log_every_n_steps), 1))
+  step_stats = stepstats_lib.StepStatsRecorder(
+      batch_size=(input_generator_train.batch_size if needs_train else 0),
+      every_n_steps=step_stats_every_n_steps if needs_train else 0)
+  if step_stats.enabled:
+    hooks.append(hooks_lib.StepStatsHook())
+    # Per-run telemetry: clear the process-global trace buffer and
+    # metrics registry so the saved trace / final snapshot cover
+    # exactly this run (the tracer itself is enabled inside the train
+    # loop's try so any exit path disables it again).
+    trace_lib.clear()
+    metrics_registry_lib.reset()
+
   ctx = hooks_lib.TrainContext(model, model_dir,
                                get_state=lambda: state,
-                               summary_writer=writer, mesh=mesh)
+                               summary_writer=writer, mesh=mesh,
+                               step_stats=(step_stats if step_stats.enabled
+                                           else None))
   for hook in hooks:
     hook.begin(ctx)
 
@@ -422,7 +460,10 @@ def train_eval_model(
             1)
 
   try:
+    if step_stats.enabled:
+      trace_lib.enable()
     if step < max_train_steps:
+      step_stats.start()
       # First placement BEFORE the worker starts: if it raises there is
       # no thread to leak; everything after is covered by the finally.
       if use_loop_for(max_train_steps - step):
@@ -431,10 +472,12 @@ def train_eval_model(
         # The init batch is step 1's data in the single-step path; the
         # first loop group must start with it too.
         train_dataset = itertools.chain([first_batch], train_dataset)
-        placed, placed_k = _place_next(max_train_steps - step,
-                                       train_dataset)
+        with step_stats.data_wait():
+          placed, placed_k = _place_next(max_train_steps - step,
+                                         train_dataset)
       else:
-        placed = _device_batch(mesh, first_batch, batch_spec)
+        with step_stats.data_wait():
+          placed = _device_batch(mesh, first_batch, batch_spec)
         placed_k = 1
         if device_prefetch_depth:
           prefetcher = mesh_lib.DevicePrefetcher(
@@ -444,10 +487,12 @@ def train_eval_model(
     while step < max_train_steps:
       features, labels = placed
       prev_step = step
+      step_stats.before_dispatch()
       if placed_k > 1:
         state, stacked = train_loop(state, features, labels)
       else:
         state, metrics = train_step(state, features, labels)
+      step_stats.after_dispatch()
       step += placed_k
       # Stage the NEXT batch/group while the device runs the (async)
       # dispatch just issued — host parse/stack/place overlaps device
@@ -456,11 +501,18 @@ def train_eval_model(
       # worker thread.)
       if step < max_train_steps:
         if prefetcher is not None:
-          placed = next(prefetcher)
+          with step_stats.data_wait():
+            placed = next(prefetcher)
           placed_k = 1
         else:
-          placed, placed_k = _place_next(max_train_steps - step,
-                                         train_dataset)
+          with step_stats.data_wait():
+            placed, placed_k = _place_next(max_train_steps - step,
+                                           train_dataset)
+      # Measured-window close (barrier at the stepstats cadence) sits
+      # AFTER next-batch staging — overlap preserved — and BEFORE the
+      # per-step metrics fetch, so device_ms absorbs the device wait
+      # and the fetch below stays cheap.
+      step_stats.end_step(step, state, num_steps=step - prev_step)
       if step - prev_step > 1:
         # One host fetch for all K steps' scalars (vs one per step).
         host = {k: np.asarray(v) for k, v in stacked.items()}
@@ -519,6 +571,12 @@ def train_eval_model(
     # Runs on SystemExit(42) preemption and any step/hook/eval failure
     # too: a daemon worker killed at interpreter shutdown mid device_put
     # is a killed TPU client (the documented tunnel-wedging hazard).
+    # The global tracer must not outlive the loop either — a driver that
+    # catches the error and keeps the process alive would otherwise pay
+    # span-recording overhead forever (the buffered events survive for
+    # StepStatsHook.end's save on the normal path).
+    if step_stats.enabled:
+      trace_lib.disable()
     if prefetcher is not None:
       prefetcher.close()
 

@@ -5,16 +5,28 @@ The reference relies on tf.summary + TPU host_call plumbing
 written to a JSONL events file (always) and mirrored to TensorBoard event
 files when TensorFlow is importable. JSONL is the source of truth: cheap,
 append-only, greppable, no runtime dependency.
+
+Robustness contract (graftscope): a bad value must never kill a train
+loop. Non-scalar and non-finite values are skipped — counted in the
+metrics registry (`counter/summaries/dropped_non_scalar`,
+`counter/summaries/dropped_non_finite`) and warned once per key — and
+every written line stays strictly-valid JSON (NaN/Inf never reach the
+file, so readers like `bin/graftscope` need no lenient parser). `close()`
+fsyncs so a crash right after a run still leaves the records on disk;
+the writer is also a context manager.
 """
 
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
-from typing import Dict, Mapping, Optional
+from typing import Dict, Mapping, Optional, Set
 
 import numpy as np
+
+from tensor2robot_tpu.obs import metrics as obs_metrics
 
 __all__ = ["SummaryWriter"]
 
@@ -24,6 +36,7 @@ class SummaryWriter:
     os.makedirs(log_dir, exist_ok=True)
     self._path = os.path.join(log_dir, "metrics.jsonl")
     self._file = open(self._path, "a")
+    self._warned_keys: Set[str] = set()
     self._tb = None
     if use_tensorboard:
       try:
@@ -37,21 +50,60 @@ class SummaryWriter:
   def path(self) -> str:
     return self._path
 
-  def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
-    record = {"step": int(step), "time": time.time()}
+  def __enter__(self) -> "SummaryWriter":
+    return self
+
+  def __exit__(self, exc_type, exc, tb) -> None:
+    self.close()
+
+  def _warn_once(self, key: str, reason: str) -> None:
+    if key in self._warned_keys:
+      return
+    self._warned_keys.add(key)
+    from absl import logging
+
+    logging.warning("SummaryWriter: skipping %s value for %r "
+                    "(further drops of this key counted silently in "
+                    "counter/summaries/dropped_%s)", reason, key, reason)
+
+  def _clean(self, scalars: Mapping[str, float]) -> Dict[str, float]:
+    """Scalar-finite subset of `scalars`; drops are counted + warned."""
+    out: Dict[str, float] = {}
     for key, value in scalars.items():
-      record[key] = float(np.asarray(value))
+      try:
+        arr = np.asarray(value, dtype=np.float64)
+        if arr.size != 1:
+          raise ValueError(f"size {arr.size}")
+        scalar = float(arr.reshape(()))
+      except (TypeError, ValueError):
+        obs_metrics.counter("summaries/dropped_non_scalar").inc()
+        self._warn_once(key, "non_scalar")
+        continue
+      if not math.isfinite(scalar):
+        obs_metrics.counter("summaries/dropped_non_finite").inc()
+        self._warn_once(key, "non_finite")
+        continue
+      out[key] = scalar
+    return out
+
+  def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+    record: Dict[str, float] = {"step": int(step), "time": time.time()}
+    record.update(self._clean(scalars))
     self._file.write(json.dumps(record) + "\n")
     self._file.flush()
     if self._tb is not None:
       with self._tb.as_default():
         import tensorflow as tf
 
-        for key, value in scalars.items():
-          tf.summary.scalar(key, float(np.asarray(value)), step=int(step))
+        for key, value in record.items():
+          if key not in ("step", "time"):
+            tf.summary.scalar(key, value, step=int(step))
         self._tb.flush()
 
   def close(self) -> None:
-    self._file.close()
+    if not self._file.closed:
+      self._file.flush()
+      os.fsync(self._file.fileno())
+      self._file.close()
     if self._tb is not None:
       self._tb.close()

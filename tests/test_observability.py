@@ -1,0 +1,614 @@
+"""Tests for graftscope (`tensor2robot_tpu/obs/`): tracer, metrics,
+step stats, hardened SummaryWriter, the device-timing lint rule, the
+train-loop integration, and the reader CLI.
+
+Contracts:
+
+* spans nest correctly and export VALID Chrome trace-event JSON
+  (Perfetto-loadable: `traceEvents` list of `ph: X` events with
+  name/ts/dur/pid/tid);
+* histogram percentiles match numpy exactly while the reservoir holds
+  every observation;
+* a CPU-mesh `train_eval_model` run writes per-step `data_wait_ms`,
+  `device_ms` and `examples_per_sec` records to `metrics.jsonl`, saves
+  a trace, and `python -m tensor2robot_tpu.bin.graftscope <model_dir>`
+  renders a non-empty report from them;
+* `tensor2robot_tpu.obs` (and the CLI) import and run under a poisoned
+  JAX_PLATFORMS without touching a backend — the `analysis/`
+  discipline (tier-1).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import train_eval
+from tensor2robot_tpu.analysis import tracer_check
+from tensor2robot_tpu.bin import graftscope
+from tensor2robot_tpu.hooks import profiler as profiler_lib
+from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import stepstats as stepstats_lib
+from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.utils import config, mocks
+from tensor2robot_tpu.utils import summaries as summaries_lib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_global_obs_state():
+  """Each test sees an empty global registry/tracer (process-wide
+  singletons; other suites' recordings must not leak into assertions)."""
+  metrics_lib.reset()
+  trace_lib.clear()
+  trace_lib.disable()
+  yield
+  metrics_lib.reset()
+  trace_lib.clear()
+  trace_lib.disable()
+
+
+# ---------------------------------------------------------------------------
+# Tracer: span semantics + Chrome-trace JSON validity.
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+
+  def test_nested_spans_contained_and_ordered(self):
+    tracer = trace_lib.Tracer()
+    tracer.enable()
+    with tracer.span("outer"):
+      time.sleep(0.002)
+      with tracer.span("inner"):
+        time.sleep(0.002)
+      time.sleep(0.002)
+    events = [e for e in tracer.events() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    # Chrome-trace nesting: the child window lies inside the parent's.
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert inner["dur"] >= 1e3  # at least the 2 ms sleep, in us
+    assert outer["dur"] > inner["dur"]
+
+  def test_save_writes_perfetto_loadable_json(self, tmp_path):
+    tracer = trace_lib.Tracer()
+    tracer.enable()
+    with tracer.span("a", cat="test", detail=1):
+      pass
+    tracer.instant("marker", note="hi")
+    path = tracer.save(str(tmp_path / "trace.json"))
+    with open(path) as f:
+      payload = json.load(f)  # strict JSON — what Perfetto parses
+    assert isinstance(payload["traceEvents"], list)
+    phases = {e["ph"] for e in payload["traceEvents"]}
+    assert "X" in phases and "M" in phases and "i" in phases
+    for event in payload["traceEvents"]:
+      assert "name" in event and "pid" in event and "tid" in event
+      if event["ph"] == "X":
+        assert event["ts"] >= 0 and event["dur"] >= 0
+        assert event["cat"] == "test"
+        assert event["args"] == {"detail": 1}
+
+  def test_thread_awareness(self):
+    tracer = trace_lib.Tracer()
+    tracer.enable()
+
+    def work():
+      with tracer.span("worker_span"):
+        pass
+
+    t = threading.Thread(target=work, name="obs-worker")
+    t.start()
+    t.join()
+    with tracer.span("main_span"):
+      pass
+    events = tracer.events()
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    assert spans["worker_span"]["tid"] != spans["main_span"]["tid"]
+    names = {e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert "obs-worker" in names
+
+  def test_disabled_tracer_records_nothing(self):
+    tracer = trace_lib.Tracer()
+    with tracer.span("nope"):
+      pass
+    tracer.instant("nope")
+    tracer.add_complete("nope", 0, 10)
+    assert tracer.events() == []
+
+  def test_ring_buffer_bounds_memory(self):
+    tracer = trace_lib.Tracer(max_events=10)
+    tracer.enable()
+    for i in range(50):
+      with tracer.span(f"s{i}"):
+        pass
+    spans = [e for e in tracer.events() if e["ph"] == "X"]
+    assert len(spans) == 10
+    assert spans[-1]["name"] == "s49"  # oldest dropped, newest kept
+
+  def test_traced_decorator(self):
+    tracer = trace_lib.Tracer()
+    tracer.enable()
+
+    @tracer.traced("fn_span")
+    def fn(x):
+      return x + 1
+
+    assert fn(1) == 2
+    assert [e["name"] for e in tracer.events() if e["ph"] == "X"] \
+        == ["fn_span"]
+
+
+# ---------------------------------------------------------------------------
+# Metrics registry.
+# ---------------------------------------------------------------------------
+
+
+class TestMetrics:
+
+  def test_counter_and_gauge(self):
+    reg = metrics_lib.Registry()
+    reg.counter("a/b").inc()
+    reg.counter("a/b").inc(4)
+    reg.gauge("g").set(2.5)
+    assert reg.counter("a/b").value == 5
+    assert reg.gauge("g").value == 2.5
+    snap = reg.snapshot()
+    assert snap["counter/a/b"] == 5.0
+    assert snap["gauge/g"] == 2.5
+
+  @pytest.mark.parametrize("dist", ["uniform", "lognormal", "constant"])
+  def test_histogram_percentiles_match_numpy(self, dist):
+    rng = np.random.RandomState(0)
+    values = {"uniform": rng.uniform(0, 100, 500),
+              "lognormal": rng.lognormal(1.0, 2.0, 500),
+              "constant": np.full(500, 7.0)}[dist]
+    hist = metrics_lib.Histogram("h")  # reservoir (4096) holds all 500
+    for v in values:
+      hist.record(v)
+    stats = hist.stats()
+    for pct, key in ((50, "p50"), (90, "p90"), (99, "p99")):
+      np.testing.assert_allclose(stats[key], np.percentile(values, pct),
+                                 rtol=1e-12)
+    np.testing.assert_allclose(stats["mean"], values.mean(), rtol=1e-9)
+    assert stats["count"] == 500
+    assert stats["min"] == values.min() and stats["max"] == values.max()
+
+  def test_histogram_reservoir_bounds_memory_keeps_exact_extremes(self):
+    hist = metrics_lib.Histogram("h", reservoir_size=64)
+    for v in range(10_000):
+      hist.record(float(v))
+    assert len(hist._sample) == 64
+    stats = hist.stats()
+    assert stats["count"] == 10_000
+    assert stats["min"] == 0.0 and stats["max"] == 9999.0
+    # Reservoir percentiles are estimates; they must land inside the
+    # observed range and be ordered.
+    assert 0.0 <= stats["p50"] <= stats["p90"] <= stats["p99"] <= 9999.0
+
+  def test_histogram_timer_records_elapsed_ms(self):
+    hist = metrics_lib.Histogram("h")
+    with hist.time_ms():
+      time.sleep(0.005)
+    assert hist.count == 1
+    assert hist.percentile(50) >= 4.0  # >= the 5 ms sleep, some slack
+
+  def test_snapshot_prefix_filter_and_empty_hist_omitted(self):
+    reg = metrics_lib.Registry()
+    reg.counter("bench/ok").inc()
+    reg.counter("other/x").inc()
+    reg.histogram("bench/empty")  # zero observations -> omitted
+    snap = reg.snapshot(prefix="bench/")
+    assert snap == {"counter/bench/ok": 1.0}
+
+  def test_global_registry_reset(self):
+    metrics_lib.counter("x").inc()
+    assert metrics_lib.snapshot()["counter/x"] == 1.0
+    metrics_lib.reset()
+    assert metrics_lib.snapshot() == {}
+
+
+# ---------------------------------------------------------------------------
+# Hardened SummaryWriter.
+# ---------------------------------------------------------------------------
+
+
+class TestSummaryWriter:
+
+  def _read(self, path):
+    with open(path) as f:
+      return [json.loads(line) for line in f if line.strip()]
+
+  def test_context_manager_and_fsync_close(self, tmp_path):
+    with summaries_lib.SummaryWriter(str(tmp_path),
+                                     use_tensorboard=False) as writer:
+      writer.write_scalars(1, {"loss": 0.5})
+      path = writer.path
+    assert writer._file.closed
+    records = self._read(path)
+    assert records[0]["step"] == 1 and records[0]["loss"] == 0.5
+    writer.close()  # idempotent
+
+  def test_non_finite_and_non_scalar_skipped_not_fatal(self, tmp_path):
+    writer = summaries_lib.SummaryWriter(str(tmp_path),
+                                         use_tensorboard=False)
+    writer.write_scalars(3, {
+        "good": 1.25,
+        "nan": float("nan"),
+        "inf": np.inf,
+        "vector": np.zeros(4),
+        "string": "not-a-number",
+    })
+    writer.close()
+    (record,) = self._read(writer.path)
+    assert record["good"] == 1.25
+    for key in ("nan", "inf", "vector", "string"):
+      assert key not in record
+    snap = metrics_lib.snapshot()
+    assert snap["counter/summaries/dropped_non_finite"] == 2.0
+    assert snap["counter/summaries/dropped_non_scalar"] == 2.0
+    # The file must stay STRICT JSON (no NaN/Infinity literals) so the
+    # graftscope reader needs no lenient parser.
+    with open(writer.path) as f:
+      text = f.read()
+    assert "NaN" not in text and "Infinity" not in text
+
+  def test_scalar_shapes_still_accepted(self, tmp_path):
+    writer = summaries_lib.SummaryWriter(str(tmp_path),
+                                         use_tensorboard=False)
+    writer.write_scalars(1, {"a": np.float32(2.0), "b": np.array([3.0]),
+                             "c": np.array(4.0), "d": True})
+    writer.close()
+    (record,) = self._read(writer.path)
+    assert (record["a"], record["b"], record["c"], record["d"]) \
+        == (2.0, 3.0, 4.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# StepStatsRecorder protocol (fake barrier: no device involved).
+# ---------------------------------------------------------------------------
+
+
+class TestStepStats:
+
+  def _run_steps(self, rec, n):
+    for i in range(n):
+      with rec.data_wait():
+        time.sleep(0.002)
+      rec.before_dispatch()
+      time.sleep(0.001)
+      rec.after_dispatch()
+      rec.end_step(i + 1, state="fake-state")
+
+  def test_per_step_records_have_required_fields(self):
+    barriers = []
+    rec = stepstats_lib.StepStatsRecorder(
+        batch_size=8, every_n_steps=1, barrier=barriers.append,
+        device_gauges=False)
+    rec.start()
+    self._run_steps(rec, 3)
+    records = rec.drain()
+    assert [step for step, _ in records] == [1, 2, 3]
+    assert barriers == ["fake-state"] * 3
+    for _, r in records:
+      for key in ("data_wait_ms", "device_ms", "examples_per_sec",
+                  "step_ms", "host_ms", "dispatch_ms", "compile"):
+        assert key in r, r
+      assert r["data_wait_ms"] >= 1.5      # the 2 ms staging sleep
+      assert r["device_ms"] >= 0.5         # the 1 ms dispatch sleep
+      assert r["step_ms"] >= r["data_wait_ms"]
+      assert r["examples_per_sec"] > 0
+    # First dispatch is always a compile event; steady steps are not.
+    assert records[0][1]["compile"] == 1.0
+    assert records[1][1]["compile"] == 0.0
+    assert rec.drain() == []  # drained
+
+  def test_windowed_cadence_averages_over_n_steps(self):
+    rec = stepstats_lib.StepStatsRecorder(
+        batch_size=4, every_n_steps=2, barrier=lambda s: None,
+        device_gauges=False)
+    rec.start()
+    self._run_steps(rec, 4)
+    records = rec.drain()
+    assert [step for step, _ in records] == [2, 4]
+    for _, r in records:
+      assert r["steps_in_window"] == 2.0
+      # Per-step averages: one window covers two 2 ms staging sleeps.
+      assert 1.5 <= r["data_wait_ms"] <= 50.0
+
+  def test_compile_spike_detection(self):
+    rec = stepstats_lib.StepStatsRecorder(
+        batch_size=1, every_n_steps=1, barrier=lambda s: None,
+        device_gauges=False)
+    rec.start()
+    self._run_steps(rec, 3)
+    rec.drain()
+    before = metrics_lib.counter("stepstats/compile_events").value
+    # A dispatch 10x over the floor AND the median: recompile detected.
+    rec.before_dispatch()
+    time.sleep(0.06)
+    rec.after_dispatch()
+    rec.end_step(4, state=None)
+    ((_, record),) = rec.drain()
+    assert record["compile"] == 1.0
+    assert metrics_lib.counter("stepstats/compile_events").value \
+        == before + 1
+
+  def test_disabled_recorder_noops(self):
+    rec = stepstats_lib.StepStatsRecorder(batch_size=8, every_n_steps=0,
+                                          barrier=None)
+    assert not rec.enabled
+    rec.start()
+    self._run_steps(rec, 2)  # barrier=None would raise if called
+    assert rec.drain() == []
+
+  def test_registry_and_trace_feeds(self):
+    trace_lib.enable()
+    rec = stepstats_lib.StepStatsRecorder(
+        batch_size=8, every_n_steps=1, barrier=lambda s: None,
+        device_gauges=False)
+    rec.start()
+    self._run_steps(rec, 2)
+    snap = metrics_lib.snapshot()
+    assert snap["hist/stepstats/step_ms/count"] == 2.0
+    assert "gauge/stepstats/examples_per_sec" in snap
+    names = {e["name"] for e in trace_lib.get_tracer().events()}
+    assert {"train/step_window", "train/data_wait"} <= names
+
+
+# ---------------------------------------------------------------------------
+# device-timing lint rule.
+# ---------------------------------------------------------------------------
+
+
+_BAD_TIMING = """
+import time
+import jax.numpy as jnp
+
+def f(x):
+  t0 = time.perf_counter()
+  y = jnp.dot(x, x)
+  return time.perf_counter() - t0
+"""
+
+
+class TestDeviceTimingRule:
+
+  def _rules(self, findings):
+    return {f.rule for f in findings}
+
+  def test_flags_unbarriered_device_window(self):
+    out = tracer_check.check_python_source(_BAD_TIMING, "x.py")
+    assert self._rules(out) == {"device-timing"}
+    assert "dispatch, not execution" in out[0].message
+
+  def test_barrier_in_window_passes(self):
+    for barrier in ("np.asarray(y)", "backend.sync(y)",
+                    "jax.device_get(y)", "y.item()"):
+      src = _BAD_TIMING.replace(
+          "  return time.perf_counter() - t0",
+          f"  import numpy as np\n"
+          f"  import jax\n"
+          f"  from tensor2robot_tpu.utils import backend\n"
+          f"  {barrier}\n"
+          f"  return time.perf_counter() - t0")
+      out = tracer_check.check_python_source(src, "x.py")
+      assert self._rules(out) == set(), (barrier, out)
+
+  def test_host_only_window_passes(self):
+    src = ("import time\n\ndef f(stream):\n"
+           "  t0 = time.perf_counter()\n"
+           "  batch = next(stream)\n"
+           "  return time.perf_counter() - t0\n")
+    assert tracer_check.check_python_source(src, "x.py") == []
+
+  def test_two_variable_close_detected(self):
+    src = ("import time\nimport jax\n\ndef f(x):\n"
+           "  start = time.time()\n"
+           "  y = jax.device_put(x)\n"
+           "  now = time.time()\n"
+           "  return now - start\n")
+    out = tracer_check.check_python_source(src, "x.py")
+    assert self._rules(out) == {"device-timing"}
+
+  def test_suppressible(self):
+    src = _BAD_TIMING.replace(
+        "return time.perf_counter() - t0",
+        "return time.perf_counter() - t0"
+        "  # graftlint: disable=device-timing")
+    assert tracer_check.check_python_source(src, "x.py") == []
+
+  def test_obs_and_backend_paths_exempt(self, tmp_path):
+    for rel in ("tensor2robot_tpu/obs/timing.py", "utils/backend.py"):
+      target = tmp_path / rel
+      target.parent.mkdir(parents=True, exist_ok=True)
+      target.write_text(_BAD_TIMING)
+      assert tracer_check.check_python_file(str(target)) == []
+    plain = tmp_path / "plain.py"
+    plain.write_text(_BAD_TIMING)
+    assert self._rules(tracer_check.check_python_file(str(plain))) \
+        == {"device-timing"}
+
+  def test_nested_function_body_not_part_of_window(self):
+    src = ("import time\nimport jax.numpy as jnp\n\ndef f(x):\n"
+           "  t0 = time.perf_counter()\n"
+           "  def g():\n"
+           "    return jnp.dot(x, x)\n"
+           "  return time.perf_counter() - t0\n")
+    assert tracer_check.check_python_source(src, "x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# ProfilerHook degrades gracefully when the profiler is unavailable.
+# ---------------------------------------------------------------------------
+
+
+class TestProfilerGuard:
+
+  def test_start_trace_failure_logs_once_and_disarms(self, tmp_path,
+                                                     monkeypatch):
+    import jax
+
+    calls = []
+
+    def boom(log_dir):
+      calls.append(log_dir)
+      raise RuntimeError("profiler service unreachable over tunnel")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    hook = profiler_lib.ProfilerHook(start_step=1, num_steps=2)
+    ctx = type("Ctx", (), {"model_dir": str(tmp_path)})()
+    hook.after_step(ctx, 1, {})  # must NOT raise
+    hook.after_step(ctx, 1, {})  # disarmed: no retry
+    hook.after_step(ctx, 3, {})
+    hook.end(ctx)
+    assert len(calls) == 1
+    snap = metrics_lib.snapshot()
+    assert snap["counter/profiler/start_failures"] == 1.0
+    assert snap["gauge/profiler/trace_captured"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: CPU-mesh train run -> per-step records, trace, CLI report.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _clean_config():
+  config.clear_config()
+  yield
+  config.clear_config()
+
+
+class TestTrainLoopStepStats:
+
+  def _train(self, model_dir, **kwargs):
+    return train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir,
+        mode="train",
+        max_train_steps=6,
+        checkpoint_every_n_steps=100,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        log_every_n_steps=2,
+        **kwargs)
+
+  def _stepstats_records(self, model_dir):
+    path = os.path.join(model_dir, "train", "metrics.jsonl")
+    assert os.path.isfile(path)
+    with open(path) as f:
+      records = [json.loads(line) for line in f if line.strip()]
+    return records, [r for r in records
+                     if all(k in r for k in ("data_wait_ms", "device_ms",
+                                             "examples_per_sec"))]
+
+  def test_train_run_emits_per_step_stepstats_trace_and_report(
+      self, tmp_path, capsys):
+    model_dir = str(tmp_path / "run")
+    self._train(model_dir)
+    records, step_records = self._stepstats_records(model_dir)
+    # Acceptance: per-step data_wait_ms / device_ms / examples_per_sec.
+    assert [r["step"] for r in step_records] == [1, 2, 3, 4, 5, 6]
+    for r in step_records:
+      assert r["data_wait_ms"] >= 0 and r["device_ms"] >= 0
+      assert r["examples_per_sec"] > 0
+      assert math.isfinite(r["step_ms"])
+    assert step_records[0]["compile"] == 1.0  # first dispatch compiles
+    # Final registry snapshot rides the same JSONL stream.
+    assert any("hist/stepstats/step_ms/p50" in r for r in records)
+    # Perfetto-loadable trace with the step windows.
+    trace_path = os.path.join(model_dir, "train", "trace.graftscope.json")
+    assert os.path.isfile(trace_path)
+    with open(trace_path) as f:
+      payload = json.load(f)
+    names = [e["name"] for e in payload["traceEvents"]
+             if e.get("ph") == "X"]
+    assert names.count("train/step_window") == 6
+    assert "train/data_wait" in names and "train/barrier" in names
+    # Reader CLI renders a non-empty report from exactly these files.
+    assert graftscope.main([model_dir]) == 0
+    out = capsys.readouterr().out
+    assert "step-time breakdown" in out
+    assert "data_wait_ms" in out and "device_ms" in out
+    assert "train/step_window" in out  # slowest-spans table
+    assert "compile events: " in out
+
+  def test_step_stats_disabled_leaves_stream_clean(self, tmp_path):
+    model_dir = str(tmp_path / "off")
+    self._train(model_dir, step_stats_every_n_steps=0)
+    _, step_records = self._stepstats_records(model_dir)
+    assert step_records == []
+    assert not os.path.isfile(
+        os.path.join(model_dir, "train", "trace.graftscope.json"))
+
+  def test_windowed_cadence_with_iterations_per_loop(self, tmp_path):
+    """K-step loop dispatch + cadence 3: windows close on loop
+    boundaries (steps 3 and 6), averaging per step."""
+    model_dir = str(tmp_path / "loop")
+    self._train(model_dir, iterations_per_loop=3,
+                step_stats_every_n_steps=3)
+    _, step_records = self._stepstats_records(model_dir)
+    assert [r["step"] for r in step_records] == [3, 6]
+    for r in step_records:
+      assert r["steps_in_window"] == 3.0
+      assert r["examples_per_sec"] > 0
+
+  def test_graftscope_cli_exit_codes(self, tmp_path, capsys):
+    assert graftscope.main([str(tmp_path / "missing")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert graftscope.main([str(empty)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: obs + reader CLI are backend-free (poisoned-platform trap).
+# ---------------------------------------------------------------------------
+
+
+def test_obs_imports_and_cli_run_backend_free(tmp_path):
+  """`tensor2robot_tpu.obs` must import — and trace/metrics/CLI must
+  RUN — without initializing any JAX backend (same two-layer proof as
+  the analysis suite: poisoned JAX_PLATFORMS + empty backend cache)."""
+  code = """
+import json, sys
+from tensor2robot_tpu import obs
+from tensor2robot_tpu.obs import metrics, trace
+trace.enable()
+with trace.span("smoke"):
+    metrics.counter("smoke/count").inc()
+    metrics.histogram("smoke/ms").record(1.5)
+trace.save(sys.argv[1] + "/t/trace.graftscope.json")
+from tensor2robot_tpu.utils import summaries
+w = summaries.SummaryWriter(sys.argv[1] + "/t", use_tensorboard=False)
+w.write_scalars(1, dict(metrics.snapshot(),
+                        data_wait_ms=1.0, device_ms=2.0,
+                        examples_per_sec=3.0))
+w.close()
+from tensor2robot_tpu.bin import graftscope
+rc = graftscope.main([sys.argv[1]])
+assert rc == 0, rc
+from jax._src import xla_bridge
+live = getattr(xla_bridge, "_backends", None)
+assert not live, f"jax backends were initialized: {sorted(live)}"
+print("OBS_NO_BACKEND_OK")
+"""
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "graftscope_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run(
+      [sys.executable, "-c", code, str(tmp_path)],
+      capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "OBS_NO_BACKEND_OK" in result.stdout

@@ -3,7 +3,11 @@ invariants hold for arbitrary structures, not just the hand-picked
 cases."""
 
 import numpy as np
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip("hypothesis")  # not baked into every image; the
+# suite must stay collectable without it (skip, don't error).
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import codec, parsing
