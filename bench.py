@@ -126,29 +126,43 @@ def probe_main(cfg: dict) -> dict:
   labels = jax.device_put(_batches(label_spec, 100, loop_steps), device)
   state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
                                    init_features)
-  # AOT-compile once: the executable is both the timed step and the
-  # source of the XLA cost analysis (flops + bytes per step) — no
-  # second trace/compile over the tunnel. The bench must emit its
-  # number even when the backend lacks AOT/cost support, so both are
-  # best-effort with the plain jitted step as fallback.
+  # AOT-compile once through graftscope-xray: the executable is both
+  # the timed step and the source of the XLA cost analysis (flops +
+  # bytes per step) — no second trace/compile over the tunnel — and the
+  # xray record additionally carries compile time, jaxpr size, donated
+  # bytes and temp memory for the run-history record. The bench must
+  # emit its number even when the backend lacks AOT/cost support, so
+  # the analysis is best-effort with the plain jitted step as fallback.
+  from tensor2robot_tpu.obs import xray as xray_lib
+
   flops = bytes_accessed = float("nan")
+  xray_rec = None
   if loop_steps > 1:
     step = ts.make_train_loop(model, loop_steps)
   else:
     step = ts.make_train_step(model)
   try:
-    step = step.lower(state, features, labels).compile()
-    cost = step.cost_analysis()
-    cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
-    flops = float(cost.get("flops", float("nan")))
-    bytes_accessed = float(cost.get("bytes accessed", float("nan")))
+    step, xray_rec = xray_lib.analyze_jit(
+        "bench/train_loop" if loop_steps > 1 else "bench/train_step",
+        step, state, features, labels)
+    flops = float(xray_rec["flops"]
+                  if xray_rec["flops"] is not None else float("nan"))
+    bytes_accessed = float(
+        xray_rec["bytes_accessed"]
+        if xray_rec["bytes_accessed"] is not None else float("nan"))
   except Exception as e:  # noqa: BLE001 - efficiency fields are optional
-    # If .lower()/.compile() itself failed, `step` is still the plain
-    # jitted fn; if only cost_analysis failed, it is the (callable)
-    # AOT executable. Either way the timing loop below works.
+    # `step` is still the plain jitted fn here; the timing loop below
+    # works either way.
     print(f"bench: AOT cost analysis unavailable "
           f"({type(e).__name__}: {e}); efficiency fields will be null",
           file=sys.stderr)
+  memory = None
+  try:
+    memory = xray_lib.memory_accounting(state, batch=(features, labels))
+    memory["hbm_watermark_bytes"] = xray_lib.hbm_watermark_estimate(
+        memory, [xray_rec] if xray_rec else [])
+  except Exception:  # noqa: BLE001 - memory accounting is optional
+    pass
   # backend_lib.time_train_steps_halves is the one shared tunnel-safe
   # timing recipe: warmup -> host-fetch barrier on the smallest param
   # leaf (block_until_ready returns early over the axon tunnel; the
@@ -201,6 +215,11 @@ def probe_main(cfg: dict) -> dict:
       "platform": device.platform,
       "batch_size": batch_size,
       "loop_steps": loop_steps,
+      # graftscope-xray blocks (JSON-safe dicts; None when unavailable):
+      # compile telemetry + per-shard/HBM-watermark accounting for the
+      # run-history record the parent appends to runs.jsonl.
+      "xray": xray_rec,
+      "memory": memory,
   }
 
 
@@ -441,6 +460,54 @@ def _record_probe(rec: dict) -> dict:
   return rec
 
 
+def _xray_headline_block(probe_rec: dict) -> dict:
+  """The headline JSON's `xray` block from one probe record — ONE
+  shape for the TPU and CPU-smoke paths, so the two bench modes cannot
+  drift into divergent schemas inside the same runs.jsonl."""
+  xray_rec = probe_rec.get("xray") or {}
+  memory = probe_rec.get("memory") or {}
+  return {
+      "compile_sec": xray_rec.get("compile_s"),
+      "jaxpr_eqns": xray_rec.get("jaxpr_eqns"),
+      "arithmetic_intensity": xray_rec.get("arithmetic_intensity"),
+      "roofline_ms": xray_rec.get("roofline_ms"),
+      "hbm_watermark_bytes": memory.get("hbm_watermark_bytes"),
+  }
+
+
+def _append_runlog(headline: dict, probe_rec: dict) -> None:
+  """Appends this bench run to the repo-root `runs.jsonl` (override with
+  GRAFTSCOPE_RUNS) so the BENCH_* trajectory is machine-comparable:
+  `python -m tensor2robot_tpu.bin.graftscope diff runs.jsonl#-2
+  runs.jsonl#-1` prices a round against the previous one. Best-effort —
+  the headline JSON never depends on the history append."""
+  try:
+    from tensor2robot_tpu.obs import runlog
+
+    xray_rec = probe_rec.get("xray")
+    bench_block = dict(headline)
+    bench_block.pop("graftscope", None)  # registry snapshot, not diffable
+    bench_block["step_sec"] = probe_rec.get("step_sec")
+    # runs.jsonl is strict JSON (allow_nan=False): one NaN/inf scalar
+    # (e.g. a degenerate timing) must cost that field, not the record.
+    for key, value in list(bench_block.items()):
+      if isinstance(value, float) and not math.isfinite(value):
+        bench_block[key] = None
+    record = runlog.make_record(
+        "bench",
+        platform=probe_rec.get("platform"),
+        device_kind=probe_rec.get("device_kind"),
+        compile_records=[xray_rec] if xray_rec else None,
+        memory=probe_rec.get("memory"),
+        bench=bench_block)
+    runs_path = os.environ.get("GRAFTSCOPE_RUNS") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "runs.jsonl")
+    runlog.append_record(runs_path, record)
+  except Exception as e:  # noqa: BLE001 - history is telemetry, not output
+    print(f"bench: runs.jsonl append failed ({type(e).__name__}: {e})",
+          file=sys.stderr)
+
+
 def _graftscope_block() -> dict:
   """Stable telemetry schema for the headline JSON: probe counters are
   pre-created so the keys exist even on a zero-probe (CPU-fallback)
@@ -474,7 +541,7 @@ def main() -> None:
                                PEAK_BF16_FLOPS["default"])
     flops = best.get("flops")
     mfu = (flops / step_sec / peak) if flops else None
-    print(json.dumps({
+    headline = {
         "metric": "qtopt_grasps_per_sec_per_chip",
         "value": round(eps, 2),
         "unit": "examples/sec",
@@ -495,8 +562,14 @@ def main() -> None:
         "bytes_per_step": best.get("bytes_accessed"),
         "device_kind": best.get("device_kind"),
         "probes_aborted": best["aborted"],
+        # Below-dispatch introspection for the winning probe (obs.xray):
+        # compile economics + the per-chip HBM watermark estimate that
+        # rounds 2-5 OOMed without.
+        "xray": _xray_headline_block(best),
         "graftscope": _graftscope_block(),
-    }))
+    }
+    print(json.dumps(headline))
+    _append_runlog(headline, best)
     return
   # Device backend unreachable (or every TPU probe failed): CPU smoke
   # fallback, in-process — pin_cpu never touches the tunnel. Honest
@@ -508,14 +581,17 @@ def main() -> None:
   rec = _record_probe(
       probe_main({"platform": "cpu", "batch_size": 16, "reruns": 3}))
   cpu_anchor = 3643.0  # recorded for this exact config at batch 16
-  print(json.dumps({
+  headline = {
       "metric": "qtopt_grasps_per_sec_cpu_smoke",
       "value": round(rec["examples_per_sec"], 2),
       "unit": "examples/sec",
       "vs_baseline": round(rec["examples_per_sec"] / cpu_anchor, 3),
       "batch_size": rec["batch_size"],
+      "xray": _xray_headline_block(rec),
       "graftscope": _graftscope_block(),
-  }))
+  }
+  print(json.dumps(headline))
+  _append_runlog(headline, rec)
 
 
 if __name__ == "__main__":

@@ -1,5 +1,6 @@
 #!/usr/bin/env bash
-# graftscope reader wrapper: summarize a model_dir's telemetry, CPU-pinned.
+# graftscope reader wrapper: summarize a model_dir's telemetry, list run
+# history, or diff two runs — CPU-pinned.
 #
 # The reader never uses a JAX backend, but this machine's environment
 # forces JAX_PLATFORMS=axon (TPU tunnel) and a wedged tunnel hangs any
@@ -9,8 +10,15 @@
 # the same belt-and-braces recipe as scripts/lint.sh.
 #
 # Usage: scripts/obs_report.sh <model_dir> [--top N]
+#        scripts/obs_report.sh --history <model_dir|runs.jsonl>
+#        scripts/obs_report.sh --diff <runA> <runB> [--threshold m=rel]
+#   (run references: model_dir / runs.jsonl, optional #run_id or #index)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+case "${1:-}" in
+  --diff) shift; set -- diff "$@" ;;
+  --history) shift; set -- history "$@" ;;
+esac
 exec python -c '
 import sys
 from tensor2robot_tpu.utils import backend

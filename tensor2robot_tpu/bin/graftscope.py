@@ -1,16 +1,29 @@
-"""graftscope reader CLI: summarize a model_dir's telemetry as text.
+"""graftscope reader CLI: reports, run history, and regression diffs.
 
 The write side lives in `tensor2robot_tpu/obs/` (span tracer, metrics
-registry, step stats — see docs/ARCHITECTURE.md "Observability"); this
-is the read side: it walks a model_dir for `metrics.jsonl` event
-streams, Chrome trace JSONs (`trace.graftscope.json`), and
-`jax.profiler` dirs, and renders a step-time breakdown table, counter
-totals, and the slowest spans.
+registry, step stats, xray compile/memory records, runlog — see
+docs/ARCHITECTURE.md "Observability"); this is the read side:
 
-Usage:
-  python -m tensor2robot_tpu.bin.graftscope <model_dir>
-  python -m tensor2robot_tpu.bin.graftscope <model_dir> --top 20
-  scripts/obs_report.sh <model_dir>      # CPU-pinned wrapper
+  python -m tensor2robot_tpu.bin.graftscope <model_dir> [--top N]
+      walk the model_dir for `metrics.jsonl` streams, Chrome trace
+      JSONs, `runs.jsonl` and `jax.profiler` dirs; render step-time
+      breakdown, counters, slowest spans, and the latest run's
+      xray/compile summary ("report" may be spelled explicitly);
+  python -m tensor2robot_tpu.bin.graftscope history <dir-or-runs.jsonl>
+      one line per recorded run (index, run_id, key metrics);
+  python -m tensor2robot_tpu.bin.graftscope diff <runA> <runB>
+      metric deltas with direction-aware regression thresholds
+      (`obs.runlog.DEFAULT_THRESHOLDS`; override per metric with
+      --threshold name=rel). A run reference is a model_dir, a
+      runs.jsonl path, or either with `#run_id` / `#index` (negative
+      from the end); bare paths mean the LATEST record. Exit 3 = a
+      delta crossed its regression threshold (0 ok, 2 bad reference).
+
+Robustness contract: a torn tail line of a live run, a truncated trace
+JSON, or binary garbage in any telemetry file is skipped with a warning
+counter (`graftscope/corrupt_lines`, surfaced in the report) — the
+reader NEVER raises on files a crashed writer left behind; a missing
+model_dir is a clear message + exit 2.
 
 Backend-free by construction (argparse, stdlib + numpy only): like the
 `analysis/` CLIs it must be safe to run on the tunnel machine while a
@@ -27,6 +40,7 @@ import sys
 from typing import Dict, List, Optional, Tuple
 
 from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import runlog as runlog_lib
 
 __all__ = ["build_report", "main"]
 
@@ -56,18 +70,12 @@ def _discover(model_dir: str) -> Tuple[List[str], List[str], List[str]]:
   return metrics_files, trace_files, sorted(set(profile_dirs))
 
 
-def _load_jsonl(path: str) -> List[dict]:
-  records = []
-  with open(path) as f:
-    for line in f:
-      line = line.strip()
-      if not line:
-        continue
-      try:
-        records.append(json.loads(line))
-      except ValueError:
-        continue  # torn tail line of a live run
-  return records
+def _load_jsonl(path: str) -> Tuple[List[dict], int]:
+  """(records, corrupt-line count) — torn tail lines of a live run and
+  garbage are skipped, counted, and warned, never raised (the shared
+  tolerant reader, `obs.runlog.read_jsonl`)."""
+  return runlog_lib.read_jsonl(path,
+                               counter_name="graftscope/corrupt_lines")
 
 
 def _split_records(records: List[dict]
@@ -159,7 +167,10 @@ def _span_lines(trace_files: List[str], top: int) -> List[str]:
     try:
       with open(path) as f:
         payload = json.load(f)
-    except (OSError, ValueError):
+    except (OSError, ValueError) as e:
+      metrics_lib.counter("graftscope/corrupt_trace_files").inc()
+      print(f"graftscope: skipping corrupt trace {path} "
+            f"({type(e).__name__})", file=sys.stderr)
       continue
     events = payload.get("traceEvents", payload) \
         if isinstance(payload, dict) else payload
@@ -182,13 +193,68 @@ def _span_lines(trace_files: List[str], top: int) -> List[str]:
   return lines
 
 
+def _compile_lines(record: dict) -> List[str]:
+  """xray compile-telemetry table from one runlog record."""
+  compiles = record.get("compile") or []
+  if not compiles:
+    return []
+  lines = ["xray compile telemetry (latest run)",
+           f"  {'executable':<22}{'compile_s':>10}{'eqns':>8}"
+           f"{'GF':>10}{'GB':>8}{'AI':>8}{'roofline_ms':>12}"]
+  for rec in compiles:
+    flops = rec.get("flops")
+    nbytes = rec.get("bytes_accessed")
+    ai = rec.get("arithmetic_intensity")
+    roofline = rec.get("roofline_ms")
+    fmt = lambda v, scale=1.0: (f"{v / scale:.2f}" if v is not None
+                                else "—")
+    lines.append(
+        f"  {str(rec.get('name', '?')):<22}"
+        f"{fmt(rec.get('compile_s')):>10}"
+        f"{rec.get('jaxpr_eqns', 0):>8}"
+        f"{fmt(flops, 1e9):>10}{fmt(nbytes, 1e9):>8}"
+        f"{fmt(ai):>8}{fmt(roofline):>12}")
+  return lines
+
+
+def _runlog_sections(model_dir: str) -> Tuple[List[List[str]], int]:
+  """(run-history summary + xray compile table sections for the latest
+  record, corrupt-line count) — runs.jsonl garbage lands in the same
+  report head count / graftscope counter as every other telemetry file."""
+  path = os.path.join(model_dir, runlog_lib.RUNS_FILENAME)
+  records, skipped = _load_jsonl(path)
+  if not records:
+    return [], skipped
+  latest = records[-1]
+  lines = [f"run history ({len(records)} record(s) in "
+           f"{runlog_lib.RUNS_FILENAME}; compare with "
+           "`graftscope diff`)"]
+  metrics = runlog_lib.key_metrics(latest)
+  for name in sorted(metrics):
+    lines.append(f"  {name:<24}{metrics[name]:>16.6g}")
+  memory = latest.get("memory") or {}
+  if memory.get("hbm_watermark_bytes"):
+    lines.append(f"  {'hbm_watermark':<24}"
+                 f"{memory['hbm_watermark_bytes'] / 2**30:>13.3f} GiB"
+                 "  (per-shard estimate)")
+  sections = [lines]
+  compile_sec = _compile_lines(latest)
+  if compile_sec:
+    sections.append(compile_sec)
+  return sections, skipped
+
+
 def build_report(model_dir: str, top: int = 10) -> Optional[str]:
   """Renders the text report; None when no telemetry exists at all."""
   metrics_files, trace_files, profile_dirs = _discover(model_dir)
+  runs_path = os.path.join(model_dir, runlog_lib.RUNS_FILENAME)
   sections: List[List[str]] = []
   all_records: List[dict] = []
+  corrupt = 0
   for path in metrics_files:
-    all_records.extend(_load_jsonl(path))
+    records, skipped = _load_jsonl(path)
+    all_records.extend(records)
+    corrupt += skipped
   step_records, snapshot = _split_records(all_records)
   if step_records:
     sections.append(_breakdown_table(step_records))
@@ -204,25 +270,33 @@ def build_report(model_dir: str, top: int = 10) -> Optional[str]:
   span_sec = _span_lines(trace_files, top)
   if span_sec:
     sections.append(span_sec)
+  runlog_sections, runlog_skipped = _runlog_sections(model_dir)
+  sections.extend(runlog_sections)
+  corrupt += runlog_skipped
   if profile_dirs:
     sections.append(["jax.profiler traces (TensorBoard/Perfetto)"]
                     + [f"  {d}" for d in profile_dirs])
-  if not metrics_files and not trace_files and not profile_dirs:
+  if (not metrics_files and not trace_files and not profile_dirs
+      and not os.path.isfile(runs_path)):
     return None
   head = [f"graftscope report: {model_dir}",
           f"  {len(metrics_files)} metrics.jsonl file(s), "
           f"{len(all_records)} records, {len(trace_files)} trace file(s)"]
+  if corrupt:
+    head.append(f"  {corrupt} corrupt/truncated line(s) skipped "
+                "(counter graftscope/corrupt_lines)")
   if not sections:
     sections = [["(telemetry files present but no graftscope records — "
                  "was the run made with step_stats_every_n_steps=0?)"]]
   return "\n\n".join("\n".join(s) for s in [head] + sections) + "\n"
 
 
-def main(argv: Optional[List[str]] = None) -> int:
+def _main_report(argv: List[str]) -> int:
   parser = argparse.ArgumentParser(
-      prog="python -m tensor2robot_tpu.bin.graftscope",
+      prog="python -m tensor2robot_tpu.bin.graftscope [report]",
       description="Summarize graftscope telemetry (metrics.jsonl + "
-                  "trace JSON) under a model_dir into a text report.")
+                  "trace JSON + runs.jsonl) under a model_dir into a "
+                  "text report.")
   parser.add_argument("model_dir", help="train/eval output directory")
   parser.add_argument("--top", type=int, default=10,
                       help="span rows in the slowest-spans table")
@@ -234,11 +308,99 @@ def main(argv: Optional[List[str]] = None) -> int:
   report = build_report(args.model_dir, top=args.top)
   if report is None:
     print(f"graftscope: no telemetry under {args.model_dir} "
-          "(no metrics.jsonl, trace JSON, or profiler dirs)",
+          "(no metrics.jsonl, trace JSON, runs.jsonl, or profiler dirs)",
           file=sys.stderr)
     return 1
   print(report, end="")
   return 0
+
+
+def _main_history(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope history",
+      description="List the run records in a model_dir's (or file's) "
+                  "runs.jsonl, one line per run.")
+  parser.add_argument("source", help="model_dir or runs.jsonl path")
+  args = parser.parse_args(argv)
+  path = args.source
+  if os.path.isdir(path):
+    path = os.path.join(path, runlog_lib.RUNS_FILENAME)
+  if not os.path.isfile(path):
+    print(f"graftscope: no run history at {args.source} "
+          f"(no such file: {path})", file=sys.stderr)
+    return 2
+  records = runlog_lib.load_records(path)
+  if not records:
+    print(f"graftscope: no parseable run records in {path}",
+          file=sys.stderr)
+    return 1
+  print("\n".join(runlog_lib.history_lines(records, path)))
+  return 0
+
+
+def _parse_threshold(spec: str):
+  name, _, value = spec.partition("=")
+  if not name or not value:
+    raise argparse.ArgumentTypeError(
+        f"expected metric=relative_threshold, got {spec!r}")
+  try:
+    return name, float(value)
+  except ValueError:
+    raise argparse.ArgumentTypeError(
+        f"threshold for {name!r} is not a number: {value!r}")
+
+
+def _main_diff(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope diff",
+      description="Compare two run records' key metrics with "
+                  "direction-aware regression thresholds. A run "
+                  "reference is a model_dir or runs.jsonl path, "
+                  "optionally suffixed #run_id or #index (negative "
+                  "from the end); bare paths pick the latest record. "
+                  "Exit 3 when a delta crosses its threshold.")
+  parser.add_argument("run_a", help="baseline run reference")
+  parser.add_argument("run_b", help="candidate run reference")
+  parser.add_argument("--threshold", action="append", default=[],
+                      type=_parse_threshold, metavar="METRIC=REL",
+                      help="override a metric's relative regression "
+                           "threshold (e.g. examples_per_sec=0.05); "
+                           "repeatable; direction stays the metric's "
+                           "default")
+  parser.add_argument("--default-threshold", type=float, default=0.10,
+                      help="|relative-change| threshold for metrics "
+                           "without a configured direction")
+  args = parser.parse_args(argv)
+  try:
+    record_a, _ = runlog_lib.resolve_run(args.run_a)
+    record_b, _ = runlog_lib.resolve_run(args.run_b)
+  except runlog_lib.RunResolveError as e:
+    print(f"graftscope: {e}", file=sys.stderr)
+    return 2
+  overrides = {}
+  for name, value in args.threshold:
+    direction = runlog_lib.DEFAULT_THRESHOLDS.get(name, ("abs", 0.0))[0]
+    overrides[name] = (direction, value)
+  deltas = runlog_lib.diff_records(
+      record_a, record_b, thresholds=overrides,
+      default_threshold=args.default_threshold)
+  print(runlog_lib.format_diff(record_a, record_b, deltas), end="")
+  return 3 if any(d["regressed"] for d in deltas) else 0
+
+
+_SUBCOMMANDS = {"report": _main_report, "history": _main_history,
+                "diff": _main_diff}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+  argv = list(sys.argv[1:] if argv is None else argv)
+  # Back-compat: `graftscope <model_dir>` (no subcommand) is a report.
+  # Subcommand names win over a same-named relative model_dir — report
+  # a directory literally called `diff`/`history`/`report` via
+  # `graftscope report diff` or `graftscope ./diff`.
+  if argv and argv[0] in _SUBCOMMANDS:
+    return _SUBCOMMANDS[argv[0]](argv[1:])
+  return _main_report(argv)
 
 
 if __name__ == "__main__":

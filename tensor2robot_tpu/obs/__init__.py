@@ -11,18 +11,26 @@ timing that diagnosed every perf round by hand (PERFORMANCE.md):
   snapshotted into the JSONL event stream (`utils/summaries.py`);
 * `stepstats` — per-train-step breakdown (data-wait vs device time via
   `utils/backend.sync` semantics, compile-event detection, throughput,
-  live-array gauges).
+  live-array gauges);
+* `xray`      — below-dispatch introspection: per-executable compile
+  timing, jaxpr equation counts, donation byte accounting, XLA
+  cost/memory analysis, analytic MFU/roofline, and per-shard
+  state/batch/HBM-watermark accounting;
+* `runlog`    — schema-versioned append-only run history
+  (`runs.jsonl`) with direction-aware regression diffing.
 
 Backend-free by construction: importing this package (and using trace /
-metrics) never touches a JAX backend — the same discipline as
+metrics / runlog) never touches a JAX backend — the same discipline as
 `analysis/` (tests/test_observability.py proves it under a poisoned
-JAX_PLATFORMS). Only `stepstats` touches the backend, lazily, from
-inside a live train loop where the backend is already up.
+JAX_PLATFORMS). Only `stepstats` and the `xray` analysis functions
+touch the backend, lazily, from inside a live train loop where the
+backend is already up.
 
 Read telemetry back with `python -m tensor2robot_tpu.bin.graftscope
-<model_dir>` (or `scripts/obs_report.sh`).
+<model_dir>` (or `scripts/obs_report.sh`); compare runs with
+`... graftscope diff <runA> <runB>` / `... graftscope history <dir>`.
 """
 
-from tensor2robot_tpu.obs import metrics, stepstats, trace
+from tensor2robot_tpu.obs import metrics, runlog, stepstats, trace, xray
 
-__all__ = ["metrics", "stepstats", "trace"]
+__all__ = ["metrics", "runlog", "stepstats", "trace", "xray"]

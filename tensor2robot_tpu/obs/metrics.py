@@ -24,6 +24,7 @@ imports jax.
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 import time
@@ -34,7 +35,7 @@ import numpy as np
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry", "get_registry",
            "counter", "gauge", "histogram", "snapshot", "reset",
-           "percentiles"]
+           "isolated", "percentiles"]
 
 DEFAULT_RESERVOIR_SIZE = 4096
 _PCTS = (50.0, 90.0, 99.0)
@@ -247,3 +248,25 @@ def snapshot(prefix: Optional[str] = None) -> Dict[str, float]:
 
 def reset() -> None:
   _GLOBAL.reset()
+
+
+@contextlib.contextmanager
+def isolated(registry: Optional[Registry] = None):
+  """Swaps the process-global registry for a fresh one within the scope.
+
+  Hermetic-test support: unlike `reset()` — which destroys whatever
+  other suites recorded into the shared singleton — this snapshots the
+  current global, installs `registry` (default: a fresh `Registry`),
+  and restores the original on exit, so tests cannot leak counters into
+  each other OR wipe state that outlives them. Components that captured
+  the registry object before entry keep writing to the old one; the
+  shipped instrumentation resolves `get_registry()` / the module-level
+  helpers at call time and lands in the isolated registry.
+  """
+  global _GLOBAL
+  previous = _GLOBAL
+  _GLOBAL = registry if registry is not None else Registry()
+  try:
+    yield _GLOBAL
+  finally:
+    _GLOBAL = previous

@@ -1,0 +1,381 @@
+"""Run-history records (`runs.jsonl`) and regression diffing.
+
+The reference's only run-over-run comparison is a human reading
+TensorBoard (/root/reference/models/abstract_model.py:873-936 host_call
+scalars); this project's own perf history (BENCH_r01..r05, the round-5
+valley, three blind OOMs) lived in hand-written markdown. This module
+makes the trajectory machine-comparable: every train/bench run appends
+ONE schema-versioned JSON line — step-stat summary, compile telemetry
+(`obs.xray` records), memory watermark, bench numbers — to an
+append-only `runs.jsonl`, and `diff_records` compares two records'
+canonical metrics against direction-aware regression thresholds
+(throughput regresses DOWN, step time / compile time / watermark
+regress UP).
+
+Readers are tolerant by contract: a torn tail line from a live run or a
+corrupt record is skipped and counted (`runlog/corrupt_lines`), never
+raised — same discipline as `bin/graftscope`'s metrics reader.
+
+Backend-free by construction (stdlib + the metrics registry only):
+`python -m tensor2robot_tpu.bin.graftscope diff` must be safe on the
+tunnel machine while a training job owns the TPU
+(tests/test_observability.py proves it under a poisoned JAX_PLATFORMS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tensor2robot_tpu.obs import metrics as metrics_lib
+
+__all__ = ["SCHEMA", "SCHEMA_VERSION", "RUNS_FILENAME", "new_run_id",
+           "make_record", "append_record", "read_jsonl", "load_records",
+           "step_stats_summary", "key_metrics", "DEFAULT_THRESHOLDS",
+           "diff_records", "format_diff", "resolve_run", "history_lines",
+           "RunResolveError"]
+
+SCHEMA = "graftscope-run-v1"
+SCHEMA_VERSION = 1
+RUNS_FILENAME = "runs.jsonl"
+
+# metric name -> (bad direction, default relative threshold). "up" means
+# an increase beyond the threshold is a regression; "down" a decrease.
+# Compile time gets the loosest band (host-load noise swings it), flops
+# the tightest (the executable's flop count is deterministic — ANY
+# growth is a real model/step change).
+DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
+    "examples_per_sec": ("down", 0.10),
+    "mfu": ("down", 0.10),
+    "step_ms": ("up", 0.10),
+    "compile_time_s": ("up", 0.50),
+    "flops_per_step": ("up", 0.05),
+    "bytes_per_step": ("up", 0.10),
+    "jaxpr_eqns": ("up", 0.25),
+    "hbm_watermark_bytes": ("up", 0.10),
+}
+
+
+class RunResolveError(ValueError):
+  """A run reference did not resolve to a record (CLI exits 2 on it)."""
+
+
+def new_run_id() -> str:
+  return (time.strftime("%Y%m%dT%H%M%S")
+          + f"-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+
+
+def make_record(kind: str,
+                run_id: Optional[str] = None,
+                platform: Optional[str] = None,
+                device_kind: Optional[str] = None,
+                num_devices: Optional[int] = None,
+                step_stats: Optional[Dict[str, float]] = None,
+                compile_records: Optional[Sequence[Dict[str, Any]]] = None,
+                memory: Optional[Dict[str, float]] = None,
+                bench: Optional[Dict[str, Any]] = None,
+                extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+  """One schema-versioned run record (JSON-safe plain dict)."""
+  if kind not in ("train", "bench"):
+    raise ValueError(f"Unknown run-record kind {kind!r}")
+  record: Dict[str, Any] = {
+      "schema": SCHEMA,
+      "schema_version": SCHEMA_VERSION,
+      "kind": kind,
+      "run_id": run_id or new_run_id(),
+      "unix_time": time.time(),
+  }
+  if platform is not None:
+    record["platform"] = platform
+  if device_kind is not None:
+    record["device_kind"] = device_kind
+  if num_devices is not None:
+    record["num_devices"] = int(num_devices)
+  if step_stats:
+    record["step_stats"] = dict(step_stats)
+  if compile_records:
+    record["compile"] = [dict(r) for r in compile_records]
+  if memory:
+    record["memory"] = dict(memory)
+  if bench:
+    record["bench"] = dict(bench)
+  if extra:
+    record["extra"] = dict(extra)
+  return record
+
+
+def append_record(path: str, record: Dict[str, Any]) -> str:
+  """Appends one strict-JSON line (fsynced — a crash right after a run
+  must not lose the record); returns `path`."""
+  os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+  line = json.dumps(record, allow_nan=False, sort_keys=True)
+  with open(path, "a") as f:
+    f.write(line + "\n")
+    f.flush()
+    os.fsync(f.fileno())
+  return path
+
+
+def read_jsonl(path: str, counter_name: str = "runlog/corrupt_lines",
+               registry: Optional[metrics_lib.Registry] = None
+               ) -> Tuple[List[Dict[str, Any]], int]:
+  """THE tolerant JSONL reader: (dict records, corrupt-line count).
+
+  Corrupt / truncated lines (torn tail of a live run, binary garbage,
+  disk hiccups) are skipped with a stderr warning and counted in
+  `counter/<counter_name>` — a reader must never raise on a file a
+  crashed writer left behind (`errors="replace"` keeps even invalid
+  UTF-8 from raising). A missing file is an empty history. The one
+  shared implementation behind `load_records` AND `bin/graftscope`'s
+  metrics reader, so a tolerance fix lands in both.
+  """
+  reg = registry or metrics_lib.get_registry()
+  records: List[Dict[str, Any]] = []
+  if not os.path.isfile(path):
+    return records, 0
+  skipped = 0
+  try:
+    with open(path, errors="replace") as f:
+      for line in f:
+        line = line.strip()
+        if not line:
+          continue
+        try:
+          record = json.loads(line)
+          if not isinstance(record, dict):
+            raise ValueError("record is not an object")
+          records.append(record)
+        except ValueError:
+          skipped += 1
+  except OSError as e:
+    print(f"runlog: cannot read {path}: {e}", file=sys.stderr)
+    skipped += 1
+  if skipped:
+    reg.counter(counter_name).inc(skipped)
+    print(f"runlog: skipped {skipped} corrupt line(s) in {path}",
+          file=sys.stderr)
+  return records, skipped
+
+
+def load_records(path: str,
+                 registry: Optional[metrics_lib.Registry] = None
+                 ) -> List[Dict[str, Any]]:
+  """Every parseable record in `path`, oldest first (see `read_jsonl`)."""
+  records, _ = read_jsonl(path, registry=registry)
+  return records
+
+
+def step_stats_summary(snapshot: Dict[str, float]) -> Dict[str, float]:
+  """Run-record step-stat summary from a metrics-registry snapshot
+  (the `stepstats/*` histograms `obs.stepstats` feeds every window)."""
+  out: Dict[str, float] = {}
+  for hist, dst in (("step_ms", "step_ms"), ("device_ms", "device_ms"),
+                    ("data_wait_ms", "data_wait_ms"),
+                    ("examples_per_sec", "examples_per_sec")):
+    for stat in ("mean", "p50", "p90"):
+      value = snapshot.get(f"hist/stepstats/{hist}/{stat}")
+      if value is not None:
+        out[f"{dst}_{stat}"] = float(value)
+  count = snapshot.get("hist/stepstats/step_ms/count")
+  if count is not None:
+    out["windows"] = float(count)
+  compiles = snapshot.get("counter/stepstats/compile_events")
+  if compiles is not None:
+    out["compile_events"] = float(compiles)
+  return out
+
+
+def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
+  """The canonical comparable metrics of one record (diff vocabulary).
+
+  Sourced in priority order: step-stat summary, then bench headline
+  fields, then compile records (the `train`-named record is primary —
+  XLA prices a scan body once, so loop-mode flops are already
+  per-step), then the memory watermark. Missing sources just omit keys.
+  """
+  out: Dict[str, float] = {}
+  step_stats = record.get("step_stats") or {}
+  if step_stats.get("examples_per_sec_mean") is not None:
+    out["examples_per_sec"] = float(step_stats["examples_per_sec_mean"])
+  if step_stats.get("step_ms_mean") is not None:
+    out["step_ms"] = float(step_stats["step_ms_mean"])
+  bench = record.get("bench") or {}
+  if bench.get("value") is not None and "sec" in str(bench.get("unit", "")):
+    out.setdefault("examples_per_sec", float(bench["value"]))
+  if bench.get("step_sec") is not None:
+    out.setdefault("step_ms", float(bench["step_sec"]) * 1e3)
+  if bench.get("mfu") is not None:
+    out["mfu"] = float(bench["mfu"])
+  compiles = record.get("compile") or []
+  if compiles:
+    # All compile/cost metrics come from the PRIMARY executable — the
+    # first train-named record (the main loop/step, analyzed on first
+    # dispatch), falling back to the first record. Summing across
+    # records would diff the telemetry SHAPE, not the compiler: a run
+    # that also analyzed a loop tail or an in-process predictor must
+    # not read as a compile-time regression against one that didn't.
+    primary = next((r for r in compiles
+                    if "train" in str(r.get("name", ""))), compiles[0])
+    out["compile_time_s"] = (
+        float(primary.get("trace_s") or 0.0)
+        + float(primary.get("lower_s") or 0.0)
+        + float(primary.get("compile_s") or 0.0))
+    for src, dst in (("flops", "flops_per_step"),
+                     ("bytes_accessed", "bytes_per_step"),
+                     ("jaxpr_eqns", "jaxpr_eqns")):
+      if primary.get(src) is not None:
+        out[dst] = float(primary[src])
+  memory = record.get("memory") or {}
+  if memory.get("hbm_watermark_bytes"):
+    out["hbm_watermark_bytes"] = float(memory["hbm_watermark_bytes"])
+  return out
+
+
+def diff_records(a: Dict[str, Any], b: Dict[str, Any],
+                 thresholds: Optional[Dict[str, Tuple[str, float]]] = None,
+                 default_threshold: float = 0.10
+                 ) -> List[Dict[str, Any]]:
+  """Metric deltas b-vs-a with direction-aware regression flags.
+
+  `thresholds` overrides/extends `DEFAULT_THRESHOLDS` per metric;
+  metrics absent from both maps regress on |relative change| >
+  `default_threshold`. A metric present in only one record is listed
+  (delta None) but never flagged — new telemetry must not read as a
+  regression.
+  """
+  merged = dict(DEFAULT_THRESHOLDS)
+  merged.update(thresholds or {})
+  metrics_a, metrics_b = key_metrics(a), key_metrics(b)
+  deltas: List[Dict[str, Any]] = []
+  for name in sorted(set(metrics_a) | set(metrics_b)):
+    va, vb = metrics_a.get(name), metrics_b.get(name)
+    entry: Dict[str, Any] = {"metric": name, "a": va, "b": vb,
+                             "delta": None, "rel": None,
+                             "regressed": False}
+    if va is not None and vb is not None:
+      entry["delta"] = vb - va
+      rel = ((vb - va) / abs(va)) if va else (0.0 if vb == va
+                                             else float("inf"))
+      entry["rel"] = rel
+      direction, threshold = merged.get(name, (None, default_threshold))
+      entry["threshold"] = threshold
+      if direction == "up":
+        entry["regressed"] = rel > threshold
+      elif direction == "down":
+        entry["regressed"] = rel < -threshold
+      else:
+        entry["regressed"] = abs(rel) > threshold
+    deltas.append(entry)
+  return deltas
+
+
+def _describe(record: Dict[str, Any]) -> str:
+  when = record.get("unix_time")
+  stamp = (time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(when))
+           if when else "?")
+  return (f"{record.get('run_id', '?')} ({record.get('kind', '?')}, "
+          f"{record.get('platform', '?')}, {stamp})")
+
+
+def comparability_warnings(a: Dict[str, Any], b: Dict[str, Any]
+                           ) -> List[str]:
+  """Reasons the two records' deltas may not be meaningful.
+
+  The recurring case: a tunnel outage makes bench fall back to the CPU
+  smoke config (its own metric name, NOT comparable to the TPU number —
+  bench.py docstring), yet both records land in the same `runs.jsonl`
+  and `key_metrics` folds both onto `examples_per_sec`. Diffing across
+  that boundary must shout, not silently flag a bogus regression.
+  """
+  warnings = []
+  for field in ("platform", "kind", "device_kind"):
+    va, vb = a.get(field), b.get(field)
+    if va and vb and va != vb:
+      warnings.append(f"{field} differs: {va} vs {vb}")
+  metric_a = (a.get("bench") or {}).get("metric")
+  metric_b = (b.get("bench") or {}).get("metric")
+  if metric_a and metric_b and metric_a != metric_b:
+    warnings.append(f"bench metric differs: {metric_a} vs {metric_b}")
+  return warnings
+
+
+def format_diff(a: Dict[str, Any], b: Dict[str, Any],
+                deltas: Sequence[Dict[str, Any]]) -> str:
+  lines = ["graftscope diff",
+           f"  A: {_describe(a)}",
+           f"  B: {_describe(b)}"]
+  for warning in comparability_warnings(a, b):
+    lines.append(f"  WARNING: {warning} — deltas may not be comparable")
+  lines.append(f"  {'metric':<22}{'A':>16}{'B':>16}{'Δ%':>9}  verdict")
+  regressions = 0
+  for d in deltas:
+    fmt = lambda v: f"{v:>16.6g}" if v is not None else f"{'—':>16}"
+    if d["rel"] is None:
+      verdict = "(only one run)"
+      rel = f"{'—':>9}"
+    else:
+      rel = f"{100.0 * d['rel']:>+8.1f}%"
+      if d["regressed"]:
+        regressions += 1
+        verdict = f"REGRESSED (>{100.0 * d['threshold']:.0f}%)"
+      else:
+        verdict = "ok"
+    lines.append(f"  {d['metric']:<22}{fmt(d['a'])}{fmt(d['b'])}"
+                 f"{rel}  {verdict}")
+  lines.append(f"  {regressions} regression(s) beyond threshold"
+               if regressions else "  no regressions beyond thresholds")
+  return "\n".join(lines) + "\n"
+
+
+def resolve_run(ref: str) -> Tuple[Dict[str, Any], str]:
+  """Resolves a run reference to (record, description).
+
+  A reference is a model_dir (its `runs.jsonl`), a `runs.jsonl` path,
+  or either with a `#selector` suffix — a run_id, or an integer index
+  into the file (negative from the end). Without a selector the LATEST
+  record wins.
+  """
+  path, selector = ref, None
+  if not os.path.exists(path) and "#" in path:
+    path, selector = path.rsplit("#", 1)
+  if os.path.isdir(path):
+    path = os.path.join(path, RUNS_FILENAME)
+  if not os.path.isfile(path):
+    raise RunResolveError(
+        f"no run history at {ref!r} (no such file: {path})")
+  records = load_records(path)
+  if not records:
+    raise RunResolveError(f"no parseable run records in {path}")
+  if selector is None:
+    return records[-1], f"{path} (latest of {len(records)})"
+  try:
+    index = int(selector)
+  except ValueError:
+    for record in reversed(records):
+      if record.get("run_id") == selector:
+        return record, f"{path}#{selector}"
+    raise RunResolveError(f"run_id {selector!r} not found in {path}")
+  try:
+    return records[index], f"{path}#{index}"
+  except IndexError:
+    raise RunResolveError(
+        f"index {index} out of range ({len(records)} record(s) in {path})")
+
+
+def history_lines(records: Sequence[Dict[str, Any]], source: str
+                  ) -> List[str]:
+  """One line per record for `graftscope history`."""
+  lines = [f"run history: {source} ({len(records)} record(s))"]
+  for i, record in enumerate(records):
+    metrics = key_metrics(record)
+    parts = []
+    for name in ("examples_per_sec", "step_ms", "compile_time_s",
+                 "hbm_watermark_bytes"):
+      if name in metrics:
+        parts.append(f"{name}={metrics[name]:.6g}")
+    lines.append(f"  [{i}] {_describe(record)} " + " ".join(parts))
+  return lines

@@ -205,6 +205,8 @@ class StepStatsRecorder:
     reg.histogram("stepstats/step_ms").record(step_ms)
     reg.histogram("stepstats/device_ms").record(device_ms)
     reg.histogram("stepstats/data_wait_ms").record(data_wait_ms)
+    reg.histogram("stepstats/examples_per_sec").record(
+        record["examples_per_sec"])
     reg.gauge("stepstats/examples_per_sec").set(record["examples_per_sec"])
     first_step = int(step) - n + 1
     self._tracer.add_complete(
@@ -224,19 +226,11 @@ class StepStatsRecorder:
     if not self._device_gauges:
       return {}
     try:
-      import jax
+      from tensor2robot_tpu.utils import backend
 
-      arrays = [a for a in jax.live_arrays() if not a.is_deleted()]
-      live_bytes = float(sum(getattr(a, "nbytes", 0) for a in arrays))
-      out = {"live_arrays": float(len(arrays)), "live_bytes": live_bytes}
-      try:
-        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
-        if stats and "bytes_in_use" in stats:
-          out["device_bytes_in_use"] = float(stats["bytes_in_use"])
-      except Exception:  # noqa: BLE001 - allocator stats are optional
-        pass
+      out = backend.device_memory_stats()
       self._registry.gauge("device/live_arrays").set(out["live_arrays"])
-      self._registry.gauge("device/live_bytes").set(live_bytes)
+      self._registry.gauge("device/live_bytes").set(out["live_bytes"])
       return out
     except Exception:  # noqa: BLE001 - gauges are best-effort
       self._device_gauges = False

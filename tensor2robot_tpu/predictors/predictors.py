@@ -38,6 +38,7 @@ from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.export import export_generator as export_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
+from tensor2robot_tpu.obs import xray as obs_xray
 from tensor2robot_tpu.parallel import train_step as ts
 from tensor2robot_tpu.utils import config
 
@@ -92,7 +93,14 @@ class _JaxPredictorBase(AbstractPredictor):
 
   def _build_predict(self) -> None:
     model = self._model
-    predict = ts.make_predict_fn(model)
+    # graftscope-xray compile telemetry: the first predict AOT-compiles
+    # through analyze_jit (compile time / jaxpr size / cost analysis
+    # into the `serve/predict` record) and later calls reuse that
+    # executable; a batch-size change or an analysis failure silently
+    # degrades to the plain jitted fn (serving must never break on
+    # telemetry).
+    predict = obs_xray.XrayedFunction("serve/predict",
+                                      ts.make_predict_fn(model))
     preprocessor = model.preprocessor
 
     def fn(features):

@@ -35,8 +35,10 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.hooks import core as hooks_lib
 from tensor2robot_tpu.obs import metrics as metrics_registry_lib
+from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import stepstats as stepstats_lib
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.obs import xray as xray_lib
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 from tensor2robot_tpu.parallel import train_step as ts
 from tensor2robot_tpu.utils import config
@@ -213,7 +215,12 @@ def train_eval_model(
   overlap between barriers is preserved); 0 disables. The process-
   global trace buffer AND metrics registry are reset at run start so
   the saved trace and the final registry snapshot cover exactly this
-  run."""
+  run. With telemetry on, the train step is additionally X-rayed
+  (`obs.xray`: compile time, jaxpr size, cost/memory analysis on first
+  dispatch) and the run appends a schema-versioned record — step-stat
+  summary, compile telemetry, HBM-watermark estimate — to
+  `<model_dir>/runs.jsonl` (`obs.runlog`; compare runs with
+  `python -m tensor2robot_tpu.bin.graftscope diff`)."""
   if mode not in ("train", "evaluate", "train_and_evaluate",
                   "continuous_eval"):
     raise ValueError(f"Unknown train_eval mode {mode!r}")
@@ -302,14 +309,23 @@ def train_eval_model(
   step_stats = stepstats_lib.StepStatsRecorder(
       batch_size=(input_generator_train.batch_size if needs_train else 0),
       every_n_steps=step_stats_every_n_steps if needs_train else 0)
+  run_memory: dict = {}
   if step_stats.enabled:
     hooks.append(hooks_lib.StepStatsHook())
-    # Per-run telemetry: clear the process-global trace buffer and
-    # metrics registry so the saved trace / final snapshot cover
-    # exactly this run (the tracer itself is enabled inside the train
-    # loop's try so any exit path disables it again).
+    # Per-run telemetry: clear the process-global trace buffer, metrics
+    # registry and xray compile-record collector so the saved trace,
+    # final snapshot and run record cover exactly this run (the tracer
+    # itself is enabled inside the train loop's try so any exit path
+    # disables it again).
     trace_lib.clear()
     metrics_registry_lib.reset()
+    xray_lib.clear_records()
+    try:
+      run_memory = xray_lib.memory_accounting(
+          state, batch=first_batch,
+          num_data_shards=int(mesh.shape.get("data", mesh.devices.size)))
+    except Exception:  # noqa: BLE001 - telemetry never kills a run
+      logging.exception("graftscope-xray: memory accounting failed")
 
   ctx = hooks_lib.TrainContext(model, model_dir,
                                get_state=lambda: state,
@@ -419,6 +435,16 @@ def train_eval_model(
                                     shardings=shardings,
                                     batch_spec=batch_spec)
     loop_spec = ts.loop_batch_spec(batch_spec)
+  if step_stats.enabled:
+    # Compile telemetry (obs.xray): the first dispatch AOT-compiles
+    # through analyze_jit — per-executable compile time, jaxpr size,
+    # donation bytes, XLA cost/memory analysis into the run record —
+    # and every later call runs the SAME executable (no double compile;
+    # any failure degrades to the plain jitted fn).
+    train_step = xray_lib.XrayedFunction("train_step", train_step)
+    if train_loop is not None:
+      train_loop = xray_lib.XrayedFunction(f"train_loop_k{loop_k}",
+                                           train_loop)
   eval_step = None
   if mode == "train_and_evaluate":
     eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
@@ -583,10 +609,57 @@ def train_eval_model(
   _checkpoint(step, force=True)
   for hook in hooks:
     hook.end(ctx)
+  if step_stats.enabled:
+    _append_run_record(model_dir, run_memory, final_metrics, step)
   manager.wait_until_finished()
   manager.close()
   writer.close()
   return final_metrics
+
+
+def _append_run_record(model_dir: str, run_memory: dict,
+                       final_metrics: dict, final_step: int) -> None:
+  """Appends this run's schema-versioned record to model_dir/runs.jsonl
+  (`obs.runlog`): step-stat summary from the registry, xray compile
+  records, memory accounting + HBM watermark estimate, final metrics.
+  Best-effort — the run's result never depends on its telemetry."""
+  try:
+    from tensor2robot_tpu.utils import backend
+
+    compile_records = xray_lib.records()
+    memory = dict(run_memory)
+    try:
+      memory.update(backend.device_memory_stats())
+    except Exception:  # noqa: BLE001 - allocator stats are optional
+      pass
+    memory["hbm_watermark_bytes"] = xray_lib.hbm_watermark_estimate(
+        memory, compile_records)
+    summary = runlog_lib.step_stats_summary(metrics_registry_lib.snapshot())
+    # runs.jsonl is strict JSON (allow_nan=False): a NaN loss must cost
+    # that one scalar, not the whole record.
+    finite_metrics = {}
+    for key, value in final_metrics.items():
+      try:
+        value = float(value)
+      except (TypeError, ValueError):
+        continue
+      if np.isfinite(value):
+        finite_metrics[key] = value
+    device = jax.devices()[0]
+    record = runlog_lib.make_record(
+        "train",
+        platform=device.platform,
+        device_kind=getattr(device, "device_kind", None),
+        num_devices=len(jax.devices()),
+        step_stats=summary,
+        compile_records=compile_records,
+        memory=memory,
+        extra={"model_dir": model_dir, "final_step": int(final_step),
+               "final_metrics": finite_metrics})
+    runlog_lib.append_record(
+        os.path.join(model_dir, runlog_lib.RUNS_FILENAME), record)
+  except Exception:  # noqa: BLE001 - telemetry never kills a run
+    logging.exception("graftscope: run-record append failed")
 
 
 @config.configurable

@@ -99,6 +99,37 @@ def state_barrier(state):
                   key=lambda a: a.size))
 
 
+def device_memory_stats() -> dict:
+  """Client-side live-buffer and allocator accounting; tunnel-safe.
+
+  Reads ONLY client-held metadata: ``jax.live_arrays`` handles and the
+  device's allocator counters (``memory_stats``) — no device
+  computation is dispatched and nothing is fetched, so this never
+  blocks on (or occupies) a busy/wedged tunnel the way an eager op
+  would (~1.5 s per dispatch, see ``sync``). Keys: ``live_arrays`` /
+  ``live_bytes`` always; ``device_bytes_in_use`` /
+  ``device_peak_bytes_in_use`` / ``device_bytes_limit`` when the
+  backend's allocator reports them (the CPU backend reports none).
+  The ONE shared implementation behind ``obs.stepstats``'s per-window
+  gauges and ``obs.xray``'s run-record memory block.
+  """
+  import jax
+
+  arrays = [a for a in jax.live_arrays() if not a.is_deleted()]
+  out = {
+      "live_arrays": float(len(arrays)),
+      "live_bytes": float(sum(getattr(a, "nbytes", 0) for a in arrays)),
+  }
+  try:
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+      if stats and key in stats:
+        out[f"device_{key}"] = float(stats[key])
+  except Exception:  # noqa: BLE001 - allocator stats are optional
+    pass
+  return out
+
+
 def time_op(fn, *args, iters: int = 30):
   """Per-iter wall time of a (jitted) op with the host-fetch barrier
   cost cancelled — the ONE shared micro-op timer for the tunnel scripts

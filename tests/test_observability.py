@@ -34,8 +34,10 @@ from tensor2robot_tpu.analysis import tracer_check
 from tensor2robot_tpu.bin import graftscope
 from tensor2robot_tpu.hooks import profiler as profiler_lib
 from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import stepstats as stepstats_lib
 from tensor2robot_tpu.obs import trace as trace_lib
+from tensor2robot_tpu.obs import xray as xray_lib
 from tensor2robot_tpu.utils import config, mocks
 from tensor2robot_tpu.utils import summaries as summaries_lib
 
@@ -44,15 +46,19 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(autouse=True)
 def _fresh_global_obs_state():
-  """Each test sees an empty global registry/tracer (process-wide
-  singletons; other suites' recordings must not leak into assertions)."""
-  metrics_lib.reset()
+  """Hermetic graftscope state per test: the process-wide metrics
+  registry is snapshot/SWAPPED for a fresh one (`metrics.isolated` —
+  unlike reset(), other suites' counters in the shared singleton
+  survive untouched and nothing this test records can leak out), and
+  the global tracer + xray compile collector are cleared both ways."""
+  with metrics_lib.isolated():
+    trace_lib.clear()
+    trace_lib.disable()
+    xray_lib.clear_records()
+    yield
   trace_lib.clear()
   trace_lib.disable()
-  yield
-  metrics_lib.reset()
-  trace_lib.clear()
-  trace_lib.disable()
+  xray_lib.clear_records()
 
 
 # ---------------------------------------------------------------------------
@@ -535,6 +541,16 @@ class TestTrainLoopStepStats:
              if e.get("ph") == "X"]
     assert names.count("train/step_window") == 6
     assert "train/data_wait" in names and "train/barrier" in names
+    # graftscope-xray: the run appended a schema-versioned record with
+    # compile telemetry and a memory watermark to runs.jsonl.
+    (run_record,) = runlog_lib.load_records(
+        os.path.join(model_dir, runlog_lib.RUNS_FILENAME))
+    assert run_record["schema"] == runlog_lib.SCHEMA
+    assert run_record["schema_version"] == runlog_lib.SCHEMA_VERSION
+    names = [r["name"] for r in run_record["compile"]]
+    assert "train_step" in names
+    assert run_record["memory"]["hbm_watermark_bytes"] > 0
+    assert run_record["step_stats"]["examples_per_sec_mean"] > 0
     # Reader CLI renders a non-empty report from exactly these files.
     assert graftscope.main([model_dir]) == 0
     out = capsys.readouterr().out
@@ -542,6 +558,7 @@ class TestTrainLoopStepStats:
     assert "data_wait_ms" in out and "device_ms" in out
     assert "train/step_window" in out  # slowest-spans table
     assert "compile events: " in out
+    assert "run history" in out and "xray compile telemetry" in out
 
   def test_step_stats_disabled_leaves_stream_clean(self, tmp_path):
     model_dir = str(tmp_path / "off")
@@ -550,6 +567,10 @@ class TestTrainLoopStepStats:
     assert step_records == []
     assert not os.path.isfile(
         os.path.join(model_dir, "train", "trace.graftscope.json"))
+    # Telemetry off means no run record and no xray wrap either.
+    assert not os.path.isfile(
+        os.path.join(model_dir, runlog_lib.RUNS_FILENAME))
+    assert xray_lib.records() == []
 
   def test_windowed_cadence_with_iterations_per_loop(self, tmp_path):
     """K-step loop dispatch + cadence 3: windows close on loop
@@ -565,10 +586,38 @@ class TestTrainLoopStepStats:
 
   def test_graftscope_cli_exit_codes(self, tmp_path, capsys):
     assert graftscope.main([str(tmp_path / "missing")]) == 2
+    err = capsys.readouterr().err
+    assert "no such directory" in err and "missing" in err
     empty = tmp_path / "empty"
     empty.mkdir()
     assert graftscope.main([str(empty)]) == 1
+    assert graftscope.main(["history", str(empty)]) == 2
     capsys.readouterr()
+
+  def test_graftscope_tolerates_corrupt_telemetry(self, tmp_path, capsys):
+    """ISSUE 3 satellite: truncated/corrupt metrics.jsonl and
+    trace.json content is skipped with a warning counter — the reader
+    must still render a report from the surviving records."""
+    log_dir = tmp_path / "run" / "train"
+    log_dir.mkdir(parents=True)
+    good = {"step": 1, "data_wait_ms": 1.0, "device_ms": 2.0,
+            "examples_per_sec": 3.0, "step_ms": 4.0}
+    (log_dir / "metrics.jsonl").write_text(
+        json.dumps(good) + "\n"
+        + '{"torn": \n'          # torn tail line of a live run
+        + "\x00\xff garbage\n"   # binary garbage
+        + json.dumps(dict(good, step=2)) + "\n")
+    (log_dir / "trace.graftscope.json").write_text('{"traceEvents": [')
+    rc = graftscope.main([str(tmp_path / "run")])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "step-time breakdown (2 records" in captured.out
+    assert "corrupt/truncated line(s) skipped" in captured.out
+    assert "skipped 2 corrupt line(s)" in captured.err
+    assert "skipping corrupt trace" in captured.err
+    snap = metrics_lib.snapshot()
+    assert snap["counter/graftscope/corrupt_lines"] == 2.0
+    assert snap["counter/graftscope/corrupt_trace_files"] == 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -577,13 +626,14 @@ class TestTrainLoopStepStats:
 
 
 def test_obs_imports_and_cli_run_backend_free(tmp_path):
-  """`tensor2robot_tpu.obs` must import — and trace/metrics/CLI must
-  RUN — without initializing any JAX backend (same two-layer proof as
-  the analysis suite: poisoned JAX_PLATFORMS + empty backend cache)."""
+  """`tensor2robot_tpu.obs` (xray/runlog included) must import — and
+  trace/metrics/runlog/CLI (report AND diff/history) must RUN — without
+  initializing any JAX backend (same two-layer proof as the analysis
+  suite: poisoned JAX_PLATFORMS + empty backend cache)."""
   code = """
 import json, sys
 from tensor2robot_tpu import obs
-from tensor2robot_tpu.obs import metrics, trace
+from tensor2robot_tpu.obs import metrics, runlog, trace, xray
 trace.enable()
 with trace.span("smoke"):
     metrics.counter("smoke/count").inc()
@@ -595,9 +645,18 @@ w.write_scalars(1, dict(metrics.snapshot(),
                         data_wait_ms=1.0, device_ms=2.0,
                         examples_per_sec=3.0))
 w.close()
+runs = sys.argv[1] + "/runs.jsonl"
+runlog.append_record(runs, runlog.make_record(
+    "train", step_stats={"examples_per_sec_mean": 100.0}))
+runlog.append_record(runs, runlog.make_record(
+    "train", step_stats={"examples_per_sec_mean": 50.0}))
 from tensor2robot_tpu.bin import graftscope
 rc = graftscope.main([sys.argv[1]])
 assert rc == 0, rc
+rc = graftscope.main(["history", sys.argv[1]])
+assert rc == 0, rc
+rc = graftscope.main(["diff", runs + "#0", runs + "#1"])
+assert rc == 3, rc  # the 50% throughput drop must flag, backend-free
 from jax._src import xla_bridge
 live = getattr(xla_bridge, "_backends", None)
 assert not live, f"jax backends were initialized: {sorted(live)}"
