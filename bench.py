@@ -36,7 +36,12 @@ reads as vs_baseline >= 4.
 
 CPU fallback (wedged/absent TPU tunnel): the small-CNN smoke config with
 its own metric name and the round-1 recorded anchor — not comparable to
-the TPU number, only to itself across rounds.
+the TPU number, only to itself across rounds. Both headline modes embed
+a `tunnel_health` block (`utils.backend.HeartbeatMonitor`: every health
+probe and bench probe child stamps healthy/degraded/dead with a
+timestamped transition timeline), so a fallback record carries the
+CAUSE and TIME of the tunnel turning — the round-5 gap where
+BENCH_r05.json silently switched metric names at the 14:10 UTC death.
 """
 
 from __future__ import annotations
@@ -187,11 +192,12 @@ def probe_main(cfg: dict) -> dict:
            else max(4, measure_steps // loop_steps))
   runs = []
   for _ in range(cfg.get("reruns", 1)):
+    run_flags: dict = {}
     h1, h2, state = backend_lib.time_train_steps_halves(
         step, state, features, labels, iters=iters,
-        warmup=WARMUP_STEPS)
-    runs.append((h2, h1))
-  sec, first_half = sorted(runs)[len(runs) // 2]
+        warmup=WARMUP_STEPS, out_flags=run_flags)
+    runs.append((h2, h1, bool(run_flags.get("barrier_dominated"))))
+  sec, first_half, barrier_dominated = sorted(runs)[len(runs) // 2]
   sec /= loop_steps
   first_half /= loop_steps
   print(f"bench: probe batch={batch_size} remat={remat} s2d={s2d} "
@@ -204,6 +210,13 @@ def probe_main(cfg: dict) -> dict:
       "examples_per_sec": batch_size / sec,
       "step_sec": sec,
       "first_half_sec": first_half,
+      # The kept (median) run's timing was barrier-dominated: step_sec
+      # is a CLAMPED estimate (backend.time_train_steps_halves) that
+      # can sit on either side of the truth — in particular
+      # examples_per_sec may be inflated — so autotune's ranking never
+      # lets a flagged record outrank a clean one, and the sentinel
+      # spike detector skips equivalently-flagged stepstats records.
+      "barrier_dominated": barrier_dominated,
       # XLA cost analysis prices a lax.scan BODY once (trip count is not
       # multiplied in) — measured: the K=8 loop executable reports the
       # same flops as the single-step one — so loop-mode cost fields are
@@ -277,7 +290,12 @@ def _subprocess_probe(batch_size: int, remat: bool = False,
             "and skipping remaining probes", file=sys.stderr)
       return {"timeout": True}
     with open(out_path) as f:
-      return json.load(f)
+      rec = json.load(f)
+    if isinstance(rec, dict):
+      # Child wall clock for the heartbeat monitor: a probe that took
+      # most of its deadline is a degraded tunnel even when it succeeds.
+      rec.setdefault("probe_wall_sec", time.monotonic() - start)
+    return rec
   except OSError:
     return {"ok": False,
             "error": f"probe child exited rc={proc.returncode} "
@@ -318,10 +336,29 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
     - remat, then space-to-depth, probed at the winning batch;
     - ANY timeout abandons all remaining probes (the tunnel is suspect
       and each further probe would hang the full deadline) but keeps
-      the best already-measured number.
+      the best already-measured number;
+    - a `barrier_dominated` record (clamped timing — an inflated
+      examples/sec is possible) never outranks a clean measurement.
   """
   best = None
   last_error = None
+
+  def wins(challenger, incumbent):
+    """True when `challenger` should replace `incumbent` as best.
+
+    A `barrier_dominated` record's step time is a CLAMPED value
+    (backend.time_train_steps_halves: a noisy-high barrier estimate can
+    understate the true step time, inflating examples/sec by up to the
+    clamp factor), so a clean measurement ALWAYS outranks a flagged
+    one regardless of magnitude; equal trust compares throughput.
+    """
+    if incumbent is None:
+      return True
+    c_flag = bool(challenger.get("barrier_dominated"))
+    i_flag = bool(incumbent.get("barrier_dominated"))
+    if c_flag != i_flag:
+      return i_flag
+    return challenger["examples_per_sec"] > incumbent["examples_per_sec"]
 
   def try_probe(b, remat, s2d, what):
     nonlocal best, last_error
@@ -350,11 +387,19 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
     b *= 2
   ladder = list(dict.fromkeys(b for b in ladder if 0 < b <= batch_cap))
   oom_floor = None
+  max_ok_batch = None
   value_batch64 = None
   for b in ladder:
     if best is not None and best["aborted"]:
       break
-    if oom_floor is not None and b >= oom_floor:
+    # Skip rungs at/above an OOMed batch ONLY while no LARGER rung has
+    # already succeeded: the ladder runs priority-first (256 before 64),
+    # so a transient OOM at b64 after a successful b256 says nothing
+    # about b128/b512 — before this guard it silently masked them
+    # (ADVICE.md round 5). A genuine capacity ceiling still short-
+    # circuits: nothing above it has ever fit.
+    if (oom_floor is not None and b >= oom_floor
+        and (max_ok_batch is None or max_ok_batch < oom_floor)):
       continue
     r = try_probe(b, False, False, f"batch-{b}")
     if r is None:
@@ -363,9 +408,10 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
       if "RESOURCE_EXHAUSTED" in (last_error or ""):
         oom_floor = b if oom_floor is None else min(oom_floor, b)
       continue
+    max_ok_batch = b if max_ok_batch is None else max(max_ok_batch, b)
     if b == BATCH_SIZE:
       value_batch64 = r["examples_per_sec"]
-    if best is None or r["examples_per_sec"] > best["examples_per_sec"]:
+    if wins(r, best):
       # aborted cannot be True here: a timeout returns None from
       # try_probe and breaks the ladder before another update.
       best = dict(r, batch_size=b, remat=False, s2d=False,
@@ -396,7 +442,7 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
   # (more bytes AND more flops; the step is not activation-bound) —
   # the probe stays as the on-chip check. Keep whichever wins.
   r = try_probe(best["batch_size"], True, False, "remat")
-  if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
+  if r is not None and wins(r, best):
     best.update(r, remat=True)
   # Space-to-depth stem probe (exact math, tests pin equivalence):
   # the 3-channel stem conv drives 3/128 MXU lanes; folding 2x2
@@ -404,7 +450,7 @@ def autotune(probe, initial_batch: int = BATCH_SIZE,
   # cost model prices at 3% of flops but that can take a far larger
   # wall-clock share at 2% MXU efficiency. Only the chip can price it.
   r = try_probe(best["batch_size"], best["remat"], True, "space-to-depth")
-  if r is not None and r["examples_per_sec"] > best["examples_per_sec"]:
+  if r is not None and wins(r, best):
     best.update(r, s2d=True)
   return best
 
@@ -441,22 +487,56 @@ def _ab_local_compile(batch_size: int) -> None:
 
 
 def _record_probe(rec: dict) -> dict:
-  """Feeds one probe outcome through the graftscope metrics registry.
+  """Feeds one probe outcome through the graftscope metrics registry
+  AND the tunnel heartbeat monitor (`backend.tunnel_health()`).
 
   Every BENCH_*.json record since this landed carries the same
   `graftscope` block (see `_graftscope_block`), so driver-side tooling
-  can consume probe accounting without parsing stderr.
+  can consume probe accounting without parsing stderr; the heartbeat
+  stamps are what let a later CPU fallback report the cause and TIME
+  of the tunnel turning (the round-5 gap: BENCH_r05.json silently
+  switched metric names at the 14:10 UTC tunnel death).
   """
+  wall = float(rec.get("probe_wall_sec") or 0.0)
   if rec.get("timeout"):
     obs_metrics.counter("bench/probes_timeout").inc()
+    backend_lib.record_heartbeat(False, elapsed_s=PROBE_DEADLINE_SEC,
+                                 source="bench_probe",
+                                 cause="probe_timeout")
   elif rec.get("ok"):
     obs_metrics.counter("bench/probes_ok").inc()
     obs_metrics.histogram("bench/probe_examples_per_sec").record(
         rec["examples_per_sec"])
     obs_metrics.histogram("bench/probe_step_ms").record(
         rec["step_sec"] * 1e3)
+    if rec.get("platform") != "cpu":
+      # Slow threshold scaled to the probe deadline, not the monitor's
+      # 60 s default: a healthy child pays fresh jax init + a first
+      # compile (minutes over the tunnel) — only a child burning most
+      # of its deadline is degradation evidence.
+      backend_lib.record_heartbeat(True, elapsed_s=wall,
+                                   source="bench_probe",
+                                   degraded_after_s=0.5
+                                   * PROBE_DEADLINE_SEC)
   else:
     obs_metrics.counter("bench/probes_failed").inc()
+    if rec.get("platform") != "cpu":
+      error = str(rec.get("error", ""))[:120]
+      if "RESOURCE_EXHAUSTED" in error:
+        # An OOM is the batch ladder working as designed: the tunnel
+        # ran the workload and answered — a HEALTHY probe outcome, not
+        # degradation (the oom_floor policy handles the batch side).
+        backend_lib.record_heartbeat(True, elapsed_s=wall,
+                                     source="bench_probe",
+                                     degraded_after_s=0.5
+                                     * PROBE_DEADLINE_SEC)
+      else:
+        # Any other child failure is inconclusive: the tunnel answered
+        # SOMETHING (not dead), but e.g. a libtpu mismatch or transport
+        # error is not a clean bill of health either.
+        backend_lib.record_heartbeat(None, elapsed_s=wall,
+                                     source="bench_probe",
+                                     cause=f"probe_error:{error}")
   return rec
 
 
@@ -562,10 +642,15 @@ def main() -> None:
         "bytes_per_step": best.get("bytes_accessed"),
         "device_kind": best.get("device_kind"),
         "probes_aborted": best["aborted"],
+        "barrier_dominated": bool(best.get("barrier_dominated", False)),
         # Below-dispatch introspection for the winning probe (obs.xray):
         # compile economics + the per-chip HBM watermark estimate that
         # rounds 2-5 OOMed without.
         "xray": _xray_headline_block(best),
+        # Tunnel heartbeat timeline (same shape as the CPU-fallback
+        # path, so the two bench modes cannot drift): every probe
+        # outcome stamped with state transitions + causes.
+        "tunnel_health": backend_lib.tunnel_health(),
         "graftscope": _graftscope_block(),
     }
     print(json.dumps(headline))
@@ -581,6 +666,7 @@ def main() -> None:
   rec = _record_probe(
       probe_main({"platform": "cpu", "batch_size": 16, "reruns": 3}))
   cpu_anchor = 3643.0  # recorded for this exact config at batch 16
+  tunnel_health = backend_lib.tunnel_health()
   headline = {
       "metric": "qtopt_grasps_per_sec_cpu_smoke",
       "value": round(rec["examples_per_sec"], 2),
@@ -588,6 +674,13 @@ def main() -> None:
       "vs_baseline": round(rec["examples_per_sec"] / cpu_anchor, 3),
       "batch_size": rec["batch_size"],
       "xray": _xray_headline_block(rec),
+      # THE round-5 gap, closed: the fallback record now carries the
+      # cause and time of the tunnel turning (heartbeat transitions
+      # from the health probe + every TPU probe attempted this run)
+      # instead of only a silently different metric name.
+      "tunnel_health": tunnel_health,
+      "fallback": {"from": "tpu", "unix_time": time.time(),
+                   "cause": tunnel_health.get("cause")},
       "graftscope": _graftscope_block(),
   }
   print(json.dumps(headline))

@@ -12,12 +12,16 @@
 # Usage: scripts/obs_report.sh <model_dir> [--top N]
 #        scripts/obs_report.sh --history <model_dir|runs.jsonl>
 #        scripts/obs_report.sh --diff <runA> <runB> [--threshold m=rel]
-#   (run references: model_dir / runs.jsonl, optional #run_id or #index)
+#        scripts/obs_report.sh --postmortem <dir> [--index I] [--list]
+#   (run references: model_dir / runs.jsonl, optional #run_id or #index;
+#    --postmortem renders the latest flight-recorder bundle: last steps,
+#    incident timeline, tunnel-heartbeat transitions)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 case "${1:-}" in
   --diff) shift; set -- diff "$@" ;;
   --history) shift; set -- history "$@" ;;
+  --postmortem) shift; set -- postmortem "$@" ;;
 esac
 exec python -c '
 import sys

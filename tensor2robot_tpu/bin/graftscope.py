@@ -17,7 +17,15 @@ docs/ARCHITECTURE.md "Observability"); this is the read side:
       --threshold name=rel). A run reference is a model_dir, a
       runs.jsonl path, or either with `#run_id` / `#index` (negative
       from the end); bare paths mean the LATEST record. Exit 3 = a
-      delta crossed its regression threshold (0 ok, 2 bad reference).
+      delta crossed its regression threshold (0 ok, 2 bad reference);
+  python -m tensor2robot_tpu.bin.graftscope postmortem <dir>
+      render a flight-recorder bundle (`obs.flightrec`, written on
+      crash/SIGTERM/hang/fatal incident): the last N recorded steps,
+      the incident timeline (bundle + the model_dir's incidents.jsonl),
+      the tunnel-heartbeat transitions, and the crash traceback.
+      <dir> is a bundle dir, a flightrec/ dir, a model_dir (searched
+      recursively; latest bundle by default, select with --index), or
+      a postmortem.json path; --list enumerates bundles.
 
 Robustness contract: a torn tail line of a live run, a truncated trace
 JSON, or binary garbage in any telemetry file is skipped with a warning
@@ -37,12 +45,14 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, List, Optional, Tuple
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
+from tensor2robot_tpu.obs import flightrec as flightrec_lib
 from tensor2robot_tpu.obs import metrics as metrics_lib
 from tensor2robot_tpu.obs import runlog as runlog_lib
 
-__all__ = ["build_report", "main"]
+__all__ = ["build_report", "render_postmortem", "main"]
 
 _SKIP_DIRS = {"checkpoints", "__pycache__", ".git"}
 # Per-step record signature written by obs.stepstats via StepStatsHook.
@@ -388,8 +398,231 @@ def _main_diff(argv: List[str]) -> int:
   return 3 if any(d["regressed"] for d in deltas) else 0
 
 
+def _stamp(unix_time) -> str:
+  try:
+    return time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(float(unix_time)))
+  except (TypeError, ValueError):
+    return "?"
+
+
+def _fmt_cell(value, width: int = 12) -> str:
+  """Step-record cell: bundle values are floats OR repr strings for
+  non-finites ('nan' is exactly the datum a postmortem is for)."""
+  if isinstance(value, (int, float)):
+    return f"{value:>{width}.2f}"
+  return f"{str(value):>{width}}"
+
+
+_STEP_COLUMNS = ("step_ms", "data_wait_ms", "device_ms",
+                 "examples_per_sec", "nonfinite_params")
+
+
+def _postmortem_steps_lines(steps: List[dict], last_n: int) -> List[str]:
+  if not steps:
+    return ["recorded steps: none (did the run crash before the first "
+            "stepstats window?)"]
+  shown = steps[-last_n:]
+  lines = [f"last {len(shown)} recorded step window(s) "
+           f"(of {len(steps)} in the ring buffer)"]
+  columns = [c for c in _STEP_COLUMNS
+             if any(c in record for record in shown)]
+  lines.append("  " + f"{'step':>8}"
+               + "".join(f"{c:>18}" for c in columns))
+  for record in shown:
+    lines.append("  " + f"{str(record.get('step', '?')):>8}"
+                 + "".join(_fmt_cell(record.get(c, "—"), 18)
+                           for c in columns))
+  return lines
+
+
+def _fmt_num(value) -> str:
+  """Tolerant numeric format: a wrong-typed field in an otherwise
+  parseable incident renders verbatim instead of raising (the CLI's
+  never-raise contract covers wrong TYPES, not just invalid JSON)."""
+  try:
+    return f"{float(value):.6g}"
+  except (TypeError, ValueError):
+    return str(value)
+
+
+def _postmortem_incident_lines(incidents: List[dict]) -> List[str]:
+  if not incidents:
+    return ["incident timeline: no incidents recorded"]
+  lines = [f"incident timeline ({len(incidents)} record(s))",
+           f"  {'time':<20}{'step':>8}  {'severity':<7} kind"]
+  for record in incidents:
+    detail = record.get("detail") if isinstance(record.get("detail"),
+                                                dict) else {}
+    extras = []
+    if record.get("value") is not None:
+      extras.append(f"value={_fmt_num(record['value'])}")
+    if detail.get("value_repr"):
+      extras.append(f"value={detail['value_repr']}")
+    if record.get("threshold") is not None:
+      extras.append(f"threshold={_fmt_num(record['threshold'])}")
+    if detail.get("metric"):
+      extras.append(f"metric={detail['metric']}")
+    lines.append(f"  {_stamp(record.get('unix_time')):<20}"
+                 f"{str(record.get('step', '—')):>8}  "
+                 f"{str(record.get('severity', '?')):<7} "
+                 f"{record.get('kind', '?')}"
+                 + ("  (" + ", ".join(extras) + ")" if extras else ""))
+  return lines
+
+
+def _postmortem_heartbeat_lines(heartbeat: Optional[dict]) -> List[str]:
+  if not heartbeat:
+    return ["tunnel heartbeat: no monitor data in this bundle"]
+  lines = [f"tunnel heartbeat: state={heartbeat.get('state', '?')}"
+           + (f" cause={heartbeat['cause']}" if heartbeat.get("cause")
+              else "")
+           + f" ({heartbeat.get('probes', 0)} probe(s))"]
+  for t in heartbeat.get("transitions") or []:
+    lines.append(f"  {_stamp(t.get('unix_time')):<20}-> "
+                 f"{t.get('state', '?'):<9}"
+                 f" source={t.get('source', '?')}"
+                 + (f" cause={t['cause']}" if t.get("cause") else ""))
+  if not (heartbeat.get("transitions") or []):
+    lines.append("  (no transitions recorded)")
+  return lines
+
+
+def render_postmortem(bundle: Dict[str, Any], source: str,
+                      last_n: int = 20,
+                      extra_incidents: Optional[List[dict]] = None) -> str:
+  """Text report for one `graftscope-postmortem-v1` bundle."""
+  head = [f"graftscope postmortem: {source}",
+          f"  reason: {bundle.get('reason', '?')}   "
+          f"at {_stamp(bundle.get('unix_time'))}   "
+          f"pid {bundle.get('pid', '?')}"]
+  watchdog = bundle.get("watchdog") or {}
+  if watchdog.get("hang_timeout_secs"):
+    head.append(f"  watchdog: timeout {watchdog['hang_timeout_secs']:.1f}s,"
+                f" stalled {watchdog.get('stalled_secs', 0.0):.1f}s at dump")
+  exception = bundle.get("exception")
+  if exception:
+    head.append(f"  exception: {exception.get('type', '?')}: "
+                f"{exception.get('message', '')}"[:200])
+  incidents = list(bundle.get("incidents") or [])
+  seen = {(r.get("unix_time"), r.get("kind"), r.get("step"))
+          for r in incidents}
+  for record in extra_incidents or []:
+    key = (record.get("unix_time"), record.get("kind"), record.get("step"))
+    if key not in seen:
+      incidents.append(record)
+      seen.add(key)
+  def _incident_order(record):
+    try:
+      when = float(record.get("unix_time") or 0.0)
+    except (TypeError, ValueError):
+      when = 0.0
+    try:
+      step = int(record.get("step") or 0)
+    except (TypeError, ValueError):
+      step = 0
+    return (when, step)
+
+  incidents.sort(key=_incident_order)
+  sections = [head,
+              _postmortem_steps_lines(list(bundle.get("steps") or []),
+                                      last_n),
+              _postmortem_incident_lines(incidents),
+              _postmortem_heartbeat_lines(bundle.get("heartbeat"))]
+  metrics = bundle.get("metrics") or {}
+  highlights = {k: v for k, v in sorted(metrics.items())
+                if "/sentinel/" in k or "/flightrec/" in k
+                or k.startswith(("counter/sentinel", "counter/flightrec"))}
+  if highlights:
+    sections.append(["sentinel/flightrec counters"]
+                    + [f"  {k:<44}{_fmt_cell(v)}"
+                       for k, v in highlights.items()])
+  if exception and exception.get("traceback"):
+    tail = exception["traceback"].strip().splitlines()[-12:]
+    sections.append(["traceback (tail)"] + [f"  {line}" for line in tail])
+  return "\n\n".join("\n".join(s) for s in sections) + "\n"
+
+
+def _load_bundle(path: str) -> Optional[Dict[str, Any]]:
+  """Tolerant bundle read: a torn/corrupt bundle is a warning + None,
+  never a raise (the writer may have died mid-crash)."""
+  try:
+    with open(path, errors="replace") as f:
+      bundle = json.load(f)
+    if not isinstance(bundle, dict):
+      raise ValueError("bundle is not an object")
+    return bundle
+  except (OSError, ValueError) as e:
+    metrics_lib.counter("graftscope/corrupt_bundles").inc()
+    print(f"graftscope: skipping corrupt bundle {path} "
+          f"({type(e).__name__}: {e})", file=sys.stderr)
+    return None
+
+
+def _main_postmortem(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope postmortem",
+      description="Render a flight-recorder postmortem bundle: last "
+                  "steps, incident timeline, tunnel-heartbeat "
+                  "transitions, crash traceback.")
+  parser.add_argument("source",
+                      help="bundle dir / flightrec dir / model_dir / "
+                           "postmortem.json path")
+  parser.add_argument("--index", type=int, default=-1,
+                      help="bundle to render when several exist "
+                           "(chronological; negative from the end; "
+                           "default: latest)")
+  parser.add_argument("--steps", type=int, default=20,
+                      help="step-window rows to show")
+  parser.add_argument("--list", action="store_true", dest="list_only",
+                      help="list discovered bundles and exit")
+  args = parser.parse_args(argv)
+  if not os.path.exists(args.source):
+    print(f"graftscope: no such path: {args.source}", file=sys.stderr)
+    return 2
+  bundles = flightrec_lib.find_bundles(args.source)
+  # The incident history file complements whatever the bundle rang.
+  incidents_path = (os.path.join(args.source,
+                                 runlog_lib.INCIDENTS_FILENAME)
+                    if os.path.isdir(args.source) else "")
+  extra_incidents, _ = (runlog_lib.read_jsonl(
+      incidents_path, counter_name="graftscope/corrupt_lines")
+      if incidents_path and os.path.isfile(incidents_path) else ([], 0))
+  if args.list_only:
+    if not bundles:
+      print(f"graftscope: no postmortem bundles under {args.source}",
+            file=sys.stderr)
+      return 1
+    for i, path in enumerate(bundles):
+      print(f"[{i}] {os.path.dirname(path)}")
+    return 0
+  if not bundles:
+    if extra_incidents:
+      # No crash bundle, but the run DID log incidents: the timeline is
+      # still the answer to "what went wrong".
+      print(f"graftscope postmortem: {args.source} (no flight-recorder "
+            "bundle; incident history only)\n")
+      print("\n".join(_postmortem_incident_lines(extra_incidents)))
+      return 0
+    print(f"graftscope: no postmortem bundles (or incidents.jsonl) "
+          f"under {args.source}", file=sys.stderr)
+    return 1
+  try:
+    path = bundles[args.index]
+  except IndexError:
+    print(f"graftscope: bundle index {args.index} out of range "
+          f"({len(bundles)} bundle(s))", file=sys.stderr)
+    return 2
+  bundle = _load_bundle(path)
+  if bundle is None:
+    return 2
+  print(render_postmortem(bundle, path, last_n=args.steps,
+                          extra_incidents=extra_incidents), end="")
+  return 0
+
+
 _SUBCOMMANDS = {"report": _main_report, "history": _main_history,
-                "diff": _main_diff}
+                "diff": _main_diff, "postmortem": _main_postmortem}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -30,24 +30,28 @@ from tensor2robot_tpu.utils import config
 __all__ = ["Hook", "HookBuilder", "ConfigSaverHook", "GoldenValuesHook",
            "VariableLoggerHook", "ExportHook", "DefaultHookBuilder",
            "AsyncExportHookBuilder", "BestExportHook", "StepStatsHook",
-           "add_golden_outputs"]
+           "SentinelHook", "add_golden_outputs"]
 
 
 class TrainContext:
   """What hooks see: model, dirs, and accessors into the live loop.
 
-  `step_stats` is the loop's live `obs.stepstats.StepStatsRecorder`
-  (None when step telemetry is disabled)."""
+  `step_stats` is the loop's live `obs.stepstats.StepStatsRecorder`,
+  `sentinel` the run's `obs.sentinel.Sentinel`, `flight_recorder` its
+  `obs.flightrec.FlightRecorder` (each None when disabled)."""
 
   def __init__(self, model, model_dir: str,
                get_state: Callable[[], Any],
-               summary_writer=None, mesh=None, step_stats=None):
+               summary_writer=None, mesh=None, step_stats=None,
+               sentinel=None, flight_recorder=None):
     self.model = model
     self.model_dir = model_dir
     self.get_state = get_state
     self.summary_writer = summary_writer
     self.mesh = mesh
     self.step_stats = step_stats
+    self.sentinel = sentinel
+    self.flight_recorder = flight_recorder
 
 
 class Hook:
@@ -193,6 +197,37 @@ class StepStatsHook(Hook):
     if tracer.events():
       log_dir = os.path.dirname(ctx.summary_writer.path)
       tracer.save(os.path.join(log_dir, self._trace_filename))
+
+
+@config.configurable
+class SentinelHook(Hook):
+  """Feeds per-step HOST-side scalars to the run's `obs.sentinel` and
+  publishes its incident summary at train end.
+
+  Auto-appended by `train_eval_model` beside `StepStatsHook` when step
+  telemetry is on. The after_step filter matters over the axon tunnel:
+  per-step metrics from a single-step dispatch are still LIVE device
+  arrays (the loop only fetches them at the log cadence) and forcing
+  them here would add a ~1.5 s eager fetch per scalar per step
+  (CLAUDE.md); `Sentinel.observe_metrics` therefore inspects only
+  values that already live on the host (numbers/numpy — e.g. the K-step
+  loop path's batched scalar fetch), and the loop additionally feeds
+  the log-cadence scalars once they are fetched anyway."""
+
+  def after_step(self, ctx: TrainContext, step: int, metrics) -> None:
+    if ctx.sentinel is not None:
+      ctx.sentinel.observe_metrics(step, metrics)
+
+  def end(self, ctx: TrainContext) -> None:
+    if ctx.sentinel is None or ctx.summary_writer is None:
+      return
+    summary = ctx.sentinel.summary()
+    if summary["incidents"]:
+      step = int(np.asarray(ctx.get_state().step))
+      ctx.summary_writer.write_scalars(
+          step, {"sentinel/incidents": float(summary["incidents"]),
+                 **{f"sentinel/{kind}": float(count)
+                    for kind, count in summary["by_kind"].items()}})
 
 
 @config.configurable

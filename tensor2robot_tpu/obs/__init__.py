@@ -17,7 +17,16 @@ timing that diagnosed every perf round by hand (PERFORMANCE.md):
   cost/memory analysis, analytic MFU/roofline, and per-shard
   state/batch/HBM-watermark accounting;
 * `runlog`    — schema-versioned append-only run history
-  (`runs.jsonl`) with direction-aware regression diffing.
+  (`runs.jsonl`) with direction-aware regression diffing;
+* `sentinel`  — online anomaly detection over the stepstats stream:
+  EWMA/MAD step-time spikes, data starvation, non-finite divergence
+  (piggybacked on the barrier fetch — zero extra tunnel round trips),
+  HBM-watermark drift; emits `graftscope-incident-v1` records;
+* `flightrec` — crash/hang flight recorder: bounded ring buffers of
+  recent steps/incidents dumped as a `graftscope-postmortem-v1` bundle
+  on unhandled exception, SIGTERM (tunnel-safe: host-side state only),
+  watchdog hang timeout, or a fatal sentinel incident; read back with
+  `graftscope postmortem`.
 
 Backend-free by construction: importing this package (and using trace /
 metrics / runlog) never touches a JAX backend — the same discipline as
@@ -31,6 +40,8 @@ Read telemetry back with `python -m tensor2robot_tpu.bin.graftscope
 `... graftscope diff <runA> <runB>` / `... graftscope history <dir>`.
 """
 
-from tensor2robot_tpu.obs import metrics, runlog, stepstats, trace, xray
+from tensor2robot_tpu.obs import (flightrec, metrics, runlog, sentinel,
+                                  stepstats, trace, xray)
 
-__all__ = ["metrics", "runlog", "stepstats", "trace", "xray"]
+__all__ = ["flightrec", "metrics", "runlog", "sentinel", "stepstats",
+           "trace", "xray"]

@@ -37,11 +37,20 @@ __all__ = ["SCHEMA", "SCHEMA_VERSION", "RUNS_FILENAME", "new_run_id",
            "make_record", "append_record", "read_jsonl", "load_records",
            "step_stats_summary", "key_metrics", "DEFAULT_THRESHOLDS",
            "diff_records", "format_diff", "resolve_run", "history_lines",
-           "RunResolveError"]
+           "RunResolveError", "INCIDENT_SCHEMA", "INCIDENTS_FILENAME",
+           "make_incident"]
 
 SCHEMA = "graftscope-run-v1"
 SCHEMA_VERSION = 1
 RUNS_FILENAME = "runs.jsonl"
+
+# Online-anomaly incident records (`obs.sentinel` is the writer; the
+# flight recorder and `graftscope postmortem` are the readers). One
+# JSON line per incident in `incidents.jsonl`, same tolerant-reader /
+# fsynced-append contract as runs.jsonl.
+INCIDENT_SCHEMA = "graftscope-incident-v1"
+INCIDENT_SCHEMA_VERSION = 1
+INCIDENTS_FILENAME = "incidents.jsonl"
 
 # metric name -> (bad direction, default relative threshold). "up" means
 # an increase beyond the threshold is a regression; "down" a decrease.
@@ -105,6 +114,47 @@ def make_record(kind: str,
     record["bench"] = dict(bench)
   if extra:
     record["extra"] = dict(extra)
+  return record
+
+
+def make_incident(kind: str,
+                  step: Optional[int] = None,
+                  severity: str = "warn",
+                  value: Optional[float] = None,
+                  threshold: Optional[float] = None,
+                  detail: Optional[Dict[str, Any]] = None,
+                  unix_time: Optional[float] = None) -> Dict[str, Any]:
+  """One schema-versioned `graftscope-incident-v1` record (JSON-safe).
+
+  `severity` is `"warn"` (informational anomaly) or `"fatal"` (the run
+  is diverging/dying — the flight recorder dumps a postmortem bundle on
+  these). A non-finite `value` — the whole point of a nonfinite-loss
+  incident — would violate the strict-JSON append contract
+  (allow_nan=False), so it is recorded as `detail["value_repr"]` and
+  the numeric field dropped.
+  """
+  if severity not in ("warn", "fatal"):
+    raise ValueError(f"Unknown incident severity {severity!r}")
+  record: Dict[str, Any] = {
+      "schema": INCIDENT_SCHEMA,
+      "schema_version": INCIDENT_SCHEMA_VERSION,
+      "kind": str(kind),
+      "severity": severity,
+      "unix_time": time.time() if unix_time is None else float(unix_time),
+  }
+  detail = dict(detail or {})
+  if step is not None:
+    record["step"] = int(step)
+  if value is not None:
+    value = float(value)
+    if value == value and abs(value) != float("inf"):
+      record["value"] = value
+    else:
+      detail["value_repr"] = repr(value)
+  if threshold is not None:
+    record["threshold"] = float(threshold)
+  if detail:
+    record["detail"] = detail
   return record
 
 

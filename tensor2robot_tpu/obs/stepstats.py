@@ -33,6 +33,7 @@ train-loop integration lives in `train_eval.py` +
 
 from __future__ import annotations
 
+import sys
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -40,6 +41,12 @@ from tensor2robot_tpu.obs import metrics as metrics_lib
 from tensor2robot_tpu.obs import trace as trace_lib
 
 __all__ = ["StepStatsRecorder"]
+
+# A window whose post-barrier residual is below this fraction of the
+# window is "barrier dominated": its step_ms is an upper bound, not a
+# measurement (the same 0.2 clamp rule as backend.time_train_steps_halves)
+# — flagged in the record so obs.sentinel's spike detector skips it.
+BARRIER_DOMINATED_RESIDUAL = 0.2
 
 # A dispatch call taking longer than BOTH this floor and 10x the running
 # median is counted as a compile event (tracing + XLA compile happen
@@ -82,10 +89,13 @@ class _NullTimer:
 _NULL_TIMER = _NullTimer()
 
 
-def _default_barrier(state) -> None:
+def _default_barrier(state):
   from tensor2robot_tpu.utils import backend
 
-  backend.state_barrier(state)
+  # Return the fetched leaf: it is ALREADY on the host (the barrier is
+  # a host fetch by definition), so the non-finite divergence check
+  # piggybacks on it for free — zero extra tunnel round trips.
+  return backend.state_barrier(state)
 
 
 class StepStatsRecorder:
@@ -129,10 +139,22 @@ class StepStatsRecorder:
     self._dispatch_history_ms: List[float] = []
     self._t_dispatch_ns = 0
     self._compile_in_window = 0
+    self._observers: List[Callable[[int, Dict[str, float]], Any]] = []
+    self._last_barrier_nonfinite: Optional[float] = None
 
   @property
   def enabled(self) -> bool:
     return self._enabled
+
+  def add_observer(self,
+                   observer: Callable[[int, Dict[str, float]], Any]
+                   ) -> None:
+    """Registers `observer(step, record)`, called synchronously for
+    every emitted window record (drain() is untouched — observers are
+    the online path, e.g. `obs.sentinel` / the flight recorder). An
+    observer that raises is warned about and dropped — telemetry must
+    never take down a train loop."""
+    self._observers.append(observer)
 
   def start(self) -> None:
     """Marks the start of the first measurement window."""
@@ -176,12 +198,61 @@ class StepStatsRecorder:
     if self._steps_in_window < self._every_n:
       return
     barrier_start_ns = time.perf_counter_ns()
-    self._barrier(state)
+    try:
+      fetched = self._barrier(state)
+    except Exception:
+      # A FAILING barrier is the strongest tunnel evidence there is:
+      # stamp it before the exception unwinds into the flight-recorder
+      # dump, so the bundle's heartbeat timeline carries the death time
+      # and cause for the in-train path (not just bench's probe path).
+      self._record_barrier_failure(
+          (time.perf_counter_ns() - barrier_start_ns) / 1e9)
+      raise
     now_ns = time.perf_counter_ns()
     self._barrier_ns += now_ns - barrier_start_ns
     self._tracer.add_complete("train/barrier", barrier_start_ns,
                               now_ns - barrier_start_ns, cat="train")
+    self._observe_barrier(fetched, (now_ns - barrier_start_ns) / 1e9)
     self._emit(step, now_ns)
+
+  def _stamp_heartbeat(self, ok: bool, barrier_s: float,
+                       cause: Optional[str] = None) -> None:
+    """The ONE place holding the tunnel-evidence rule for barriers:
+    stamp the heartbeat monitor only when the barrier actually crossed
+    the tunnel (non-CPU backend) — a CPU-pinned run's barriers say
+    nothing about tunnel health and must not overwrite a correctly
+    recorded DEAD (platform_pinned_cpu) state. Never raises (and in
+    the failure path, never masks the barrier's own error)."""
+    try:
+      import jax
+
+      if jax.devices()[0].platform != "cpu":
+        from tensor2robot_tpu.utils import backend
+
+        backend.record_heartbeat(ok, elapsed_s=barrier_s,
+                                 source="state_barrier", cause=cause)
+    except Exception:  # noqa: BLE001 - heartbeat is best-effort
+      pass
+
+  def _record_barrier_failure(self, barrier_s: float) -> None:
+    # A FAILING barrier is the strongest tunnel evidence there is.
+    self._stamp_heartbeat(False, barrier_s, cause="barrier_failed")
+
+  def _observe_barrier(self, fetched: Any, barrier_s: float) -> None:
+    """Piggybacks on the barrier's host fetch: non-finite divergence
+    check on the fetched param leaf (zero extra round trips) + a
+    tunnel heartbeat stamp (see `_stamp_heartbeat` for the
+    crossed-the-tunnel gate)."""
+    self._last_barrier_nonfinite = None
+    if fetched is not None:
+      try:
+        import numpy as np
+
+        self._last_barrier_nonfinite = float(
+            not bool(np.all(np.isfinite(np.asarray(fetched)))))
+      except Exception:  # noqa: BLE001 - non-float leaves etc.
+        self._last_barrier_nonfinite = None
+    self._stamp_heartbeat(True, barrier_s)
 
   def _emit(self, step: int, now_ns: int) -> None:
     n = self._steps_in_window
@@ -198,9 +269,24 @@ class StepStatsRecorder:
         "examples_per_sec": n * self._batch_size / window_s,
         "compile": float(self._compile_in_window > 0),
         "steps_in_window": float(n),
+        # The 0.2-residual clamp rule (backend.time_train_steps_halves):
+        # a window the barrier fetch swallowed is an upper bound — the
+        # sentinel spike detector must skip it.
+        "barrier_dominated": float(
+            window_s * 1e9 - self._barrier_ns
+            < BARRIER_DOMINATED_RESIDUAL * window_s * 1e9),
     }
+    if self._last_barrier_nonfinite is not None:
+      record["nonfinite_params"] = self._last_barrier_nonfinite
     record.update(self._read_device_gauges())
     self._records.append((int(step), record))
+    for observer in list(self._observers):
+      try:
+        observer(int(step), record)
+      except Exception as e:  # noqa: BLE001 - drop a broken observer
+        self._observers.remove(observer)
+        print(f"stepstats: observer {observer!r} failed and was "
+              f"detached ({type(e).__name__}: {e})", file=sys.stderr)
     reg = self._registry
     reg.histogram("stepstats/step_ms").record(step_ms)
     reg.histogram("stepstats/device_ms").record(device_ms)

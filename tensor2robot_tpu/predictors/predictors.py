@@ -37,6 +37,7 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.export import export_generator as export_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import sentinel as obs_sentinel
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.obs import xray as obs_xray
 from tensor2robot_tpu.parallel import train_step as ts
@@ -83,13 +84,21 @@ class AbstractPredictor(abc.ABC):
 
 
 class _JaxPredictorBase(AbstractPredictor):
-  """Common predict plumbing: pack features by spec, run jitted fn."""
+  """Common predict plumbing: pack features by spec, run jitted fn.
 
-  def __init__(self):
+  `latency_slo_ms` arms the serving SLO breach counter
+  (`serve/slo_breaches`, `obs.sentinel.observe_serving_latency`):
+  every predict whose END-TO-END latency (the `np.asarray` fetch is the
+  tunnel barrier) exceeds it increments the counter — a latency
+  regression becomes a counter delta in the graftscope report instead
+  of a percentile archaeology session. None disables."""
+
+  def __init__(self, latency_slo_ms: Optional[float] = None):
     self._model = None
     self._state: Optional[ts.TrainState] = None
     self._predict_fn: Optional[Callable] = None
     self._global_step = -1
+    self._latency_slo_ms = latency_slo_ms
 
   def _build_predict(self) -> None:
     model = self._model
@@ -134,24 +143,29 @@ class _JaxPredictorBase(AbstractPredictor):
     # graftscope serving latency: the np.asarray fetch inside the timed
     # window IS the tunnel barrier (block_until_ready is not), so the
     # histogram measures true end-to-end latency, not dispatch.
-    with obs_trace.span("serve/predict", cat="serve"), \
-        obs_metrics.histogram("serve/predict_ms").time_ms():
+    start = time.perf_counter()
+    with obs_trace.span("serve/predict", cat="serve"):
       outputs = self._predict_fn(features)
       result = {k: np.asarray(v)
                 for k, v in dict(outputs.items()).items()}
-    obs_metrics.counter("serve/predictions").inc()
+    self._observe_latency((time.perf_counter() - start) * 1e3)
     return result
 
   def predict_preprocessed(self, features) -> Dict[str, np.ndarray]:
     """Predict on MODEL-layout (already-preprocessed) features."""
     self.assert_is_loaded()
-    with obs_trace.span("serve/predict_preprocessed", cat="serve"), \
-        obs_metrics.histogram("serve/predict_ms").time_ms():
+    start = time.perf_counter()
+    with obs_trace.span("serve/predict_preprocessed", cat="serve"):
       outputs = self._predict_preprocessed_fn(features)
       result = {k: np.asarray(v)
                 for k, v in dict(outputs.items()).items()}
-    obs_metrics.counter("serve/predictions").inc()
+    self._observe_latency((time.perf_counter() - start) * 1e3)
     return result
+
+  def _observe_latency(self, elapsed_ms: float) -> None:
+    obs_metrics.histogram("serve/predict_ms").record(elapsed_ms)
+    obs_metrics.counter("serve/predictions").inc()
+    obs_sentinel.observe_serving_latency(elapsed_ms, self._latency_slo_ms)
 
 
 @config.configurable
@@ -161,8 +175,9 @@ class CheckpointPredictor(_JaxPredictorBase):
   model object and polls model_dir for new steps."""
 
   def __init__(self, model=None, model_dir: Optional[str] = None,
-               timeout_secs: float = 0.0):
-    super().__init__()
+               timeout_secs: float = 0.0,
+               latency_slo_ms: Optional[float] = None):
+    super().__init__(latency_slo_ms=latency_slo_ms)
     if model is None or model_dir is None:
       raise ValueError("model and model_dir are required.")
     self._model = model
@@ -244,8 +259,9 @@ class ExportedModelPredictor(_JaxPredictorBase):
   timestamped dir, loads assets + params, optional async restore."""
 
   def __init__(self, export_dir: Optional[str] = None, model=None,
-               timeout_secs: float = 0.0):
-    super().__init__()
+               timeout_secs: float = 0.0,
+               latency_slo_ms: Optional[float] = None):
+    super().__init__(latency_slo_ms=latency_slo_ms)
     if export_dir is None:
       raise ValueError("export_dir is required.")
     self._export_dir = export_dir

@@ -28,14 +28,15 @@ from typing import Any, Callable, Iterator, List, Optional, Sequence
 import jax
 import numpy as np
 from absl import logging
-from jax.sharding import PartitionSpec
 
 from tensor2robot_tpu import checkpoints as checkpoints_lib
 from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.hooks import core as hooks_lib
+from tensor2robot_tpu.obs import flightrec as flightrec_lib
 from tensor2robot_tpu.obs import metrics as metrics_registry_lib
 from tensor2robot_tpu.obs import runlog as runlog_lib
+from tensor2robot_tpu.obs import sentinel as sentinel_lib
 from tensor2robot_tpu.obs import stepstats as stepstats_lib
 from tensor2robot_tpu.obs import trace as trace_lib
 from tensor2robot_tpu.obs import xray as xray_lib
@@ -189,6 +190,8 @@ def train_eval_model(
     device_prefetch_depth: int = 2,
     iterations_per_loop: int = 1,
     step_stats_every_n_steps: Optional[int] = None,
+    enable_sentinel: bool = True,
+    watchdog_timeout_secs: Optional[float] = None,
 ) -> dict:
   """Runs the requested mode; returns final metrics.
 
@@ -220,7 +223,20 @@ def train_eval_model(
   dispatch) and the run appends a schema-versioned record — step-stat
   summary, compile telemetry, HBM-watermark estimate — to
   `<model_dir>/runs.jsonl` (`obs.runlog`; compare runs with
-  `python -m tensor2robot_tpu.bin.graftscope diff`)."""
+  `python -m tensor2robot_tpu.bin.graftscope diff`).
+
+  With telemetry on and `enable_sentinel` (default), the run is also
+  watched ONLINE (`obs.sentinel` at the stepstats cadence: step-time
+  spikes, data starvation, non-finite divergence piggybacked on the
+  barrier fetch, HBM drift — incidents appended to
+  `<model_dir>/incidents.jsonl`) and flight-recorded
+  (`obs.flightrec`): a crash, a SIGTERM, a fatal incident, or —
+  when `watchdog_timeout_secs` is set — a hang dumps a postmortem
+  bundle of the last steps/incidents/heartbeat timeline under
+  `<model_dir>/flightrec/` (`graftscope postmortem <model_dir>`
+  renders it). The default watchdog is OFF: over the axon tunnel a
+  first compile legitimately takes minutes, so the timeout is a
+  per-deployment choice."""
   if mode not in ("train", "evaluate", "train_and_evaluate",
                   "continuous_eval"):
     raise ValueError(f"Unknown train_eval mode {mode!r}")
@@ -310,8 +326,30 @@ def train_eval_model(
       batch_size=(input_generator_train.batch_size if needs_train else 0),
       every_n_steps=step_stats_every_n_steps if needs_train else 0)
   run_memory: dict = {}
+  sentinel = flight_recorder = None
   if step_stats.enabled:
     hooks.append(hooks_lib.StepStatsHook())
+    if enable_sentinel:
+      # Online third leg of graftscope: sentinel rides the stepstats
+      # cadence (observer below — zero extra barriers/round trips) and
+      # fans incidents out to incidents.jsonl + the flight recorder,
+      # whose ring buffers back the postmortem bundle on crash/SIGTERM/
+      # hang/fatal incident.
+      flight_recorder = flightrec_lib.FlightRecorder(
+          os.path.join(model_dir, flightrec_lib.FLIGHTREC_DIRNAME),
+          hang_timeout_secs=watchdog_timeout_secs)
+      incidents_path = os.path.join(model_dir,
+                                    runlog_lib.INCIDENTS_FILENAME)
+      sentinel = sentinel_lib.Sentinel(sinks=[
+          lambda record: runlog_lib.append_record(incidents_path, record),
+          flight_recorder.record_incident])
+      # Order matters: the recorder must ring a window BEFORE the
+      # sentinel sees it — a fatal incident dumps the bundle
+      # synchronously from the sentinel's sink, and the bundle must
+      # include the very window that triggered it.
+      step_stats.add_observer(flight_recorder.record_step)
+      step_stats.add_observer(sentinel.observe_step_record)
+      hooks.append(hooks_lib.SentinelHook())
     # Per-run telemetry: clear the process-global trace buffer, metrics
     # registry and xray compile-record collector so the saved trace,
     # final snapshot and run record cover exactly this run (the tracer
@@ -331,7 +369,9 @@ def train_eval_model(
                                get_state=lambda: state,
                                summary_writer=writer, mesh=mesh,
                                step_stats=(step_stats if step_stats.enabled
-                                           else None))
+                                           else None),
+                               sentinel=sentinel,
+                               flight_recorder=flight_recorder)
   for hook in hooks:
     hook.begin(ctx)
 
@@ -468,26 +508,53 @@ def train_eval_model(
     `iterations_per_loop` quantization)."""
     return interval > 0 and (cur // interval) > (prev // interval)
 
+  # Host batches consumed from a finite stream that ended mid-group:
+  # single-stepped (oldest first) instead of dropped — the train twin of
+  # the eval partial-group rule in _run_eval.
+  pending_host_batches: List = []
+
+  def _next_host(stream):
+    if pending_host_batches:
+      return pending_host_batches.pop(0)
+    return next(stream)
+
   def _stacked_group(stream, k):
-    """Stacks k consecutive host batches on a leading scan axis.
-    StopIteration propagates, matching the single-step path's contract
-    for exhausted finite train streams."""
-    group = [next(stream) for _ in range(k)]
+    """Stacks k consecutive host batches on a leading scan axis. A
+    finite stream ending MID-group parks the already-consumed batches
+    for single-step dispatch and returns None (the compiled loop is
+    shape-specialized to exactly k); StopIteration on a group BOUNDARY
+    propagates, matching the single-step path's contract for exhausted
+    finite train streams."""
+    group = []
+    try:
+      for _ in range(k):
+        group.append(_next_host(stream))
+    except StopIteration:
+      if not group:
+        raise
+      pending_host_batches.extend(group)
+      return None
     return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *group)
 
   use_loop_for = lambda remaining: (train_loop is not None
                                     and remaining >= loop_k)
 
   def _place_next(remaining, stream):
-    if use_loop_for(remaining):
-      return (mesh_lib.place_batch(mesh, _stacked_group(stream, loop_k),
-                                   batch_spec=loop_spec), loop_k)
-    return (mesh_lib.place_batch(mesh, next(stream), batch_spec=batch_spec),
-            1)
+    if use_loop_for(remaining) and not pending_host_batches:
+      stacked = _stacked_group(stream, loop_k)
+      if stacked is not None:
+        return (mesh_lib.place_batch(mesh, stacked,
+                                     batch_spec=loop_spec), loop_k)
+    return (mesh_lib.place_batch(mesh, _next_host(stream),
+                                 batch_spec=batch_spec), 1)
 
   try:
     if step_stats.enabled:
       trace_lib.enable()
+    if flight_recorder is not None:
+      # Arms the tunnel-safe SIGTERM handler and (when configured) the
+      # hang watchdog for exactly the loop's lifetime.
+      flight_recorder.install()
     if step < max_train_steps:
       step_stats.start()
       # First placement BEFORE the worker starts: if it raises there is
@@ -511,6 +578,8 @@ def train_eval_model(
               depth=device_prefetch_depth)
     last_log_step = step
     while step < max_train_steps:
+      if flight_recorder is not None:
+        flight_recorder.touch()
       features, labels = placed
       prev_step = step
       step_stats.before_dispatch()
@@ -524,16 +593,24 @@ def train_eval_model(
       # dispatch just issued — host parse/stack/place overlaps device
       # compute instead of serializing after the metrics fetch below.
       # (The single-step prefetcher path gets the same overlap from its
-      # worker thread.)
+      # worker thread.) A finite stream running out HERE is deferred to
+      # the end of this iteration: the step just dispatched still gets
+      # its barrier/hooks/log/checkpoint bookkeeping (its batch counts
+      # — the train twin of the eval partial-group rule) before the
+      # documented StopIteration exhaustion contract fires.
+      stream_exhausted = False
       if step < max_train_steps:
-        if prefetcher is not None:
-          with step_stats.data_wait():
-            placed = next(prefetcher)
-          placed_k = 1
-        else:
-          with step_stats.data_wait():
-            placed, placed_k = _place_next(max_train_steps - step,
-                                           train_dataset)
+        try:
+          if prefetcher is not None:
+            with step_stats.data_wait():
+              placed = next(prefetcher)
+            placed_k = 1
+          else:
+            with step_stats.data_wait():
+              placed, placed_k = _place_next(max_train_steps - step,
+                                             train_dataset)
+        except StopIteration:
+          stream_exhausted = True
       # Measured-window close (barrier at the stepstats cadence) sits
       # AFTER next-batch staging — overlap preserved — and BEFORE the
       # per-step metrics fetch, so device_ms absorbs the device wait
@@ -553,6 +630,11 @@ def train_eval_model(
       if _crossed(log_every_n_steps, prev_step, step) \
           or step == max_train_steps:
         scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        if sentinel is not None:
+          # The scalars were JUST fetched for logging anyway — the
+          # non-finite check rides that fetch for free (the hook path
+          # skips live device arrays by design).
+          sentinel.observe_metrics(step, scalars)
         writer.write_scalars(step, scalars)
         now = time.time()
         logging.info("step %d: loss=%.5f (%.1f steps/s)", step,
@@ -593,6 +675,22 @@ def train_eval_model(
           logging.info("eval @%d: %s", step, eval_metrics)
           final_metrics.update(
               {f"eval/{k}": v for k, v in eval_metrics.items()})
+          if flight_recorder is not None:
+            # An in-loop eval is legitimate non-train time; re-arm the
+            # watchdog so only a REAL stall past the timeout dumps.
+            # (Pick watchdog_timeout_secs above the longest eval.)
+            flight_recorder.touch()
+      if stream_exhausted:
+        raise StopIteration(
+            f"finite train stream exhausted after step {step}")
+  except Exception as e:
+    # Unhandled crash: dump the flight-recorder bundle BEFORE unwinding
+    # (the ring buffers and heartbeat timeline are the postmortem).
+    # StopIteration is excluded — a finite train stream ending is the
+    # documented loop-exit contract, not a crash.
+    if flight_recorder is not None and not isinstance(e, StopIteration):
+      flight_recorder.dump("exception", exc=e)
+    raise
   finally:
     # Runs on SystemExit(42) preemption and any step/hook/eval failure
     # too: a daemon worker killed at interpreter shutdown mid device_put
@@ -601,6 +699,8 @@ def train_eval_model(
     # catches the error and keeps the process alive would otherwise pay
     # span-recording overhead forever (the buffered events survive for
     # StepStatsHook.end's save on the normal path).
+    if flight_recorder is not None:
+      flight_recorder.close()  # disarm watchdog + restore SIGTERM
     if step_stats.enabled:
       trace_lib.disable()
     if prefetcher is not None:
@@ -610,7 +710,8 @@ def train_eval_model(
   for hook in hooks:
     hook.end(ctx)
   if step_stats.enabled:
-    _append_run_record(model_dir, run_memory, final_metrics, step)
+    _append_run_record(model_dir, run_memory, final_metrics, step,
+                       sentinel=sentinel)
   manager.wait_until_finished()
   manager.close()
   writer.close()
@@ -618,10 +719,12 @@ def train_eval_model(
 
 
 def _append_run_record(model_dir: str, run_memory: dict,
-                       final_metrics: dict, final_step: int) -> None:
+                       final_metrics: dict, final_step: int,
+                       sentinel=None) -> None:
   """Appends this run's schema-versioned record to model_dir/runs.jsonl
   (`obs.runlog`): step-stat summary from the registry, xray compile
-  records, memory accounting + HBM watermark estimate, final metrics.
+  records, memory accounting + HBM watermark estimate, final metrics,
+  sentinel incident totals + the tunnel-heartbeat health block.
   Best-effort — the run's result never depends on its telemetry."""
   try:
     from tensor2robot_tpu.utils import backend
@@ -646,6 +749,11 @@ def _append_run_record(model_dir: str, run_memory: dict,
       if np.isfinite(value):
         finite_metrics[key] = value
     device = jax.devices()[0]
+    extra = {"model_dir": model_dir, "final_step": int(final_step),
+             "final_metrics": finite_metrics,
+             "tunnel_health": backend.tunnel_health()}
+    if sentinel is not None:
+      extra["sentinel"] = sentinel.summary()
     record = runlog_lib.make_record(
         "train",
         platform=device.platform,
@@ -654,8 +762,7 @@ def _append_run_record(model_dir: str, run_memory: dict,
         step_stats=summary,
         compile_records=compile_records,
         memory=memory,
-        extra={"model_dir": model_dir, "final_step": int(final_step),
-               "final_metrics": finite_metrics})
+        extra=extra)
     runlog_lib.append_record(
         os.path.join(model_dir, runlog_lib.RUNS_FILENAME), record)
   except Exception:  # noqa: BLE001 - telemetry never kills a run

@@ -20,6 +20,8 @@ import os
 import re
 import subprocess
 import sys
+import threading
+import time
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
@@ -130,6 +132,134 @@ def device_memory_stats() -> dict:
   return out
 
 
+class HeartbeatMonitor:
+  """Tunnel-health state machine fed by timestamped probes and barriers.
+
+  Rounds 1-5 all ended with the axon tunnel degrading or dying
+  mid-window and nothing machine-readable recording WHEN it turned or
+  WHY the CPU fallback fired (VERDICT r5 weakness #1). Every tunnel
+  touchpoint that already exists — the ``accelerator_healthy``
+  subprocess probe, bench's per-probe children, stepstats' per-window
+  ``state_barrier`` fetch — now stamps its outcome here, and the
+  monitor classifies the tunnel as ``healthy`` / ``degraded`` / ``dead``
+  (``unknown`` before the first probe) and keeps the full transition
+  timeline. ``bench.py`` embeds ``health_block()`` in its headline JSON
+  and runlog record; ``obs.flightrec`` snapshots it into postmortem
+  bundles. Pure host-side stdlib state — recording a heartbeat NEVER
+  touches a device (safe from signal handlers and watchdog threads).
+
+  Classification per probe:
+
+  * ``ok=True`` and fast                    -> ``healthy``
+  * ``ok=True`` but ``elapsed_s`` >= the degraded threshold -> ``degraded``
+  * ``ok=None`` (ran but inconclusive — e.g. a probe child that errored
+    on its own workload: the tunnel answered)             -> ``degraded``
+  * ``ok=False`` (probe failed/timed out/never ran)       -> ``dead``
+  """
+
+  HEALTHY = "healthy"
+  DEGRADED = "degraded"
+  DEAD = "dead"
+  UNKNOWN = "unknown"
+
+  def __init__(self, degraded_after_s: float = 60.0, clock=None,
+               max_transitions: int = 64):
+    self._degraded_after_s = float(degraded_after_s)
+    self._clock = clock or time.time
+    self._max_transitions = int(max_transitions)
+    self._lock = threading.Lock()
+    self.reset()
+
+  def reset(self) -> None:
+    with self._lock:
+      self._state = self.UNKNOWN
+      self._cause = None
+      self._transitions = []
+      self._probes = 0
+      self._last = None
+
+  def record_probe(self, ok, elapsed_s: float = 0.0,
+                   source: str = "probe", cause: str | None = None,
+                   degraded_after_s: float | None = None) -> str:
+    """Stamps one probe outcome; returns the (possibly new) state.
+
+    `degraded_after_s` overrides the monitor's slow-probe threshold for
+    THIS probe: the default (60 s) is sized for health probes and
+    barriers, but e.g. a bench probe child legitimately pays fresh jax
+    init + a first compile (minutes over the tunnel) — callers pass a
+    limit scaled to their own deadline so routine probes do not read
+    as degradation.
+    """
+    now = self._clock()
+    slow_after = (self._degraded_after_s if degraded_after_s is None
+                  else float(degraded_after_s))
+    if ok is True:
+      state = (self.DEGRADED if elapsed_s >= slow_after
+               else self.HEALTHY)
+      cause = cause or ("slow_probe" if state == self.DEGRADED else None)
+    elif ok is None:
+      state, cause = self.DEGRADED, (cause or "probe_inconclusive")
+    else:
+      state, cause = self.DEAD, (cause or "probe_failed")
+    with self._lock:
+      self._probes += 1
+      self._last = {"ok": ok, "elapsed_s": float(elapsed_s),
+                    "unix_time": now, "source": source, "cause": cause}
+      if state != self._state:
+        self._transitions.append(
+            {"state": state, "unix_time": now, "source": source,
+             "cause": cause, "elapsed_s": float(elapsed_s)})
+        if len(self._transitions) > self._max_transitions:
+          # Keep the first transition (when the run's health history
+          # started) and the most recent tail.
+          self._transitions = ([self._transitions[0]]
+                               + self._transitions[-(self._max_transitions
+                                                     - 1):])
+        self._state = state
+        self._cause = cause
+      return self._state
+
+  @property
+  def state(self) -> str:
+    return self._state
+
+  def transitions(self) -> list:
+    with self._lock:
+      return [dict(t) for t in self._transitions]
+
+  def health_block(self) -> dict:
+    """JSON-safe summary: current state, cause, transition timeline."""
+    with self._lock:
+      return {
+          "state": self._state,
+          "cause": self._cause,
+          "probes": self._probes,
+          "last_probe": dict(self._last) if self._last else None,
+          "transitions": [dict(t) for t in self._transitions],
+      }
+
+
+_HEARTBEAT = HeartbeatMonitor()
+
+
+def heartbeat_monitor() -> HeartbeatMonitor:
+  """The process-wide monitor every tunnel touchpoint stamps into."""
+  return _HEARTBEAT
+
+
+def record_heartbeat(ok, elapsed_s: float = 0.0, source: str = "probe",
+                     cause: str | None = None,
+                     degraded_after_s: float | None = None) -> str:
+  return _HEARTBEAT.record_probe(ok, elapsed_s=elapsed_s, source=source,
+                                 cause=cause,
+                                 degraded_after_s=degraded_after_s)
+
+
+def tunnel_health() -> dict:
+  """The monitor's JSON-safe health block (state + cause + timeline)."""
+  return _HEARTBEAT.health_block()
+
+
 def time_op(fn, *args, iters: int = 30):
   """Per-iter wall time of a (jitted) op with the host-fetch barrier
   cost cancelled — the ONE shared micro-op timer for the tunnel scripts
@@ -183,10 +313,15 @@ def time_train_steps(step, state, features, labels, iters,
 
 
 def time_train_steps_halves(step, state, features, labels, iters,
-                            warmup: int = 3):
+                            warmup: int = 3, out_flags: dict | None = None):
   """``time_train_steps`` with the timed loop split into two
   barrier-separated halves; returns ``(sec_per_step_first_half,
-  sec_per_step_second_half, final_state)``.
+  sec_per_step_second_half, final_state)``. When a half's window is
+  barrier-dominated (see ``_pure`` below) and ``out_flags`` is given,
+  ``out_flags["barrier_dominated"] = True`` is set so callers (bench
+  probe records, autotune's ranking) know the number is a clamped
+  estimate rather than a measurement, and ``obs.sentinel``'s step-time
+  spike detector ignores such records.
 
   Why: one-time remote effects INSIDE the timed window (first-touch
   allocation, defrag, terminal-side warm caches) inflate a plain mean —
@@ -225,14 +360,24 @@ def time_train_steps_halves(step, state, features, labels, iters,
   barrier_cost = time.perf_counter() - mid
 
   def _pure(window, n):
-    # Fall back to the un-subtracted window when the estimated barrier
-    # cost swallows (nearly) all of it: a noisy barrier estimate close
-    # to a short half-window would otherwise leave a near-zero residual
-    # and report an absurdly small step time — and autotune keeps the
-    # MAX examples/sec, so one such probe would become the headline.
+    # Clamp the barrier-dominated fallback: when the estimated barrier
+    # cost swallows (nearly) all of the window, a naive residual would
+    # be near-zero (or negative) and report an absurdly small step time
+    # — autotune keeps the MAX examples/sec, so one such probe would
+    # become the headline. Returning the FULL window (pre-round-5
+    # behavior) over-corrects the other way: it re-includes the whole
+    # barrier and reads ~barrier/n high. Clamp to max(residual,
+    # 0.2*window) — a bounded estimate that can still sit on EITHER
+    # side of the truth when the barrier estimate itself is noisy,
+    # which is exactly why the record is flagged ``barrier_dominated``:
+    # consumers (bench autotune's ranking, sentinel's spike detector)
+    # must treat it as untrusted, not merely conservative (ADVICE.md
+    # round 5).
     residual = window - barrier_cost
     if residual < 0.2 * window:
-      return window / n
+      if out_flags is not None:
+        out_flags["barrier_dominated"] = True
+      return max(residual, 0.2 * window) / n
     return residual / n
 
   sec_h1 = _pure(mid - start, n1)
@@ -254,19 +399,34 @@ def accelerator_healthy(timeout: float = 120.0) -> bool:
   a client mid TPU-init is what wedged the tunnel (and later killed the
   relay) in round 1 — see NOTES_r1.md. On timeout it gets SIGTERM and, if
   that is ignored, is left to finish or hang on its own.
+
+  Every outcome is stamped into the process heartbeat monitor
+  (``tunnel_health()``), so a later CPU fallback can report the cause
+  and time of the tunnel turning instead of silently switching metrics.
   """
   if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    record_heartbeat(False, source="accelerator_healthy",
+                     cause="platform_pinned_cpu")
     return False
   proc = subprocess.Popen(
       [sys.executable, "-c",
        "import jax; assert jax.devices()[0].platform != 'cpu'"],
       stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+  start = time.monotonic()
   try:
-    return proc.wait(timeout=timeout) == 0
+    ok = proc.wait(timeout=timeout) == 0
+    record_heartbeat(ok, elapsed_s=time.monotonic() - start,
+                     source="accelerator_healthy",
+                     cause=None if ok
+                     else "probe_failed"
+                          f"(rc={getattr(proc, 'returncode', '?')})")
+    return ok
   except subprocess.TimeoutExpired:
     proc.terminate()  # SIGTERM only — never SIGKILL (see docstring).
     try:
       proc.wait(timeout=10)
     except subprocess.TimeoutExpired:
       pass  # Still mid-init: orphan it rather than hard-kill.
+    record_heartbeat(False, elapsed_s=time.monotonic() - start,
+                     source="accelerator_healthy", cause="probe_timeout")
     return False

@@ -164,3 +164,69 @@ def test_state_barrier_fetches_smallest_param_leaf():
 
   fetched = backend.state_barrier(_State())
   np.testing.assert_array_equal(fetched, [7.0])
+
+
+def test_time_train_steps_halves_clamps_barrier_dominated_windows():
+  """ADVICE round 5: when the estimated barrier cost swallows a half's
+  window, the fallback must be max(residual, 0.2*window)/n — NOT the
+  full window (which re-includes the whole barrier and reads high) —
+  and out_flags must flag the record so autotune/sentinel treat the
+  number as an upper bound."""
+  import time as _time
+
+  import numpy as np
+
+  class _SlowLeaf:
+    """Param leaf whose host fetch (the barrier) dominates the window."""
+    size = 1
+    shape = (1,)
+
+    def __array__(self, *a, **kw):
+      _time.sleep(0.03)
+      return np.zeros(1)
+
+  class _State:
+    params = {"w": _SlowLeaf()}
+
+  flags = {}
+  h1, h2, _ = backend.time_train_steps_halves(
+      lambda s, f, l: (s, {}), _State(), "f", "l", iters=4, warmup=0,
+      out_flags=flags)
+  assert flags.get("barrier_dominated") is True
+  # The clamp: a near-instant step under a ~30 ms barrier must come out
+  # far below the naive window/n fallback (which would be >= ~15 ms),
+  # yet strictly positive (downstream divides by it).
+  assert 0.0 < h1 < 0.015
+  assert 0.0 < h2 < 0.015
+
+
+def test_time_train_steps_halves_leaves_flags_unset_when_clean():
+  import numpy as np
+
+  class _State:
+    params = {"w": np.zeros(3)}
+
+  flags = {}
+  def step(state, features, labels):
+    import time as _time
+    _time.sleep(0.005)
+    return state, {}
+
+  backend.time_train_steps_halves(step, _State(), "f", "l", iters=4,
+                                  warmup=0, out_flags=flags)
+  assert "barrier_dominated" not in flags
+
+
+def test_heartbeat_records_platform_pinned_cpu_cause():
+  """accelerator_healthy under JAX_PLATFORMS=cpu must stamp the monitor
+  with the fallback cause instead of silently returning False."""
+  monitor = backend.heartbeat_monitor()
+  monitor.reset()
+  try:
+    assert backend.accelerator_healthy() is False
+    block = backend.tunnel_health()
+    assert block["state"] == "dead"
+    assert block["cause"] == "platform_pinned_cpu"
+    assert block["transitions"][0]["source"] == "accelerator_healthy"
+  finally:
+    monitor.reset()

@@ -228,3 +228,106 @@ def test_probe_failure_mid_tune_keeps_best_without_abort():
   # Non-timeout failures keep probing (an OOM at batch 128 says
   # nothing about remat at batch 64).
   assert (64, False, True) in probe.calls
+
+
+def test_transient_oom_below_a_successful_rung_does_not_mask_larger():
+  """ADVICE.md round 5: the ladder probes 256 FIRST; a transient OOM at
+  the b64 comparison probe therefore says nothing about b128/b512 when
+  b256 already fit — before the fix, the oom_floor silently skipped
+  them and the headline was stuck at the priority batch."""
+  probe = FakeProbe({
+      (256, False, False): 1200.0,
+      (64, False, False): "oom",     # transient — 256 already fit
+      (128, False, False): 1300.0,
+      (512, False, False): 1500.0,   # the real winner
+      (512, True, False): 1000.0,
+      (512, False, True): 1100.0,
+  })
+  best = bench.autotune(probe)
+  assert (128, False, False) in probe.calls
+  assert (512, False, False) in probe.calls
+  assert best["batch_size"] == 512
+  assert best["examples_per_sec"] == 1500.0
+  assert best["value_batch64"] is None  # the b64 probe itself OOMed
+
+
+def test_genuine_capacity_ceiling_still_short_circuits():
+  """An OOM above every successful rung is a real ceiling: nothing
+  larger has ever fit, so larger rungs stay skipped."""
+  probe = FakeProbe({
+      (256, False, False): "oom",    # priority probe OOMs first
+      (64, False, False): 1000.0,
+      (128, False, False): 1100.0,
+      (128, True, False): 900.0,
+      (128, False, True): 950.0,
+  })
+  best = bench.autotune(probe)
+  # 512 >= floor(256) and no success above the floor -> skipped.
+  assert (512, False, False) not in probe.calls
+  assert best["batch_size"] == 128
+
+
+def test_barrier_dominated_probe_never_outranks_clean_measurement():
+  """A clamped (barrier-dominated) timing can inflate examples/sec by
+  up to the clamp factor; the headline must come from a clean
+  measurement whenever one exists — in the ladder AND in the remat/s2d
+  adoption comparisons."""
+  def probe(b, remat, s2d):
+    rec = {"ok": True, "step_sec": 0.01, "flops": 1e12,
+           "bytes_accessed": 1e10, "device_kind": "TPU v5e",
+           "platform": "tpu", "batch_size": b}
+    if (b, remat, s2d) == (128, False, False):
+      # Suspiciously fast AND flagged: must not win.
+      return dict(rec, examples_per_sec=9999.0, barrier_dominated=True)
+    if (b, remat, s2d) == (256, True, False):
+      return dict(rec, examples_per_sec=8888.0, barrier_dominated=True)
+    return dict(rec, examples_per_sec=1000.0 + b,
+                barrier_dominated=False)
+
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 512
+  assert best["examples_per_sec"] == 1512.0
+  assert best["barrier_dominated"] is False
+  assert not best["remat"]  # the flagged remat 8888 didn't displace it
+
+
+def test_all_probes_barrier_dominated_still_yields_a_headline():
+  """When EVERY probe is flagged, the best flagged number still wins —
+  a degraded headline beats no headline."""
+  def probe(b, remat, s2d):
+    return {"ok": True, "examples_per_sec": 1000.0 + b,
+            "step_sec": 0.01, "flops": None, "bytes_accessed": None,
+            "device_kind": "TPU v5e", "platform": "tpu",
+            "batch_size": b, "barrier_dominated": True}
+
+  best = bench.autotune(probe)
+  assert best["batch_size"] == 512
+  assert best["barrier_dominated"] is True
+
+
+def test_heartbeat_classification_of_probe_outcomes():
+  """_record_probe's tunnel evidence rules: OOM = the tunnel answered
+  (healthy); other child errors = inconclusive (degraded); timeout =
+  dead; and a slow-but-successful child is judged against the probe
+  DEADLINE, not the monitor's 60 s default."""
+  from tensor2robot_tpu.utils import backend
+
+  monitor = backend.heartbeat_monitor()
+  monitor.reset()
+  try:
+    bench._record_probe({"ok": True, "examples_per_sec": 1.0,
+                         "step_sec": 1.0, "platform": "tpu",
+                         "probe_wall_sec": 240.0})  # 4 min: healthy
+    assert monitor.state == "healthy"
+    bench._record_probe({"ok": False,
+                         "error": "RESOURCE_EXHAUSTED: hbm",
+                         "probe_wall_sec": 30.0})
+    assert monitor.state == "healthy"  # OOM = tunnel ran the workload
+    bench._record_probe({"ok": False, "error": "libtpu mismatch",
+                         "probe_wall_sec": 5.0})
+    assert monitor.state == "degraded"
+    bench._record_probe({"timeout": True})
+    assert monitor.state == "dead"
+    assert monitor.health_block()["cause"] == "probe_timeout"
+  finally:
+    monitor.reset()
