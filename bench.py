@@ -555,30 +555,41 @@ def _xray_headline_block(probe_rec: dict) -> dict:
   }
 
 
-def _append_runlog(headline: dict, probe_rec: dict) -> None:
-  """Appends this bench run to the repo-root `runs.jsonl` (override with
-  GRAFTSCOPE_RUNS) so the BENCH_* trajectory is machine-comparable:
-  `python -m tensor2robot_tpu.bin.graftscope diff runs.jsonl#-2
-  runs.jsonl#-1` prices a round against the previous one. Best-effort —
-  the headline JSON never depends on the history append."""
+def _write_runlog(headline: dict, platform, device_kind,
+                  compile_records=None, memory=None,
+                  step_sec=None) -> None:
+  """THE bench-side runlog append (train-smoke AND serve headlines):
+  scrubs the headline into a strict-JSON bench block (allow_nan=False —
+  one NaN/inf scalar must cost that field, not the record), builds one
+  `graftscope-run-v1` record, and appends it to the repo-root
+  `runs.jsonl` (override with GRAFTSCOPE_RUNS) so the BENCH_* trajectory
+  is machine-comparable: `python -m tensor2robot_tpu.bin.graftscope
+  diff runs.jsonl#-2 runs.jsonl#-1` prices a round against the previous
+  one. Best-effort — the headline JSON never depends on the append."""
   try:
     from tensor2robot_tpu.obs import runlog
 
-    xray_rec = probe_rec.get("xray")
     bench_block = dict(headline)
     bench_block.pop("graftscope", None)  # registry snapshot, not diffable
-    bench_block["step_sec"] = probe_rec.get("step_sec")
-    # runs.jsonl is strict JSON (allow_nan=False): one NaN/inf scalar
-    # (e.g. a degenerate timing) must cost that field, not the record.
-    for key, value in list(bench_block.items()):
+    if step_sec is not None:
+      bench_block["step_sec"] = step_sec
+
+    def scrub(value):
+      # The serve headline nests floats (latency_ms, sweep[].qps, batcher
+      # stats): scrub recursively, or one nested inf costs the whole
+      # record at the strict allow_nan=False append.
       if isinstance(value, float) and not math.isfinite(value):
-        bench_block[key] = None
+        return None
+      if isinstance(value, dict):
+        return {k: scrub(v) for k, v in value.items()}
+      if isinstance(value, (list, tuple)):
+        return [scrub(v) for v in value]
+      return value
+
+    bench_block = scrub(bench_block)
     record = runlog.make_record(
-        "bench",
-        platform=probe_rec.get("platform"),
-        device_kind=probe_rec.get("device_kind"),
-        compile_records=[xray_rec] if xray_rec else None,
-        memory=probe_rec.get("memory"),
+        "bench", platform=platform, device_kind=device_kind,
+        compile_records=compile_records or None, memory=memory,
         bench=bench_block)
     runs_path = os.environ.get("GRAFTSCOPE_RUNS") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "runs.jsonl")
@@ -586,6 +597,17 @@ def _append_runlog(headline: dict, probe_rec: dict) -> None:
   except Exception as e:  # noqa: BLE001 - history is telemetry, not output
     print(f"bench: runs.jsonl append failed ({type(e).__name__}: {e})",
           file=sys.stderr)
+
+
+def _append_runlog(headline: dict, probe_rec: dict) -> None:
+  """Train-smoke headline → runlog record (see `_write_runlog`)."""
+  xray_rec = probe_rec.get("xray")
+  _write_runlog(headline,
+                platform=probe_rec.get("platform"),
+                device_kind=probe_rec.get("device_kind"),
+                compile_records=[xray_rec] if xray_rec else None,
+                memory=probe_rec.get("memory"),
+                step_sec=probe_rec.get("step_sec"))
 
 
 def _graftscope_block() -> dict:
@@ -599,12 +621,144 @@ def _graftscope_block() -> dict:
           "metrics": obs_metrics.snapshot(prefix="bench/")}
 
 
+SERVE_CONCURRENCY = 8
+SERVE_MAX_BATCH = 8
+SERVE_SWEEP = (1, 2, 4, 8)
+# Recorded for this exact config on this host (round 6; host-load noise
+# swings this VM +-20%, PERFORMANCE.md round 2): batched QPS at
+# concurrency 8 through MicroBatcher + BucketedEngine over the CPU smoke
+# critic. Like cpu_anchor below, vs_baseline ~= 1.0 reads as "no serving
+# regression vs the recorded baseline", nothing more.
+SERVE_CPU_ANCHOR = 1700.0
+
+
+def serve_main(requests_per_thread: int = 150) -> None:
+  """Closed-loop serve bench: ONE JSON headline line (CPU smoke path).
+
+  Measures the graftserve stack end to end over the QT-Opt flagship
+  predictor (the CPU smoke critic — `flagship.make_flagship_model`
+  degrades honestly off-TPU): a sequential unbatched-predict baseline,
+  then a concurrency sweep through MicroBatcher + BucketedEngine. The
+  headline is batched QPS at concurrency 8 under the stable
+  `qtopt_serve_qps_cpu_smoke` metric name, with p50/p95/p99 from the
+  `serve/request_ms` histogram and a `graftscope-run-v1` record appended
+  to runs.jsonl so `graftscope diff` gates serving regressions exactly
+  like training ones. In-process on the pinned CPU backend — the serve
+  smoke never touches the tunnel (a TPU serve probe is a future window
+  item; it must ride the subprocess-probe isolation pattern above).
+  """
+  backend_lib.pin_cpu()
+  backend_lib.assert_cpu_backend()
+  import jax
+
+  from tensor2robot_tpu import serving, specs as specs_lib
+  from tensor2robot_tpu.predictors import predictors as predictors_lib
+  from tensor2robot_tpu.research.qtopt import flagship
+  from tensor2robot_tpu.serving import loadgen
+
+  device = jax.devices()[0]
+  model = flagship.make_flagship_model(device.platform)
+  predictor = predictors_lib.CheckpointPredictor(model=model,
+                                                 model_dir="/nonexistent")
+  predictor.init_randomly()
+  request = dict(specs_lib.make_random_numpy(
+      predictor.get_feature_specification(), batch_size=1,
+      seed=0).items())
+  make_request = lambda i: request  # noqa: E731 - read-only shared dict
+
+  # Unbatched baseline: ONE sequential client against the raw predictor
+  # (per-request dispatch — the pre-graftserve serving shape). A warmup
+  # call first so its one-time xray compile stays out of the window.
+  predictor.predict(request)
+  with obs_metrics.isolated():
+    unbatched = loadgen.run_load(
+        predictor.predict, make_request, concurrency=1,
+        requests_per_thread=2 * requests_per_thread)
+  print(f"bench-serve: unbatched sequential {unbatched['qps']:.1f} req/s",
+        file=sys.stderr)
+
+  engine = serving.BucketedEngine(predictor=predictor,
+                                  max_batch_size=SERVE_MAX_BATCH)
+  engine.warmup()
+  sweep = []
+  latency = {}
+  batch_stats: dict = {}
+  with serving.MicroBatcher(backend=engine,
+                            max_batch_size=SERVE_MAX_BATCH,
+                            max_delay_ms=2.0) as batcher:
+    batcher.predict(request)  # settle the worker before timing
+    for concurrency in SERVE_SWEEP:
+      with obs_metrics.isolated():
+        result = loadgen.run_load(batcher.predict, make_request,
+                                  concurrency=concurrency,
+                                  requests_per_thread=requests_per_thread)
+        if concurrency == SERVE_CONCURRENCY:
+          latency = loadgen.latency_percentiles()
+          snap = obs_metrics.snapshot(prefix="serve/")
+          batch_stats = {
+              "batches": snap.get("counter/serve/batcher/batches"),
+              "mean_batch_rows": snap.get("hist/serve/batch_rows/mean"),
+              "shed": (snap.get("counter/serve/batcher/shed_queue_full",
+                                0.0)
+                       + snap.get("counter/serve/batcher/shed_deadline",
+                                  0.0)),
+              "slo_breaches": snap.get("counter/serve/slo_breaches", 0.0),
+              # Nonzero = the warmup cache was bypassed in steady state
+              # (engine_compiles alone can't show it: it is warmup-only).
+              "exec_fallbacks": snap.get(
+                  "counter/serve/engine/exec_fallbacks", 0.0),
+          }
+      sweep.append({"concurrency": concurrency,
+                    "qps": round(result["qps"], 2),
+                    "errors": result["errors"]})
+      print(f"bench-serve: batched c={concurrency} "
+            f"{result['qps']:.1f} req/s", file=sys.stderr)
+  batched_qps = sweep[-1]["qps"]
+  compiles = engine.compile_count
+  headline = {
+      "metric": "qtopt_serve_qps_cpu_smoke",
+      "value": round(batched_qps, 2),
+      "unit": "requests/sec",
+      "vs_baseline": round(batched_qps / SERVE_CPU_ANCHOR, 3),
+      "concurrency": SERVE_CONCURRENCY,
+      "unbatched_qps": round(unbatched["qps"], 2),
+      # The acceptance ratio: the dynamic batcher must beat per-request
+      # dispatch by >= 2x at concurrency 8 (ISSUE 5 / PERFORMANCE.md
+      # "Reading a serve bench").
+      "batched_vs_unbatched": round(batched_qps / unbatched["qps"], 3)
+      if unbatched["qps"] else None,
+      "max_batch_size": SERVE_MAX_BATCH,
+      "buckets": engine.buckets,
+      "engine_compiles": compiles,
+      "latency_ms": {k: round(v, 3) for k, v in latency.items()},
+      "batcher": batch_stats,
+      "sweep": sweep,
+      "device_kind": device.device_kind,
+      "platform": device.platform,
+      "graftscope": _graftscope_block(),
+  }
+  print(json.dumps(headline))
+  _append_serve_runlog(headline, engine.compile_records, device)
+
+
+def _append_serve_runlog(headline: dict, compile_records, device) -> None:
+  """Serve headline → runlog record with per-bucket compile telemetry
+  (see `_write_runlog`), so `graftscope diff` gates a serving regression
+  with the same direction-aware thresholds as training throughput."""
+  _write_runlog(headline, platform=device.platform,
+                device_kind=device.device_kind,
+                compile_records=compile_records)
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--ab-local-compile":
     _ab_local_compile(int(sys.argv[2]) if len(sys.argv) > 2 else BATCH_SIZE)
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--serve":
+    serve_main(int(sys.argv[2]) if len(sys.argv) > 2 else 150)
     return
   best = None
   if backend_lib.accelerator_healthy():

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Closed-loop serve bench + regression gate.
+#
+# Runs `bench.py --serve` (the qtopt_serve_qps_cpu_smoke headline — see
+# PERFORMANCE.md "Reading a serve bench"), then diffs the new runs.jsonl
+# record against the PREVIOUS serve-bench record with `graftscope diff`
+# so a serving-throughput regression exits non-zero exactly like a
+# training one. Train-bench records interleave in the same runs.jsonl;
+# the index lookup below selects serve records only.
+#
+# Usage: scripts/serve_bench.sh [requests_per_thread]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+JAX_PLATFORMS=cpu python bench.py --serve "${1:-150}"
+
+# Indices of the last two qtopt_serve_qps records (empty when this was
+# the first serve run — nothing to diff yet).
+mapfile -t IDX < <(JAX_PLATFORMS=cpu python - "$RUNS" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+serve = [i for i, r in enumerate(records)
+         if "serve" in str((r.get("bench") or {}).get("metric", ""))]
+for i in serve[-2:]:
+    print(i)
+EOF
+)
+
+if [ "${#IDX[@]}" -lt 2 ]; then
+  echo "serve_bench: first serve record in $RUNS; no diff baseline yet" >&2
+  exit 0
+fi
+
+JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+    "$RUNS#${IDX[0]}" "$RUNS#${IDX[1]}"

@@ -1,0 +1,97 @@
+"""graftserve CLI: load-test the serving runtime against a real artifact.
+
+The reference has no serving CLI — exports were exercised through
+TF-Serving or ad-hoc robot clients against
+`ExportedSavedModelPredictor`
+(/root/reference/predictors/exported_savedmodel_predictor.py:53-359).
+
+Restores a predictor from an export bundle (the same timestamped dirs
+`ExportedModelPredictor` polls), fronts it with the graftserve stack
+(BucketedEngine + MicroBatcher), warms every shape bucket, then drives a
+closed-loop load test and prints ONE JSON stats line — QPS, latency
+percentiles, per-bucket compile economics, shed/SLO counters. The
+operational twin of `bench.py --serve` (same `serving.loadgen`
+machinery), pointed at real checkpoints instead of the smoke critic.
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_graftserve \
+      --export_dir /tmp/run/export \
+      --concurrency 8 --requests_per_thread 100 \
+      [--config_files tensor2robot_tpu/configs/serve_qtopt.gin]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+from absl import app, flags
+
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse (e.g. the shipped "
+                          "serve_qtopt.gin batching policy).")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+flags.DEFINE_string("export_dir", None,
+                    "Export root with timestamped bundle dirs.")
+flags.DEFINE_integer("concurrency", 8, "Closed-loop client threads.")
+flags.DEFINE_integer("requests_per_thread", 100, "Requests per client.")
+flags.DEFINE_float("deadline_ms", 0.0,
+                   "Per-request admission deadline (0 disables); expired "
+                   "requests are shed and counted as SLO breaches.")
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  if not FLAGS.export_dir:
+    raise app.UsageError("--export_dir is required.")
+
+  from tensor2robot_tpu import serving, specs as specs_lib
+  from tensor2robot_tpu.obs import metrics as obs_metrics
+  from tensor2robot_tpu.predictors import predictors as predictors_lib
+  from tensor2robot_tpu.serving import loadgen
+
+  predictor = predictors_lib.ExportedModelPredictor(
+      export_dir=FLAGS.export_dir)
+  if not predictor.restore():
+    print(f"no valid export bundle under {FLAGS.export_dir!r}",
+          file=sys.stderr)
+    return 2
+  engine = serving.BucketedEngine(predictor=predictor)
+  engine.warmup()
+  request = dict(specs_lib.make_random_numpy(
+      predictor.get_feature_specification(), batch_size=1,
+      seed=0).items())
+  with serving.MicroBatcher(backend=engine) as batcher:
+    result = loadgen.run_load(
+        batcher.predict, lambda i: request,
+        concurrency=FLAGS.concurrency,
+        requests_per_thread=FLAGS.requests_per_thread,
+        deadline_ms=FLAGS.deadline_ms or None)
+  snap = obs_metrics.snapshot(prefix="serve/")
+  print(json.dumps({
+      "global_step": predictor.global_step,
+      "qps": round(result["qps"], 2),
+      "ok": result["ok"],
+      "errors": result["errors"],
+      "concurrency": result["concurrency"],
+      "latency_ms": {k: round(v, 3)
+                     for k, v in loadgen.latency_percentiles().items()},
+      "buckets": engine.buckets,
+      "engine_compiles": engine.compile_count,
+      "compile_sec": [round(float(r.get("compile_s") or 0.0), 3)
+                      for r in engine.compile_records],
+      "shed_deadline": snap.get("counter/serve/batcher/shed_deadline", 0.0),
+      "shed_queue_full": snap.get("counter/serve/batcher/shed_queue_full",
+                                  0.0),
+      "slo_breaches": snap.get("counter/serve/slo_breaches", 0.0),
+  }))
+  return 0
+
+
+if __name__ == "__main__":
+  app.run(main)

@@ -23,6 +23,7 @@ import itertools
 import queue
 import random
 import threading
+import time
 from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -38,6 +39,10 @@ __all__ = ["resolve_file_patterns", "RecordBatchPipeline", "prefetch",
 
 PreprocessFn = Callable[[specs_lib.SpecStruct, specs_lib.SpecStruct, str],
                         Tuple[specs_lib.SpecStruct, specs_lib.SpecStruct]]
+
+# How many per-batch wait observations the prefetch consumer buffers
+# locally before one `record_many` flush into the metrics registry.
+_FLUSH_EVERY = 64
 
 
 def resolve_file_patterns(
@@ -180,21 +185,41 @@ def prefetch(stream: Iterator[Any], size: int = 2) -> Iterator[Any]:
   thread.start()
   # graftscope: how long the consumer stalls on the queue is THE input
   # pipeline health number (empty queue = host parse can't keep up).
+  # Hot-path discipline (PERFORMANCE.md "telemetry overhead"): this loop
+  # runs once per batch between the device dispatches, so it takes ONE
+  # clock pair per item (shared by trace and histogram), gates the trace
+  # write on `tracer.enabled` instead of allocating a no-op span, and
+  # flushes wait times to the registry in blocks of `_FLUSH_EVERY`
+  # (`Histogram.record_many`: one lock round trip per block, identical
+  # statistics). Snapshots lag the live stream by at most one block;
+  # the `finally` flush keeps totals exact at stream end.
   wait_hist = obs_metrics.histogram("data/prefetch_wait_ms")
   batch_counter = obs_metrics.counter("data/batches")
+  tracer = obs_trace.get_tracer()
+  pending_ms: List[float] = []
+  perf_counter_ns = time.perf_counter_ns
   try:
     while True:
-      with obs_trace.span("data/prefetch_wait", cat="data"), \
-          wait_hist.time_ms():
-        item = q.get()
+      t0 = perf_counter_ns()
+      item = q.get()
+      dur_ns = perf_counter_ns() - t0
+      if tracer.enabled:
+        tracer.add_complete("data/prefetch_wait", t0, dur_ns, cat="data")
       if item is _END:
         if error:
           raise error[0]
         return
-      batch_counter.inc()
+      pending_ms.append(dur_ns * 1e-6)
+      if len(pending_ms) >= _FLUSH_EVERY:
+        wait_hist.record_many(pending_ms)
+        batch_counter.inc(len(pending_ms))
+        pending_ms.clear()
       yield item
   finally:
     stop.set()
+    if pending_ms:
+      wait_hist.record_many(pending_ms)
+      batch_counter.inc(len(pending_ms))
 
 
 @config.configurable
@@ -294,15 +319,21 @@ class RecordBatchPipeline:
       epoch += 1
 
   def _assemble(self, raw: Iterator[List[Dict[str, bytes]]],
-                prefetch_size: Optional[int] = None
+                prefetch_size: Optional[int] = None,
+                num_parallel_parses: Optional[int] = None
                 ) -> Iterator[specs_lib.SpecStruct]:
     """raw record-tuple batches -> parsed+preprocessed (+prefetched)
     batches. Parsing runs in parallel; preprocessing stays serial in
     consumption order so stateful/seeded preprocessors keep
-    deterministic behavior. Shared with WeightedRecordPipeline."""
-    if self._num_parallel_parses > 1:
+    deterministic behavior. Shared with WeightedRecordPipeline, which
+    passes its OWN `num_parallel_parses` as a parameter — overwriting
+    this pipeline's attribute instead (the pre-round-6 behavior) leaked
+    the override into the template source's later iterations."""
+    workers = (self._num_parallel_parses if num_parallel_parses is None
+               else num_parallel_parses)
+    if workers > 1:
       parsed = parallel_map_ordered(self._parse_only, raw,
-                                    num_workers=self._num_parallel_parses)
+                                    num_workers=workers)
       stream: Iterator[specs_lib.SpecStruct] = map(
           self._apply_preprocess, parsed)
     else:
@@ -433,7 +464,10 @@ class WeightedRecordPipeline:
                     self._drop_remainder)
 
   def __iter__(self) -> Iterator[specs_lib.SpecStruct]:
-    template = self._sources[0]
-    template._num_parallel_parses = self._num_parallel_parses
-    return template._assemble(self._raw_batches(),
-                              prefetch_size=self._prefetch_size)
+    # The first source is used as the parse/preprocess TEMPLATE only;
+    # this pipeline's parallelism rides along as a parameter so the
+    # template's own configuration is never mutated (a second iteration
+    # or a caller sharing the source used to see the overwritten value).
+    return self._sources[0]._assemble(
+        self._raw_batches(), prefetch_size=self._prefetch_size,
+        num_parallel_parses=self._num_parallel_parses)

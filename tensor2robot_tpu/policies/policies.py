@@ -62,7 +62,16 @@ class Policy(abc.ABC):
 
   def restore(self) -> bool:
     if self._predictor is not None:
-      return self._predictor.restore()
+      ok = self._predictor.restore()
+      # graftserve seam: a serving-runtime predictor (BucketedEngine /
+      # MicroBatcher) exposes `warmup()` — compiling its shape-bucket
+      # executables HERE, before the robot loop starts, instead of on
+      # the first action's critical path (over the axon tunnel a cold
+      # compile is 20-40 s the robot would spend frozen mid-episode).
+      warm = getattr(self._predictor, "warmup", None)
+      if ok and warm is not None:
+        warm()
+      return ok
     return True
 
   @property

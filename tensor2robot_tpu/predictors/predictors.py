@@ -26,7 +26,8 @@ import json
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Mapping, NamedTuple, Optional,
+                    Sequence)
 
 import jax
 import numpy as np
@@ -44,7 +45,17 @@ from tensor2robot_tpu.parallel import train_step as ts
 from tensor2robot_tpu.utils import config
 
 __all__ = ["AbstractPredictor", "CheckpointPredictor",
-           "ExportedModelPredictor", "EnsemblePredictor"]
+           "ExportedModelPredictor", "EnsemblePredictor", "ServingBundle"]
+
+
+class ServingBundle(NamedTuple):
+  """What `serving.BucketedEngine` needs from a predictor (see
+  `_JaxPredictorBase.serving_bundle`)."""
+
+  jit_predict: Callable      # jitted (state, model_features) -> outputs
+  get_state: Callable        # () -> current TrainState (restore-aware)
+  preprocess: Callable       # wire features -> model-layout features
+  feature_spec: Any          # wire-layout feature spec (warmup synthesis)
 
 
 class AbstractPredictor(abc.ABC):
@@ -97,19 +108,24 @@ class _JaxPredictorBase(AbstractPredictor):
     self._model = None
     self._state: Optional[ts.TrainState] = None
     self._predict_fn: Optional[Callable] = None
+    self._jit_predict: Optional[Callable] = None
     self._global_step = -1
     self._latency_slo_ms = latency_slo_ms
 
   def _build_predict(self) -> None:
     model = self._model
+    # The raw jitted predict fn is kept separately from the xray wrapper:
+    # graftserve's BucketedEngine AOT-compiles IT once per shape bucket
+    # (`serving_bundle`), while the in-process predict path below wraps
+    # it in compile telemetry frozen at the first live shape.
+    self._jit_predict = ts.make_predict_fn(model)
     # graftscope-xray compile telemetry: the first predict AOT-compiles
     # through analyze_jit (compile time / jaxpr size / cost analysis
     # into the `serve/predict` record) and later calls reuse that
     # executable; a batch-size change or an analysis failure silently
     # degrades to the plain jitted fn (serving must never break on
     # telemetry).
-    predict = obs_xray.XrayedFunction("serve/predict",
-                                      ts.make_predict_fn(model))
+    predict = obs_xray.XrayedFunction("serve/predict", self._jit_predict)
     preprocessor = model.preprocessor
 
     def fn(features):
@@ -123,6 +139,32 @@ class _JaxPredictorBase(AbstractPredictor):
     # preprocessor's wire format).
     self._predict_preprocessed_fn = lambda features: predict(self._state,
                                                              features)
+
+  def serving_bundle(self) -> "ServingBundle":
+    """The graftserve seam: the pieces an external serving runtime needs.
+
+    Returns the RAW jitted predict fn (AOT-traceable per shape bucket —
+    not the xray wrapper, which freezes at its first live shape), a
+    state getter (so a later `restore()` hot-swap is visible to cached
+    executables without re-warming: shapes/dtypes are stable across
+    restores, only values change), the host-side preprocess fn that
+    maps wire-layout features to the model layout, and the wire-layout
+    feature spec for synthesizing warmup batches.
+    """
+    self.assert_is_loaded()
+    model = self._model
+    preprocessor = model.preprocessor
+
+    def preprocess(features):
+      features, _ = preprocessor.preprocess(
+          features, specs_lib.SpecStruct(), modes_lib.PREDICT)
+      return features
+
+    return ServingBundle(
+        jit_predict=self._jit_predict,
+        get_state=lambda: self._state,
+        preprocess=preprocess,
+        feature_spec=self.get_feature_specification())
 
   def get_feature_specification(self) -> specs_lib.SpecStruct:
     self.assert_is_loaded()

@@ -1,0 +1,18 @@
+"""graftserve: the throughput-oriented inference runtime.
+
+Layer order, robot to chip:
+
+  clients -> MicroBatcher (coalesce + admission control, batcher.py)
+          -> BucketedEngine (pad to bucket, cached executable, engine.py)
+          -> predictor serving_bundle (jitted predict + state)
+
+plus `loadgen` (closed-loop concurrency sweeps) for measurement. See
+docs/ARCHITECTURE.md "Serving runtime (graftserve)".
+"""
+
+from tensor2robot_tpu.serving.batcher import (DeadlineError, MicroBatcher,
+                                              ShedError, ShutdownError)
+from tensor2robot_tpu.serving.engine import BucketedEngine, bucket_ladder
+
+__all__ = ["MicroBatcher", "BucketedEngine", "bucket_ladder", "ShedError",
+           "DeadlineError", "ShutdownError"]

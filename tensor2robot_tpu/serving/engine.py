@@ -1,0 +1,299 @@
+"""graftserve engine: shape-bucketed executable cache over a predictor.
+
+The reference's serving runtime stops at one-request-per-session-call
+SavedModel serving
+(/root/reference/predictors/exported_savedmodel_predictor.py:53-359);
+it has no executable reuse story at all — TF sessions re-specialize
+per feed shape behind the scenes.
+
+The recompile problem this solves: a jitted predict fn compiles per
+input SHAPE, and serving traffic arrives at every batch size — over the
+axon tunnel each fresh compile costs 20-40 s while clients wait, and the
+in-process predictor's xray wrapper freezes at its FIRST live shape,
+permanently degrading every other size to plain-jit dispatch (one
+compile per new size, forever). Production inference engines fix this
+with compile-once/serve-many executable reuse (PAPERS.md: portable O(1)
+autoregressive caching; the Gemma-on-TPU serving writeup): pad requests
+up a small bucket ladder so a handful of executables, compiled ONCE at
+startup, cover every request size.
+
+`BucketedEngine` implements that cache:
+
+* a bucket ladder (default: doubling 1/2/4/.../max_batch_size) — each
+  bucket AOT-compiled eagerly at `warmup()` through the graftscope-xray
+  path (`obs.xray.analyze_jit`), so compile time, jaxpr size, roofline
+  and per-bucket cost analysis land in the metrics registry and the
+  run's `runs.jsonl` record like every other executable in this repo;
+* `predict(features)` pads the batch up to the smallest covering bucket
+  (pad rows repeat row 0 — always in-distribution, never NaN fodder),
+  dispatches the CACHED executable, host-fetches, and masks the pad
+  rows out of every returned output;
+* a pinned zero-recompile guarantee: after warmup every spec-conforming
+  request hits a cached executable (`serve/engine/compiles` stays at
+  `len(buckets)` — tests/test_graftserve.py pins it across a randomized
+  request-size sweep). Requests larger than the top bucket are chunked
+  into top-bucket dispatches;
+* serving never breaks on cache trouble: a Compiled call rejected at
+  dispatch (e.g. off-spec dtypes) falls back to the plain jitted fn
+  (counted: `serve/engine/exec_fallbacks`), mirroring
+  `obs.xray.XrayedFunction`.
+
+Backend-free at import like `obs/`: jax is imported only inside methods,
+which run where the backend is already up (tier-1 poisoned-platform
+trap covers this module).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
+from tensor2robot_tpu.utils import config
+
+__all__ = ["BucketedEngine", "bucket_ladder"]
+
+
+def bucket_ladder(max_batch_size: int) -> List[int]:
+  """The default doubling ladder 1, 2, 4, ... with max always included
+  (a non-power-of-two max becomes the top rung: 12 -> [1, 2, 4, 8, 12])."""
+  if max_batch_size < 1:
+    raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+  ladder = []
+  b = 1
+  while b < max_batch_size:
+    ladder.append(b)
+    b *= 2
+  ladder.append(max_batch_size)
+  return ladder
+
+
+def _pad_rows(array: np.ndarray, bucket: int) -> np.ndarray:
+  """Pads the leading dim up to `bucket` by repeating row 0 (always a
+  valid, in-distribution row — zero padding can feed NaN-producing ops
+  like normalizations on degenerate inputs)."""
+  rows = array.shape[0]
+  if rows == bucket:
+    return array
+  pad = np.broadcast_to(array[:1], (bucket - rows,) + array.shape[1:])
+  return np.concatenate([array, pad], axis=0)
+
+
+@config.configurable
+class BucketedEngine:
+  """Shape-bucketed executable cache in front of a predictor.
+
+  Wraps any `_JaxPredictorBase` (via its `serving_bundle()` seam).
+  Duck-types the predictor contract, so callers — policies, env loops,
+  a `MicroBatcher` — use it exactly like the predictor it fronts.
+  """
+
+  def __init__(self, predictor=None,
+               max_batch_size: int = 8,
+               buckets: Optional[Sequence[int]] = None,
+               name: str = "serve/engine"):
+    if predictor is None:
+      raise ValueError("predictor is required.")
+    self._predictor = predictor
+    if buckets is not None:
+      buckets = sorted(set(int(b) for b in buckets))
+      if not buckets or buckets[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets}")
+      max_batch_size = buckets[-1]
+    else:
+      buckets = bucket_ladder(max_batch_size)
+    self._buckets = buckets
+    self._max_batch_size = max_batch_size
+    self._name = name
+    self._compiled: Dict[int, Callable] = {}
+    self._records: Dict[int, Dict[str, Any]] = {}
+    self._bundle = None
+    self._lock = threading.Lock()
+
+  # -- warmup ---------------------------------------------------------------
+
+  @property
+  def buckets(self) -> List[int]:
+    return list(self._buckets)
+
+  @property
+  def compile_count(self) -> int:
+    return len(self._compiled)
+
+  @property
+  def compile_records(self) -> List[Dict[str, Any]]:
+    """Per-bucket xray records (compile time, flops, roofline, ...)."""
+    return [dict(self._records[b]) for b in self._buckets
+            if b in self._records]
+
+  def warmup(self) -> "BucketedEngine":
+    """Eagerly AOT-compiles every bucket through graftscope-xray.
+
+    Synthesizes a wire-layout batch per bucket from the predictor's
+    feature spec, runs it through the SAME host preprocess the live path
+    uses (so the compiled pytree structure/dtypes match real traffic
+    exactly), and caches the compiled executable. Idempotent; called
+    again after a predictor `restore()` it is a no-op (shapes are stable
+    across restores — only param values change, and the engine reads
+    state through the bundle's getter at every dispatch).
+    """
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.obs import xray as obs_xray
+
+    with self._lock:
+      bundle = self._bundle = self._predictor.serving_bundle()
+      for bucket in self._buckets:
+        if bucket in self._compiled:
+          continue
+        wire = specs_lib.make_random_numpy(bundle.feature_spec,
+                                           batch_size=bucket, seed=0)
+        features = bundle.preprocess(wire)
+        start = time.perf_counter()
+        try:
+          compiled, record = obs_xray.analyze_jit(
+              f"{self._name}/bucket{bucket}", bundle.jit_predict,
+              bundle.get_state(), features)
+        except Exception as e:  # noqa: BLE001 - AOT-less backends
+          # No AOT support: dispatch the plain jit once at this shape —
+          # jax's own per-shape cache then serves later calls without
+          # recompiling, preserving the zero-recompile guarantee with
+          # degraded (no cost-analysis) telemetry.
+          bundle.jit_predict(bundle.get_state(), features)
+          compiled = None
+          record = {"name": f"{self._name}/bucket{bucket}",
+                    "compile_s": time.perf_counter() - start,
+                    "error": f"{type(e).__name__}: {e}"}
+        self._compiled[bucket] = compiled
+        self._records[bucket] = record
+        obs_metrics.counter("serve/engine/compiles").inc()
+        obs_metrics.gauge(
+            f"serve/engine/bucket{bucket}/compile_s").set(
+                float(record.get("compile_s") or 0.0))
+    return self
+
+  def _bucket_for(self, rows: int) -> int:
+    for bucket in self._buckets:
+      if bucket >= rows:
+        return bucket
+    raise AssertionError(f"no bucket covers {rows} rows")  # chunked before
+
+  # -- serving --------------------------------------------------------------
+
+  def predict(self, features: Mapping[str, Any]) -> Dict[str, np.ndarray]:
+    """Bucket-padded predict; outputs match unbatched predict row-for-row.
+
+    Oversize requests are served in top-bucket chunks and re-assembled —
+    callers never see the ladder.
+    """
+    if not self._compiled:
+      self.warmup()
+    features = {k: np.asarray(v) for k, v in dict(features).items()}
+    rows = next(iter(features.values())).shape[0]
+    if rows < 1:
+      raise ValueError("request must have at least one row (got 0)")
+    start = time.perf_counter()
+    with obs_trace.span("serve/engine/predict", cat="serve", rows=rows):
+      if rows <= self._max_batch_size:
+        result = self._predict_chunk(features, rows)
+      else:
+        chunks = []
+        chunk_rows = []
+        for offset in range(0, rows, self._max_batch_size):
+          chunk = {k: v[offset:offset + self._max_batch_size]
+                   for k, v in features.items()}
+          chunk_rows.append(next(iter(chunk.values())).shape[0])
+          chunks.append(self._predict_chunk(chunk, chunk_rows[-1]))
+        result = {}
+        for k in chunks[0]:
+          first = np.asarray(chunks[0][k])
+          # Batched outputs (leading dim == that chunk's rows) re-join
+          # across chunks; non-batched ones (scalars / fixed-size
+          # diagnostics) are identical per chunk — keep the first.
+          if first.ndim and first.shape[0] == chunk_rows[0]:
+            result[k] = np.concatenate([c[k] for c in chunks], axis=0)
+          else:
+            result[k] = first
+    obs_metrics.histogram("serve/engine/predict_ms").record(
+        (time.perf_counter() - start) * 1e3)
+    obs_metrics.counter("serve/engine/rows").inc(rows)
+    return result
+
+  def _predict_chunk(self, features: Dict[str, np.ndarray],
+                     rows: int) -> Dict[str, np.ndarray]:
+    bundle = self._bundle
+    bucket = self._bucket_for(rows)
+    # Preprocess the REAL rows only, then pad the model-layout features
+    # up to the bucket — host preprocessing is per-row work on the
+    # serving hot path, and preprocessing pad rows would multiply it by
+    # bucket/rows. Shapes still match the warmup-compiled executable
+    # (warmup preprocesses a full bucket, and preprocess is per-row:
+    # the split-exactness tests pin outputs against unbatched predict).
+    # Only leaves whose leading dim is the batch get padded — the same
+    # shape[0] test the pad-mask below and `batcher._split_outputs` use.
+    model_features = bundle.preprocess(features)
+    if bucket != rows:
+      import jax
+
+      obs_metrics.counter("serve/engine/padded_rows").inc(bucket - rows)
+      model_features = jax.tree_util.tree_map(
+          lambda a: _pad_rows(np.asarray(a), bucket)
+          if getattr(a, "ndim", 0) and np.asarray(a).shape[0] == rows
+          else a, model_features)
+    state = bundle.get_state()
+    compiled = self._compiled.get(bucket)
+    try:
+      if compiled is not None:
+        outputs = compiled(state, model_features)
+      else:
+        outputs = bundle.jit_predict(state, model_features)
+    except Exception:  # noqa: BLE001 - never break serving on the cache
+      # Pre-execution rejection by the frozen executable (off-spec
+      # dtype/layout traffic): degrade THIS call to the plain jit —
+      # correctness first, the recompile it may cost is counted.
+      obs_metrics.counter("serve/engine/exec_fallbacks").inc()
+      outputs = bundle.jit_predict(state, model_features)
+    # The np.asarray fetch is the tunnel barrier (CLAUDE.md:
+    # block_until_ready is not); pad rows are masked out AFTER the
+    # fetch so the device sees only full-bucket shapes. Only outputs
+    # whose leading dim IS the padded batch get sliced — a non-batched
+    # output (a scalar or fixed-size diagnostic) passes through intact,
+    # the same shape[0] test `batcher._split_outputs` applies.
+    out = {}
+    for k, v in dict(outputs).items():
+      v = np.asarray(v)
+      if v.ndim and v.shape[0] == bucket:
+        v = v[:rows]
+      out[k] = v
+    return out
+
+  # -- predictor duck-type passthroughs -------------------------------------
+
+  def get_feature_specification(self):
+    return self._predictor.get_feature_specification()
+
+  def restore(self) -> bool:
+    ok = self._predictor.restore()
+    if ok and self._bundle is not None:
+      # Re-bind the bundle so a model swapped in by restore() (not just
+      # new params) is picked up; cached executables stay valid because
+      # shapes/dtypes are pinned by the spec.
+      self._bundle = self._predictor.serving_bundle()
+    return ok
+
+  @property
+  def global_step(self) -> int:
+    return self._predictor.global_step
+
+  @property
+  def model_version(self) -> int:
+    return self.global_step
+
+  def assert_is_loaded(self) -> None:
+    self._predictor.assert_is_loaded()
+
+  def close(self) -> None:
+    self._predictor.close()

@@ -1,0 +1,104 @@
+"""graftserve load generator: closed-loop concurrency sweeps.
+
+The reference has no serving load harness — its predictors are
+exercised one request at a time from robot control loops
+(/root/reference/predictors/exported_savedmodel_predictor.py:53-359);
+throughput under concurrency was never a measured quantity.
+
+The measurement half of the serving runtime: N client threads issue
+requests back-to-back against a predict callable (closed loop — each
+thread's next request waits for its previous answer, the robot-fleet
+traffic shape), and the result is QPS plus latency percentiles read
+from the `serve/request_ms` histogram the serving stack already feeds.
+Shared by `bench.py --serve` (the `qtopt_serve_qps_cpu_smoke` headline)
+and `bin/run_graftserve.py` (ad-hoc load against a real artifact), so
+the two can never measure different things.
+
+Backend-free at import (numpy + threading + obs only): whether the
+predict callable touches a device is the caller's business.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional
+
+from tensor2robot_tpu.obs import metrics as obs_metrics
+
+__all__ = ["run_load", "latency_percentiles"]
+
+
+def run_load(predict: Callable[[Mapping[str, Any]], Any],
+             make_request: Callable[[int], Mapping[str, Any]],
+             concurrency: int,
+             requests_per_thread: int,
+             deadline_ms: Optional[float] = None) -> Dict[str, Any]:
+  """Closed-loop load: `concurrency` threads x `requests_per_thread`.
+
+  `make_request(i)` builds the i-th request's feature dict (i is unique
+  across threads, so request content can vary). `deadline_ms` is passed
+  through when `predict` accepts it (a `MicroBatcher`); errors —
+  including deliberate sheds — are counted per type, never raised: a
+  load test measures the system's behavior under pressure, shedding
+  included.
+
+  Returns {qps, wall_sec, ok, errors: {type: count}, concurrency}.
+  """
+  if concurrency < 1 or requests_per_thread < 1:
+    raise ValueError("concurrency and requests_per_thread must be >= 1")
+  errors: Dict[str, int] = {}
+  ok = [0] * concurrency
+  lock = threading.Lock()
+  start_barrier = threading.Barrier(concurrency + 1)
+
+  def client(tid: int) -> None:
+    start_barrier.wait()
+    for i in range(requests_per_thread):
+      request = make_request(tid * requests_per_thread + i)
+      try:
+        if deadline_ms is not None:
+          predict(request, deadline_ms=deadline_ms)
+        else:
+          predict(request)
+        ok[tid] += 1
+      except Exception as e:  # noqa: BLE001 - shed/deadline are outcomes
+        with lock:
+          key = type(e).__name__
+          errors[key] = errors.get(key, 0) + 1
+
+  threads = [threading.Thread(target=client, args=(tid,), daemon=True,
+                              name=f"loadgen-{tid}")
+             for tid in range(concurrency)]
+  for thread in threads:
+    thread.start()
+  start_barrier.wait()
+  t0 = time.perf_counter()
+  for thread in threads:
+    thread.join()
+  wall = time.perf_counter() - t0
+  total_ok = sum(ok)
+  return {
+      "concurrency": concurrency,
+      "requests": concurrency * requests_per_thread,
+      "ok": total_ok,
+      "errors": errors,
+      "wall_sec": wall,
+      "qps": total_ok / wall if wall > 0 else 0.0,
+  }
+
+
+def latency_percentiles(histogram_name: str = "serve/request_ms"
+                        ) -> Dict[str, float]:
+  """p50/p95/p99 (+ mean/count) of a serve latency histogram, read from
+  the process-wide registry the serving stack records into."""
+  hist = obs_metrics.histogram(histogram_name)
+  if not hist.count:
+    return {}
+  return {
+      "p50": hist.percentile(50.0),
+      "p95": hist.percentile(95.0),
+      "p99": hist.percentile(99.0),
+      "mean": hist.mean,
+      "count": float(hist.count),
+  }

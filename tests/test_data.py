@@ -464,6 +464,29 @@ class TestInputGenerators(_SpecsProviderMixin):
     total = sum(len(np.asarray(b["features/x"])) for b in pipe)
     assert total == 24  # empty source contributes nothing, no hang
 
+  def test_weighted_does_not_mutate_template_source(self, tmp_path):
+    """ISSUE 5 satellite: __iter__ used to overwrite the template
+    source's `_num_parallel_parses` in place — a second iteration (or a
+    caller sharing the source) saw the weighted pipeline's value instead
+    of the source's own."""
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    feature_spec, label_spec, groups = self._weighted_groups(tmp_path)
+    parse_fn = parsing.create_parse_fn(feature_spec, label_spec)
+    pipe = pipeline_lib.WeightedRecordPipeline(
+        groups, [0.5, 0.5], parse_fn, batch_size=4, mode="eval",
+        seed=7, drop_remainder=False, num_parallel_parses=5)
+    template = pipe._sources[0]
+    before = template._num_parallel_parses
+    assert before != 5  # the template keeps its own default
+    first = [np.asarray(b["features/x"]) for b in pipe]
+    assert template._num_parallel_parses == before
+    # And iterating again yields the identical deterministic pass.
+    second = [np.asarray(b["features/x"]) for b in pipe]
+    assert len(first) == len(second) and len(first) > 0
+    for a, b in zip(first, second):
+      np.testing.assert_array_equal(a, b)
+
 
 class TestExtractedAndMultiDatasetTraining:
 

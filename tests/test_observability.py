@@ -223,6 +223,37 @@ class TestMetrics:
     metrics_lib.reset()
     assert metrics_lib.snapshot() == {}
 
+  def test_record_many_identical_to_sequential_records(self):
+    """The hot-path amortization primitive (one lock per block, ISSUE 5
+    telemetry-overhead satellite) must be statistically INVISIBLE:
+    count/mean/min/max and the reservoir RNG stream match a per-value
+    `record` sequence exactly, including past the reservoir bound."""
+    rng = np.random.RandomState(3)
+    values = rng.lognormal(0.0, 2.0, 5000).tolist()
+    one_by_one = metrics_lib.Histogram("h", reservoir_size=256)
+    blocked = metrics_lib.Histogram("h", reservoir_size=256)
+    for v in values:
+      one_by_one.record(v)
+    for start in range(0, len(values), 64):
+      blocked.record_many(values[start:start + 64])
+    assert one_by_one.stats() == blocked.stats()
+    assert one_by_one._sample == blocked._sample
+
+  def test_prefetch_flushes_exact_totals_at_stream_end(self):
+    """data/pipeline.prefetch buffers wait observations in blocks; the
+    end-of-stream flush must keep counter/histogram totals exact for
+    ANY item count (a partial last block must not be dropped)."""
+    from tensor2robot_tpu.data import pipeline as pipeline_lib
+
+    for n in (0, 1, 63, 64, 65, 200):
+      with metrics_lib.isolated() as registry:
+        assert list(pipeline_lib.prefetch(iter(range(n)), size=4)) \
+            == list(range(n))
+        snap = registry.snapshot()
+      assert snap.get("counter/data/batches", 0.0) == float(n)
+      if n:
+        assert snap["hist/data/prefetch_wait_ms/count"] == float(n)
+
 
 # ---------------------------------------------------------------------------
 # Hardened SummaryWriter.
