@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Data-plane bench + regression gate.
+#
+# Runs `bench.py --data` (the qtopt_parse_ex_per_sec_cpu_smoke headline
+# — see PERFORMANCE.md "Reading a data bench"), then diffs the new
+# runs.jsonl record against the PREVIOUS data-bench record with
+# `graftscope diff` so a staging-throughput regression exits non-zero
+# exactly like a training one. Train/serve records interleave in the
+# same runs.jsonl; the index lookup below selects data records only.
+#
+# Usage: scripts/data_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+JAX_PLATFORMS=cpu python bench.py --data
+
+# Indices of the last two parse_ex records (empty when this was the
+# first data run — nothing to diff yet). The lookup runs OUTSIDE a
+# process substitution so a failure (unreadable runs.jsonl, broken
+# import) fails the script loudly instead of reading as "no baseline"
+# and silently skipping the gate.
+IDX_OUT=$(JAX_PLATFORMS=cpu python - "$RUNS" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if "parse_ex" in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+) || { echo "data_bench: runs.jsonl index lookup failed" >&2; exit 1; }
+IDX=()
+[ -n "$IDX_OUT" ] && mapfile -t IDX <<< "$IDX_OUT"
+
+if [ "${#IDX[@]}" -lt 2 ]; then
+  echo "data_bench: first data record in $RUNS; no diff baseline yet" >&2
+  exit 0
+fi
+
+JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+    "$RUNS#${IDX[0]}" "$RUNS#${IDX[1]}"

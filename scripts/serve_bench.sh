@@ -17,8 +17,10 @@ RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
 JAX_PLATFORMS=cpu python bench.py --serve "${1:-150}"
 
 # Indices of the last two qtopt_serve_qps records (empty when this was
-# the first serve run — nothing to diff yet).
-mapfile -t IDX < <(JAX_PLATFORMS=cpu python - "$RUNS" <<'EOF'
+# the first serve run — nothing to diff yet). Lookup outside a process
+# substitution so a failure exits loudly instead of silently skipping
+# the gate (same hardening as scripts/data_bench.sh).
+IDX_OUT=$(JAX_PLATFORMS=cpu python - "$RUNS" <<'EOF'
 import sys
 from tensor2robot_tpu.obs import runlog
 records = runlog.load_records(sys.argv[1])
@@ -27,7 +29,9 @@ serve = [i for i, r in enumerate(records)
 for i in serve[-2:]:
     print(i)
 EOF
-)
+) || { echo "serve_bench: runs.jsonl index lookup failed" >&2; exit 1; }
+IDX=()
+[ -n "$IDX_OUT" ] && mapfile -t IDX <<< "$IDX_OUT"
 
 if [ "${#IDX[@]}" -lt 2 ]; then
   echo "serve_bench: first serve record in $RUNS; no diff baseline yet" >&2

@@ -27,7 +27,8 @@ import os
 import sys
 from typing import List, Tuple
 
-from tensor2robot_tpu.analysis import config_check, spec_check, tracer_check
+from tensor2robot_tpu.analysis import (config_check, native_check,
+                                       spec_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -57,6 +58,12 @@ tracer rules (.py):
                          dispatch without a host-fetch barrier (measures
                          dispatch, not execution, over the tunnel);
                          obs/ and utils/backend.py are exempt
+
+native rules (native/__init__.py ↔ native/*.cc):
+  native-binding-missing a .cc source exports a `t2r_*` symbol the
+                         ctypes wrapper never references
+  native-binding-unknown the wrapper references a `t2r_*` name no .cc
+                         source defines
 
 spec rules (.py):
   unknown-mesh-axis      TensorSpec.sharding names an undeclared axis
@@ -106,6 +113,13 @@ def run(paths: List[str]) -> List[Finding]:
   for path in py_files:
     findings.extend(tracer_check.check_python_file(path))
     findings.extend(spec_check.check_python_file(path, mesh_axes))
+    # A native-package wrapper pulls in the export/binding coverage
+    # check for its whole directory (.cc sources aren't walked
+    # directly — the wrapper is the unit whose drift matters).
+    if (os.path.basename(path) == "__init__.py"
+        and os.path.basename(os.path.dirname(path)) == "native"):
+      findings.extend(native_check.check_native_bindings(
+          os.path.dirname(path)))
   return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
 
 

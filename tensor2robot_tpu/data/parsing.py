@@ -352,7 +352,15 @@ class ParseFn:
     """Fast path: columnar native parse producing full batch arrays."""
     parser = self._native_parsers[dkey]
     plans = self._plans[dkey]
-    parsed = parser.parse(list(serialized_list))
+    if hasattr(serialized_list, "arena"):
+      # Staged arena batch (data/stager.py): the parser reads straight
+      # out of the contiguous arena — no per-record bytes objects on
+      # the whole records->parsed-batch path.
+      parsed = parser.parse_arena(serialized_list.arena,
+                                  serialized_list.offsets,
+                                  serialized_list.lengths)
+    else:
+      parsed = parser.parse(list(serialized_list))
     batch = len(serialized_list)
     out: Dict[str, np.ndarray] = {}
     for i, plan in enumerate(plans):
@@ -459,7 +467,13 @@ class ParseFn:
                   records: Union[Sequence[bytes],
                                  Mapping[str, Sequence[bytes]]]
                   ) -> specs_lib.SpecStruct:
-    """Parses a batch; returns `features/...` + `labels/...` SpecStruct."""
+    """Parses a batch; returns `features/...` + `labels/...` SpecStruct.
+
+    `records` (or any mapping value) may be a sequence of serialized
+    records OR a `data.stager.StagedBatch` arena — the native columnar
+    parser then reads records in place (`parse_arena`); fallback paths
+    materialize per-record bytes first.
+    """
     if not isinstance(records, Mapping):
       if len(self._dataset_keys) > 1:
         raise ValueError(
@@ -528,6 +542,11 @@ class ParseFn:
                 "(streak %d, total %d).", dkey, detail, streak, total)
       plans = self._plans[dkey]
       is_sequence = self._sequence_datasets[dkey]
+      if hasattr(serialized_list, "records"):
+        # Python path over a staged arena batch (no native parser for
+        # these specs, or a format-mismatch fallback): materialize the
+        # per-record bytes the proto walk below needs.
+        serialized_list = serialized_list.records()
       for serialized in serialized_list:
         if is_sequence:
           message = example_pb2.SequenceExample.FromString(serialized)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import glob as glob_lib
 import itertools
+import logging
 import queue
 import random
 import threading
@@ -30,6 +31,7 @@ import numpy as np
 
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import parsing, tfrecord
+from tensor2robot_tpu.data import stager as stager_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
@@ -55,6 +57,23 @@ def resolve_file_patterns(
   (/root/reference/utils/tfdata.py:92-138) with JAX multi-process sharding
   in place of per-host TPUEstimator input invocation.
   """
+  files, _ = _resolve_file_patterns_sharded(file_patterns, process_index,
+                                            process_count)
+  return files
+
+
+def _resolve_file_patterns_sharded(
+    file_patterns: Union[str, Sequence[str]],
+    process_index: int = 0,
+    process_count: int = 1) -> Tuple[List[str], bool]:
+  """`resolve_file_patterns` plus a shared-files flag.
+
+  Returns (files, shared): `shared` is True on the fewer-files-than-
+  hosts path, where every host reads the SAME full file list —
+  `RecordBatchPipeline` then offsets its epoch shuffle seed by
+  `process_index` so co-hosted processes don't train on identical
+  record orders (correctness preserved, determinism traded for
+  progress)."""
   if isinstance(file_patterns, str):
     file_patterns = file_patterns.split(",")
   files: List[str] = []
@@ -66,12 +85,13 @@ def resolve_file_patterns(
     if not matched:
       raise ValueError(f"File pattern {pattern!r} matched no files.")
     files.extend(matched)
+  shared = False
   if process_count > 1:
     if len(files) >= process_count:
       files = files[process_index::process_count]
-    # Fewer files than hosts: every host reads everything but offsets its
-    # shuffle seed; correctness preserved, determinism traded for progress.
-  return files
+    else:
+      shared = True
+  return files, shared
 
 
 def interleave_records(files: Sequence[str],
@@ -100,7 +120,14 @@ def interleave_records(files: Sequence[str],
 
 def shuffled(stream: Iterator[Any], buffer_size: int,
              seed: Optional[int] = None) -> Iterator[Any]:
-  """Reservoir-style shuffle buffer (tf.data.Dataset.shuffle semantics)."""
+  """Reservoir-style shuffle buffer (tf.data.Dataset.shuffle semantics).
+
+  `buffer_size` <= 0 is a pass-through (tf.data treats shuffle(0)/(1) as
+  no-ops) — without the guard the first post-fill item would hit
+  `rng.randrange(0)` and raise ValueError."""
+  if buffer_size <= 0:
+    yield from stream
+    return
   rng = random.Random(seed)
   buffer: List[Any] = []
   for item in stream:
@@ -230,6 +257,15 @@ class RecordBatchPipeline:
   mixture sampling across dataset groups (reference
   `WeightedRecordInputGenerator`,
   /root/reference/input_generators/default_input_generator.py:228-314).
+
+  Staging plane: with the native toolchain present, the single-dataset
+  records->batch path runs on the C++ `BatchStager` (`data/stager.py`:
+  GIL-free interleave + shuffle + batch assembly, whole batches handed
+  over as one arena) and the pure-Python generator chain stays as the
+  no-toolchain fallback — `use_native_stager` (None = auto) forces
+  either side, which the parity tests use. Multi-dataset zip keeps the
+  per-record Python zip but streams each dataset's records through the
+  native plane in record mode.
   """
 
   def __init__(self,
@@ -247,7 +283,8 @@ class RecordBatchPipeline:
                prefetch_size: int = 2,
                num_parallel_parses: int = 2,
                process_index: int = 0,
-               process_count: int = 1):
+               process_count: int = 1,
+               use_native_stager: Optional[bool] = None):
     self._parse_fn = parse_fn
     self._batch_size = batch_size
     self._mode = mode
@@ -261,19 +298,32 @@ class RecordBatchPipeline:
     self._mixture_weights = mixture_weights
     self._prefetch_size = prefetch_size
     self._num_parallel_parses = num_parallel_parses
+    self._use_native_stager = use_native_stager
+    self._warned_stager_unavailable = False
     dataset_keys = parse_fn.dataset_keys
     if isinstance(file_patterns, Mapping):
-      self._files = {
-          k: resolve_file_patterns(v, process_index, process_count)
+      resolved = {
+          k: _resolve_file_patterns_sharded(v, process_index, process_count)
           for k, v in file_patterns.items()}
     else:
       if len(dataset_keys) > 1:
         raise ValueError(
             f"Specs use dataset keys {dataset_keys}; pass a mapping of "
             "dataset_key -> file patterns.")
-      self._files = {
-          dataset_keys[0]: resolve_file_patterns(
+      resolved = {
+          dataset_keys[0]: _resolve_file_patterns_sharded(
               file_patterns, process_index, process_count)}
+    self._files = {k: files for k, (files, _) in resolved.items()}
+    # Fewer files than hosts: every co-hosted process reads the SAME
+    # file list, so each offsets its epoch shuffle seed by its
+    # process_index (one offset pipeline-wide — multi-dataset zip
+    # streams must keep using one common seed or their file orders
+    # de-align). Sharded hosts keep offset 0: their record orders
+    # already differ by construction, and the round-1..5 seed behavior
+    # is preserved.
+    self._host_seed_offset = (
+        process_index * 1_000_003
+        if any(shared for _, shared in resolved.values()) else 0)
     unknown = set(self._files) - set(dataset_keys)
     if unknown:
       raise ValueError(
@@ -284,6 +334,47 @@ class RecordBatchPipeline:
   def batch_size(self) -> int:
     return self._batch_size
 
+  def _stager_enabled(self) -> bool:
+    if self._use_native_stager is not None:
+      if self._use_native_stager and not stager_lib.stager_available():
+        # Loud once per pipeline: an explicit force of the native plane
+        # that cannot be honored is a deployment misconfiguration (no
+        # toolchain / broken build), and the ~2x-slower Python chain
+        # would otherwise engage with no signal beyond absent data/*
+        # telemetry. Auto mode (None) stays a silent fallback by design.
+        if not self._warned_stager_unavailable:
+          self._warned_stager_unavailable = True
+          logging.warning(
+              "use_native_stager=True but the native toolchain is "
+              "unavailable; falling back to the pure-Python record "
+              "chain (expect ~2x lower host staging throughput).")
+        return False
+      return self._use_native_stager
+    return stager_lib.stager_available()
+
+  def _epoch_seed(self, epoch: int) -> Optional[int]:
+    return (None if self._seed is None
+            else self._seed + epoch + self._host_seed_offset)
+
+  def _epoch_files(self, files: Sequence[str],
+                   epoch_seed: Optional[int]) -> List[str]:
+    """Final per-epoch file order: train mode shuffles in Python with
+    the epoch seed on BOTH staging planes, so native/Python file order
+    is identical (`interleave_records` shuffle_files parity)."""
+    files = list(files)
+    if self._train:
+      random.Random(epoch_seed).shuffle(files)
+    return files
+
+  def _interleave(self, files: Sequence[str],
+                  epoch_seed: Optional[int]) -> Iterator[bytes]:
+    """Per-dataset record stream: native record-mode staging when the
+    toolchain is present, the Python generator chain otherwise."""
+    files = self._epoch_files(files, epoch_seed)
+    if self._stager_enabled() and files:
+      return stager_lib.iter_staged_records(files, self._cycle_length)
+    return interleave_records(files, self._cycle_length)
+
   def _record_tuples(self, epoch_seed: Optional[int]
                      ) -> Iterator[Dict[str, bytes]]:
     """Yields aligned {dataset_key: record} tuples for one pass."""
@@ -292,10 +383,8 @@ class RecordBatchPipeline:
       # mixture source; all specs must share one dataset_key in this mode.
       raise NotImplementedError(
           "mixture_weights are handled by WeightedRecordPipeline.")
-    streams = {
-        k: interleave_records(files, self._cycle_length,
-                              shuffle_files=self._train, seed=epoch_seed)
-        for k, files in self._files.items()}
+    streams = {k: self._interleave(files, epoch_seed)
+               for k, files in self._files.items()}
     keys = list(streams)
     while True:
       item = {}
@@ -306,19 +395,34 @@ class RecordBatchPipeline:
         return
       yield item
 
-  def _raw_batches(self) -> Iterator[List[Dict[str, bytes]]]:
+  def _raw_batches(self) -> Iterator[Any]:
+    """Raw record batches: `List[{dataset_key: record}]` on the Python
+    chain, `stager.StagedBatch` arenas on the native plane (single
+    dataset only — the zip path must align records across keys one at a
+    time). `_parse_only` consumes either shape."""
+    single_key = (len(self._files) == 1 and self._mixture_weights is None)
     epoch = 0
     while True:
-      epoch_seed = None if self._seed is None else self._seed + epoch
-      stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
-      if self._shuffle_buffer_size:
-        stream = shuffled(stream, self._shuffle_buffer_size, epoch_seed)
-      yield from _batched(stream, self._batch_size, self._drop_remainder)
+      epoch_seed = self._epoch_seed(epoch)
+      files = next(iter(self._files.values())) if single_key else None
+      if files and self._stager_enabled():
+        yield from stager_lib.stage_batches(
+            self._epoch_files(files, epoch_seed),
+            batch_size=self._batch_size,
+            cycle_length=self._cycle_length,
+            shuffle_buffer=self._shuffle_buffer_size,
+            seed=epoch_seed,
+            drop_remainder=self._drop_remainder)
+      else:
+        stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
+        if self._shuffle_buffer_size:
+          stream = shuffled(stream, self._shuffle_buffer_size, epoch_seed)
+        yield from _batched(stream, self._batch_size, self._drop_remainder)
       if not self._repeat:
         return
       epoch += 1
 
-  def _assemble(self, raw: Iterator[List[Dict[str, bytes]]],
+  def _assemble(self, raw: Iterator[Any],
                 prefetch_size: Optional[int] = None,
                 num_parallel_parses: Optional[int] = None
                 ) -> Iterator[specs_lib.SpecStruct]:
@@ -346,8 +450,16 @@ class RecordBatchPipeline:
   def _batches(self) -> Iterator[specs_lib.SpecStruct]:
     return self._assemble(self._raw_batches(), prefetch_size=0)
 
-  def _parse_only(self, batch: List[Dict[str, bytes]]
-                  ) -> specs_lib.SpecStruct:
+  def _parse_only(self, batch: Any) -> specs_lib.SpecStruct:
+    if isinstance(batch, stager_lib.StagedBatch):
+      # Arena batch from the native staging plane: hand it through
+      # whole — the native parser reads records in place (parse_arena),
+      # fallback paths materialize bytes themselves. Keyed by the
+      # pipeline's OWN single files key, not dataset_keys[0]: specs may
+      # declare several keys while this pipeline feeds just one of
+      # them, and the Python chain parses under that same key.
+      return self._parse_fn.parse_batch(
+          {next(iter(self._files)): batch})
     records = {k: [item[k] for item in batch] for k in batch[0]}
     return self._parse_fn.parse_batch(records)
 
@@ -427,9 +539,16 @@ class WeightedRecordPipeline:
     self._parse_fn = parse_fn
 
   def _source_iter(self, idx: int, epoch: int) -> Iterator[Dict[str, bytes]]:
+    # The source's _host_seed_offset rides along, mirroring
+    # RecordBatchPipeline._epoch_seed: on the shared-files path (fewer
+    # files than hosts) co-hosted processes must not read identical
+    # record orders, and this path drives the source's _record_tuples
+    # directly, bypassing its own _epoch_seed.
+    source = self._sources[idx]
     seed = (None if self._seed is None
-            else self._seed + 7919 * idx + 104_729 * epoch)
-    stream = self._sources[idx]._record_tuples(seed)
+            else self._seed + 7919 * idx + 104_729 * epoch
+            + source._host_seed_offset)
+    stream = source._record_tuples(seed)
     if self._shuffle_buffer_size:
       stream = shuffled(stream, self._shuffle_buffer_size, seed)
     return iter(stream)

@@ -1,0 +1,169 @@
+"""Native batched record staging: the host data plane's fast path.
+
+Python-side seam over the C++ `BatchStager` (`native/batch_stager.cc`):
+file interleave, reservoir shuffle and batch assembly run on GIL-released
+worker threads, and Python receives ONE contiguous arena (+ offsets/
+lengths) per batch instead of paying a Python frame per record through
+the `interleave_records -> shuffled -> _batched` generator chain. The
+arena feeds `BatchExampleParser.parse_arena` directly, so the whole
+records->parsed-batch path costs a handful of ctypes calls per batch.
+
+Semantics are pinned against the pure-Python chain by
+tests/test_stager.py: identical interleave order (eval mode is
+byte-identical end to end), same shuffle distribution and per-seed
+determinism in train mode, `_batched` drop_remainder behavior, and
+IOError on corruption — `data/pipeline.py` keeps the Python chain as
+the no-toolchain fallback.
+
+graftscope telemetry (flows into runs.jsonl via the standard registry
+snapshot, gated by `graftscope diff` like any other metric):
+  data/stage_ms            consumer wait per staged batch (high = the
+                           C++ plane can't keep up; the inverse of
+                           data/prefetch_wait_ms one stage downstream)
+  data/arena_bytes         payload bytes per staged batch
+  data/stager_queue_depth  staged batches waiting in the C++ queue
+                           (0 in steady state = Python is the slower
+                           side; == queue_depth = staging is)
+  data/staged_batches      batches handed to Python
+
+Reference path shape: /root/reference/utils/tfdata.py:174-210 (parallel
+interleave) and :629-689 (shuffle/batch options).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu import native
+from tensor2robot_tpu.obs import metrics as obs_metrics
+
+__all__ = ["StagedBatch", "stager_available", "stage_batches",
+           "iter_staged_records"]
+
+# Record-mode streaming (`iter_staged_records`) chunking: up to
+# _RECORD_CHUNK records per staged chunk (amortizes the per-chunk Python
+# cost on small records) but never much past _RECORD_CHUNK_BYTES of
+# payload — the byte cap also bounds the C++ reader queues, so host RSS
+# stays ~O(cycle_length + queue_depth) chunks even on multi-MB episode
+# records (a count-only bound buffered GiBs there; the Python chain it
+# replaces buffered ~one record per active file).
+_RECORD_CHUNK = 256
+_RECORD_CHUNK_BYTES = 8 << 20  # 8 MiB
+
+
+class StagedBatch:
+  """One staged batch: contiguous payload arena + per-record offsets.
+
+  `arena` is a uint8 numpy array owned by Python (one memcpy out of the
+  native buffer); `offsets`/`lengths` are int64 arrays indexing into
+  it. `records()` materializes per-record bytes for consumers that need
+  them (the Python parse fallback); the fast path hands the arrays to
+  `BatchExampleParser.parse_arena` untouched.
+  """
+
+  __slots__ = ("arena", "offsets", "lengths")
+
+  def __init__(self, arena: np.ndarray, offsets: np.ndarray,
+               lengths: np.ndarray):
+    self.arena = arena
+    self.offsets = offsets
+    self.lengths = lengths
+
+  def __len__(self) -> int:
+    return len(self.offsets)
+
+  def records(self) -> List[bytes]:
+    view = memoryview(self.arena)
+    return [bytes(view[o:o + n]) for o, n in
+            zip(self.offsets.tolist(), self.lengths.tolist())]
+
+
+def stager_available() -> bool:
+  """True when the native staging plane can be used (toolchain built)."""
+  return native.available()
+
+
+def stage_batches(files: Sequence[str],
+                  batch_size: int,
+                  cycle_length: int = 4,
+                  shuffle_buffer: int = 0,
+                  seed: Optional[int] = None,
+                  drop_remainder: bool = True,
+                  verify_crc: bool = False,
+                  queue_depth: int = 2,
+                  max_chunk_bytes: int = 0,
+                  telemetry: bool = True) -> Iterator[StagedBatch]:
+  """Streams `StagedBatch`es for ONE pass over `files` (final order —
+  per-epoch file shuffling stays in the caller, keeping train-mode file
+  order identical to the Python chain's). Raises IOError on corruption.
+
+  `seed` drives the C++ reservoir shuffle (std::mt19937_64): same
+  distribution as `pipeline.shuffled` and deterministic per seed, not
+  the identical permutation. None seeds from the clock (train-mode
+  parity with `shuffled(seed=None)`); shuffle_buffer 0 bypasses the
+  shuffle entirely, so eval mode is byte-identical to the Python chain.
+
+  `telemetry=False` skips the `data/*` metrics: the documented unit of
+  those gauges is PIPELINE batches, so internal consumers staging
+  implementation-detail chunks (`iter_staged_records`) must not feed
+  them — mixed units would turn a zip-vs-single-dataset `graftscope
+  diff` into phantom regressions.
+
+  `max_chunk_bytes` > 0 byte-bounds staging (reader queues + EARLY batch
+  flush at that arena size). Record-mode only: an early flush would
+  break exact `batch_size` semantics, so pipeline batch staging must
+  leave it 0.
+  """
+  if seed is None:
+    seed = time.time_ns() & (2**63 - 1)
+  if telemetry:
+    stage_hist = obs_metrics.histogram("data/stage_ms")
+    arena_hist = obs_metrics.histogram("data/arena_bytes")
+    depth_gauge = obs_metrics.gauge("data/stager_queue_depth")
+    batch_counter = obs_metrics.counter("data/staged_batches")
+  perf_counter_ns = time.perf_counter_ns
+  with native.RecordStager(list(files), batch_size=batch_size,
+                           cycle_length=cycle_length,
+                           shuffle_buffer=shuffle_buffer, seed=seed,
+                           drop_remainder=drop_remainder,
+                           verify_crc=verify_crc,
+                           queue_depth=queue_depth,
+                           max_chunk_bytes=max_chunk_bytes) as stager:
+    while True:
+      t0 = perf_counter_ns()
+      out = stager.next_batch()
+      if telemetry:
+        stage_hist.record((perf_counter_ns() - t0) * 1e-6)
+      if out is None:
+        return
+      arena, offsets, lengths = out
+      if telemetry:
+        arena_hist.record(float(arena.nbytes))
+        depth_gauge.set(float(stager.queue_depth()))
+        batch_counter.inc()
+      yield StagedBatch(arena, offsets, lengths)
+
+
+def iter_staged_records(files: Sequence[str],
+                        cycle_length: int = 4,
+                        verify_crc: bool = False,
+                        chunk_records: int = _RECORD_CHUNK,
+                        chunk_bytes: int = _RECORD_CHUNK_BYTES
+                        ) -> Iterator[bytes]:
+  """Record-mode streaming through the native plane (no shuffle/batch):
+  byte-identical to `pipeline.interleave_records` over the same file
+  order, but with the file IO, CRC and interleave running GIL-free.
+  Used by consumers that must stay per-record (the weighted-mixture
+  sampler, multi-dataset zip). Chunk boundaries are an implementation
+  detail (`chunk_bytes` caps buffered payload regardless of record
+  size); the flattened record stream is invariant to them."""
+  for batch in stage_batches(files, batch_size=chunk_records,
+                             cycle_length=cycle_length, shuffle_buffer=0,
+                             seed=0, drop_remainder=False,
+                             verify_crc=verify_crc,
+                             max_chunk_bytes=chunk_bytes,
+                             telemetry=False):
+    yield from batch.records()

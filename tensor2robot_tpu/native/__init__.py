@@ -1,9 +1,12 @@
 """Native (C++) runtime components, loaded via ctypes.
 
 `tfrecord_io.cc` provides the fast host-side TFRecord reader and CRC32C
-used by the data layer. The shared library is built on first import with
-g++ (cached next to the source); every caller has a pure-Python fallback,
-so environments without a toolchain still work.
+used by the data layer; `batch_stager.cc` the GIL-free batched record
+staging plane (interleave + shuffle + batch assembly on worker threads);
+`example_parser.cc` the columnar Example parser. The shared library is
+built on first import with g++ (cached next to the source); every caller
+has a pure-Python fallback, so environments without a toolchain still
+work.
 """
 
 from __future__ import annotations
@@ -16,8 +19,10 @@ from typing import Iterator, List, Optional
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [os.path.join(_DIR, "tfrecord_io.cc"),
-            os.path.join(_DIR, "example_parser.cc")]
+            os.path.join(_DIR, "example_parser.cc"),
+            os.path.join(_DIR, "batch_stager.cc")]
 _JPEG_SOURCE = os.path.join(_DIR, "jpeg_decode.cc")
+_HEADERS = [os.path.join(_DIR, "record_framing.h")]
 _LIB_PATH = os.path.join(_DIR, "libt2r_native.so")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
@@ -27,12 +32,13 @@ _LOAD_FAILED = False
 def _build() -> bool:
   # Preferred build includes the libjpeg-backed batch decoder; if the
   # toolchain lacks jpeglib.h / -ljpeg, fall back to building without it
-  # (the reader/parser fast paths must not depend on libjpeg).
+  # (the reader/parser/stager fast paths must not depend on libjpeg).
+  # -lpthread in BOTH attempts: the stager spawns std::threads.
   base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17"]
   attempts = [
       base + [*_SOURCES, _JPEG_SOURCE, "-o", _LIB_PATH, "-ljpeg",
               "-lpthread"],
-      base + [*_SOURCES, "-o", _LIB_PATH],
+      base + [*_SOURCES, "-o", _LIB_PATH, "-lpthread"],
   ]
   for cmd in attempts:
     try:
@@ -52,7 +58,7 @@ def load() -> Optional[ctypes.CDLL]:
       return _LIB
     if not os.path.isfile(_LIB_PATH) or any(
         os.path.getmtime(_LIB_PATH) < os.path.getmtime(src)
-        for src in [*_SOURCES, _JPEG_SOURCE]):
+        for src in [*_SOURCES, _JPEG_SOURCE, *_HEADERS]):
       if not _build():
         _LOAD_FAILED = True
         return None
@@ -101,6 +107,33 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_uint8)]
+    lib.t2r_parser_gather_plane.restype = ctypes.c_int
+    lib.t2r_parser_gather_plane.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_uint8)]
+    lib.t2r_stager_open.restype = ctypes.c_void_p
+    lib.t2r_stager_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int64, ctypes.c_int64]
+    lib.t2r_stager_next_batch.restype = ctypes.c_void_p
+    lib.t2r_stager_next_batch.argtypes = [ctypes.c_void_p]
+    lib.t2r_stager_error.restype = ctypes.c_char_p
+    lib.t2r_stager_error.argtypes = [ctypes.c_void_p]
+    lib.t2r_stager_queue_depth.restype = ctypes.c_int64
+    lib.t2r_stager_queue_depth.argtypes = [ctypes.c_void_p]
+    lib.t2r_stager_close.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_count.restype = ctypes.c_int64
+    lib.t2r_staged_count.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_data.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.t2r_staged_data.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_offsets.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_staged_offsets.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_lengths.restype = ctypes.POINTER(ctypes.c_int64)
+    lib.t2r_staged_lengths.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_arena_bytes.restype = ctypes.c_int64
+    lib.t2r_staged_arena_bytes.argtypes = [ctypes.c_void_p]
+    lib.t2r_staged_free.argtypes = [ctypes.c_void_p]
     if hasattr(lib, "t2r_decode_jpeg_batch"):  # libjpeg build variant
       lib.t2r_decode_jpeg_batch.restype = ctypes.c_int
       lib.t2r_decode_jpeg_batch.argtypes = [
@@ -177,6 +210,97 @@ def decode_jpeg_batch(datas, height: int, width: int, channels: int,
   return out if status == 0 else None
 
 
+class RecordStager:
+  """Low-level handle on the C++ batched record stager (one epoch).
+
+  Staging (file interleave + reservoir shuffle + batch assembly) starts
+  on background C++ threads at construction; `next_batch()` blocks until
+  a batch is staged and returns `(arena, offsets, lengths)` numpy arrays
+  (the arena is copied out of the native buffer in ONE memcpy and owned
+  by Python), or None at end of stream. Corruption/IO failures raise
+  IOError, matching both `iter_records` paths. `close()` (or `with`)
+  stops and JOINS the worker threads — the tunnel-safety discipline of
+  CLAUDE.md applies to any thread owner, and an abandoned stager would
+  leak readers blocked on full queues.
+
+  Telemetry (`data/stage_ms` etc.) lives one level up in
+  `data/stager.py`; this class stays a thin ctypes seam.
+  """
+
+  def __init__(self, paths: List[str], batch_size: int,
+               cycle_length: int = 4, shuffle_buffer: int = 0,
+               seed: int = 0, drop_remainder: bool = True,
+               verify_crc: bool = False, queue_depth: int = 2,
+               max_chunk_bytes: int = 0):
+    # max_chunk_bytes > 0 byte-bounds the C++ reader queues and flushes
+    # batches early at that arena size — record-mode streaming only
+    # (early flush breaks exact batch_size semantics); 0 = off.
+    lib = load()
+    if lib is None:
+      raise RuntimeError("native library unavailable")
+    if not paths:
+      raise ValueError("RecordStager needs at least one file")
+    self._lib = lib
+    encoded = [p.encode() for p in paths]
+    path_array = (ctypes.c_char_p * len(encoded))(*encoded)
+    self._handle = lib.t2r_stager_open(
+        path_array, len(encoded), cycle_length, shuffle_buffer,
+        ctypes.c_uint64(seed & (2**64 - 1)), batch_size,
+        int(drop_remainder), int(verify_crc), queue_depth,
+        max_chunk_bytes)
+    if not self._handle:
+      raise ValueError("invalid stager configuration")
+
+  def next_batch(self):
+    """(arena uint8[bytes], offsets int64[n], lengths int64[n]) or None."""
+    import numpy as np
+
+    lib = self._lib
+    if self._handle is None:
+      return None
+    batch = lib.t2r_stager_next_batch(self._handle)
+    if not batch:
+      error = lib.t2r_stager_error(self._handle).decode()
+      if error:
+        raise IOError(f"Corrupt TFRecord stream: {error}")
+      return None
+    try:
+      n = lib.t2r_staged_count(batch)
+      nbytes = lib.t2r_staged_arena_bytes(batch)
+      arena = np.empty((nbytes,), np.uint8)
+      if nbytes:
+        ctypes.memmove(arena.ctypes.data, lib.t2r_staged_data(batch),
+                       nbytes)
+      offsets = np.ctypeslib.as_array(lib.t2r_staged_offsets(batch),
+                                      (n,)).copy()
+      lengths = np.ctypeslib.as_array(lib.t2r_staged_lengths(batch),
+                                      (n,)).copy()
+      return arena, offsets, lengths
+    finally:
+      lib.t2r_staged_free(batch)
+
+  def queue_depth(self) -> int:
+    """Staged batches waiting for the consumer (0 in steady state means
+    Python consumes faster than the plane stages)."""
+    if self._handle is None:
+      return 0
+    return int(self._lib.t2r_stager_queue_depth(self._handle))
+
+  def close(self) -> None:
+    if getattr(self, "_handle", None):
+      self._lib.t2r_stager_close(self._handle)
+      self._handle = None
+
+  def __enter__(self) -> "RecordStager":
+    return self
+
+  def __exit__(self, *exc) -> None:
+    self.close()
+
+  def __del__(self):
+    self.close()
+
+
 KIND_FLOAT, KIND_INT64, KIND_BYTES = 0, 1, 2
 
 
@@ -191,9 +315,9 @@ class BatchExampleParser:
   For context bytes, `size` > 0 declares a fixed-size raw plane: when
   every record carries exactly one value of that byte length, the batch
   is returned as ONE contiguous [batch, size] uint8 buffer filled by a
-  single memmove per record straight from the parser's slices (the
-  per-record bytes-object path would copy twice); otherwise the entry
-  falls back to the per-record value lists.
+  single `t2r_parser_gather_plane` call straight from the parser's
+  slices (the per-record bytes-object path would copy twice); otherwise
+  the entry falls back to the per-record value lists.
 
   `parse` returns a dict:
     float/int: {plan index: np array [batch, size] or [batch, T, size]},
@@ -252,15 +376,36 @@ class BatchExampleParser:
       self._handle = None
 
   def parse(self, records):
-    with self._parse_lock:
-      return self._parse_locked(records)
-
-  def _parse_locked(self, records):
-    np = self._np
     batch = len(records)
-    n = len(self._plan)
     rec_array = (ctypes.c_char_p * batch)(*records)
     len_array = (ctypes.c_int64 * batch)(*[len(r) for r in records])
+    with self._parse_lock:
+      return self._parse_ptrs(rec_array, len_array, batch)
+
+  def parse_arena(self, arena, offsets, lengths):
+    """Parses records living in one contiguous arena buffer.
+
+    `arena` is a C-contiguous uint8 numpy array; `offsets`/`lengths` are
+    per-record int64 arrays indexing into it (the `t2r_stager_*` batch
+    layout, see `data/stager.py`). No per-record bytes objects are
+    materialized — the parser reads straight out of the arena, so the
+    whole records->parsed-batch path costs a handful of ctypes calls
+    per BATCH. The arena must stay alive for the duration of the call
+    (the returned per-record bytes values are copied out before
+    return).
+    """
+    base = arena.ctypes.data
+    batch = len(offsets)
+    ptr_array = (ctypes.c_void_p * batch)(
+        *[base + o for o in offsets.tolist()])
+    rec_array = ctypes.cast(ptr_array, ctypes.POINTER(ctypes.c_char_p))
+    len_array = (ctypes.c_int64 * batch)(*lengths.tolist())
+    with self._parse_lock:
+      return self._parse_ptrs(rec_array, len_array, batch)
+
+  def _parse_ptrs(self, rec_array, len_array, batch):
+    np = self._np
+    n = len(self._plan)
     float_outs = (ctypes.c_void_p * n)()
     int_outs = (ctypes.c_void_p * n)()
     out = {"float": {}, "int": {}, "bytes": {}, "bytes_planes": {},
@@ -292,20 +437,22 @@ class BatchExampleParser:
           continue
         cap, offset = self._caps[i], self._caps_offset[i]
         if size > 0 and seq_len == 0:
-          # Raw-plane single-copy path: every record has exactly one
-          # value of the declared byte length -> one contiguous buffer,
-          # one memmove per record from the parse slices (still under
-          # the lock, before the next parse invalidates them).
-          contiguous = all(
-              counts[r * self._num_bytes + slot] == 1
-              and lens[r * self._total_caps + offset] == size
-              for r in range(batch))
-          if contiguous:
+          # Raw-plane single-copy path: when every record has exactly
+          # one value of the declared byte length, t2r_parser_gather_
+          # plane memcpys all planes into one contiguous buffer — the
+          # pre-round-6 wrapper paid a Python frame + ctypes.memmove
+          # per record here. A null-dest probe first, so a stream that
+          # never qualifies (status 0 -> per-value path below) does
+          # not allocate a dest per batch. Still under the lock,
+          # before the next parse invalidates the slices.
+          status = self._lib.t2r_parser_gather_plane(
+              self._handle, i, batch, None)
+          if status == 1:
             dest = np.empty((batch, size), np.uint8)
-            base = dest.ctypes.data
-            for r in range(batch):
-              ctypes.memmove(base + r * size,
-                             ptrs[r * self._total_caps + offset], size)
+            status = self._lib.t2r_parser_gather_plane(
+                self._handle, i, batch,
+                dest.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+          if status == 1:
             out["bytes_planes"][i] = dest
             out["bytes"][i] = None
             out["bytes_counts"][i] = np.ones((batch,), np.int64)

@@ -361,6 +361,39 @@ const int64_t* t2r_parser_step_counts(void* handle) {
   return static_cast<Plan*>(handle)->step_counts.data();
 }
 
+// Gathers plan entry `i`'s context-bytes raw plane from the LAST
+// t2r_parser_parse_batch call into one contiguous [batch, size] buffer
+// (`size` = the plan's declared byte size). Returns 1 when every record
+// holds exactly one value of exactly `size` bytes (dest filled), 0 when
+// any record deviates (caller falls back to the per-value path), -1 on
+// a non-bytes/out-of-range entry. A null `dest` is a CHECK-ONLY probe
+// (same return values, nothing copied) — the wrapper probes first so a
+// stream that never qualifies pays no dest allocation per batch.
+// Replaces the wrapper's per-record Python memmove loop with ctypes
+// calls per feature per BATCH.
+int t2r_parser_gather_plane(void* handle, int i, int64_t batch,
+                            uint8_t* dest) {
+  Plan* plan = static_cast<Plan*>(handle);
+  if (i < 0 || i >= static_cast<int>(plan->names.size()) ||
+      plan->kinds[i] != KIND_BYTES || plan->seq_lens[i] > 0)
+    return -1;
+  int64_t size = plan->sizes[i];
+  if (size <= 0) return -1;
+  int64_t offset = plan->caps_offset[i];
+  int slot = plan->bytes_slot[i];
+  for (int64_t r = 0; r < batch; ++r) {
+    if (plan->bytes_counts[r * plan->num_bytes + slot] != 1 ||
+        plan->bytes_lens[r * plan->total_caps + offset] != size)
+      return 0;
+  }
+  if (dest == nullptr) return 1;  // check-only probe
+  for (int64_t r = 0; r < batch; ++r)
+    std::memcpy(dest + r * size,
+                plan->bytes_ptrs[r * plan->total_caps + offset],
+                static_cast<size_t>(size));
+  return 1;
+}
+
 // Parses `batch` Example or SequenceExample records. float/int features
 // land in dense zeroed buffers of shape [batch, max(1, seq_len), size]
 // supplied per feature (float_outs[i] / int_outs[i], null for other

@@ -7,14 +7,16 @@
 // (tensor2robot_tpu/native/__init__.py). Python fallbacks exist for
 // every entry point.
 //
-// Record framing (public TFRecord format):
-//   uint64 length | uint32 masked_crc(length) | data | uint32 masked_crc(data)
+// Record framing lives in record_framing.h — the ONE definition of the
+// header/footer contract shared with batch_stager.cc's RecordReader.
 
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <string>
 #include <vector>
+
+#include "record_framing.h"
 
 namespace {
 
@@ -110,46 +112,22 @@ int64_t t2r_reader_next_batch(void* handle, int64_t max_records) try {
   r->arena.clear();
   r->offsets.clear();
   r->lengths.clear();
-  uint8_t header[12];
-  // Sanity cap: a corrupt length field must not drive a huge allocation.
-  constexpr uint64_t kMaxRecordBytes = 1ull << 31;  // 2 GiB
   for (int64_t i = 0; i < max_records; ++i) {
-    size_t got = std::fread(header, 1, 12, r->file);
-    if (got == 0) break;               // clean EOF
-    if (got < 12) { r->error = "truncated header"; return -1; }
     uint64_t length;
-    std::memcpy(&length, header, 8);
-    if (length > kMaxRecordBytes) {
-      r->error = "implausible record length (corrupt file?)";
-      return -1;
-    }
-    if (r->verify_crc) {
-      uint32_t expect;
-      std::memcpy(&expect, header + 8, 4);
-      if (masked_crc(header, 8) != expect) {
-        r->error = "length crc mismatch";
-        return -1;
-      }
-    }
+    int status = t2r::ReadRecordHeader(r->file, r->verify_crc, &length,
+                                       &r->error);
+    if (status == 0) break;            // clean EOF
+    if (status < 0) return -1;
     size_t offset = r->arena.size();
     r->arena.resize(offset + length);
     if (std::fread(r->arena.data() + offset, 1, length, r->file) < length) {
       r->error = "truncated body";
       return -1;
     }
-    uint8_t footer[4];
-    if (std::fread(footer, 1, 4, r->file) < 4) {
-      r->error = "truncated footer";
+    if (t2r::ReadRecordFooter(r->file, r->verify_crc,
+                              r->arena.data() + offset, length,
+                              &r->error) < 0)
       return -1;
-    }
-    if (r->verify_crc) {
-      uint32_t expect;
-      std::memcpy(&expect, footer, 4);
-      if (masked_crc(r->arena.data() + offset, length) != expect) {
-        r->error = "data crc mismatch";
-        return -1;
-      }
-    }
     r->offsets.push_back(static_cast<int64_t>(offset));
     r->lengths.push_back(static_cast<int64_t>(length));
   }

@@ -491,6 +491,96 @@ print("OK")
   assert "OK" in result.stdout
 
 
+def _make_native_pkg(tmp_path, cc_text, init_text):
+  native_dir = tmp_path / "native"
+  native_dir.mkdir()
+  (native_dir / "x.cc").write_text(cc_text)
+  (native_dir / "__init__.py").write_text(init_text)
+  return str(native_dir)
+
+
+def test_native_binding_missing_fires(tmp_path):
+  from tensor2robot_tpu.analysis import native_check
+
+  native_dir = _make_native_pkg(
+      tmp_path,
+      'extern "C" {\n'
+      "int64_t t2r_bound(void* h) { return 0; }\n"
+      "void* t2r_unbound(void* h) { return h; }\n"
+      "}\n",
+      "lib.t2r_bound.restype = ctypes.c_int64\n")
+  found = native_check.check_native_bindings(native_dir)
+  assert _rules(found) == {"native-binding-missing"}
+  assert "t2r_unbound" in found[0].message
+
+
+def test_native_binding_unknown_fires(tmp_path):
+  from tensor2robot_tpu.analysis import native_check
+
+  native_dir = _make_native_pkg(
+      tmp_path,
+      'extern "C" int64_t t2r_bound(void* h) { return 0; }\n',
+      "lib.t2r_bound.restype = ctypes.c_int64\n"
+      "lib.t2r_typoed.restype = None\n")
+  found = native_check.check_native_bindings(native_dir)
+  assert _rules(found) == {"native-binding-unknown"}
+  assert found[0].line == 2
+
+
+def test_native_binding_call_sites_and_wildcards_ignored(tmp_path):
+  """A C++-side CALL of an exported symbol is not a second export, a
+  `hasattr` probe counts as a binding, and prose like `t2r_stager_*`
+  or `libt2r_native.so` never registers as a symbol reference."""
+  from tensor2robot_tpu.analysis import native_check
+
+  native_dir = _make_native_pkg(
+      tmp_path,
+      'extern "C" uint32_t t2r_crc(const uint8_t* d, int64_t n);\n'
+      'extern "C" {\n'
+      "int t2r_probe_only(void* h) { return 0; }\n"
+      "uint32_t t2r_crc(const uint8_t* d, int64_t n) {\n"
+      "  if (t2r_crc(d, 0)) return t2r_crc(d, 1);\n"
+      "  return 0;\n"
+      "}\n"
+      "}\n",
+      '"""Wrapper for libt2r_native.so; see the `t2r_*` exports and the\n'
+      "`t2r_probe_*` family.\"\"\"\n"
+      "lib.t2r_crc.restype = ctypes.c_uint32\n"
+      'if hasattr(lib, "t2r_probe_only"):\n'
+      "  pass\n")
+  assert native_check.check_native_bindings(native_dir) == []
+
+
+def test_native_binding_suppression(tmp_path):
+  from tensor2robot_tpu.analysis import native_check
+
+  native_dir = _make_native_pkg(
+      tmp_path,
+      'extern "C" int64_t t2r_bound(void* h) { return 0; }\n',
+      "lib.t2r_bound.restype = ctypes.c_int64\n"
+      "lib.t2r_gone.restype = None"
+      "  # graftlint: disable=native-binding-unknown\n")
+  assert native_check.check_native_bindings(native_dir) == []
+
+
+def test_native_binding_repo_symbols_all_covered():
+  """Every real exported symbol is seen by the checker (a regression
+  here means the export regex stopped matching the repo's .cc style)."""
+  from tensor2robot_tpu.analysis import native_check
+
+  native_dir = os.path.join(REPO_ROOT, "tensor2robot_tpu", "native")
+  exported = set()
+  for name in os.listdir(native_dir):
+    if name.endswith(".cc"):
+      exported |= native_check.exported_symbols(
+          os.path.join(native_dir, name))
+  for symbol in ("t2r_crc32c", "t2r_masked_crc32c", "t2r_reader_open",
+                 "t2r_parser_parse_batch", "t2r_parser_gather_plane",
+                 "t2r_stager_open", "t2r_stager_next_batch",
+                 "t2r_staged_free", "t2r_decode_jpeg_batch"):
+    assert symbol in exported, symbol
+
+
 def test_grasp2vec_quadrant_centers_is_host_constant():
   """The fixed violation stays fixed in-process too: the module constant
   must be a host numpy array, not a device array."""
