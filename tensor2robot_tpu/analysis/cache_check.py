@@ -1,0 +1,83 @@
+"""graftlint: executable-cache key completeness.
+
+A persistent executable cache is only as safe as its key: an entry
+keyed without the device topology loads an 8-device executable into a
+1-device process; without dtypes it serves a bf16 executable to f32
+traffic; without the backend version it replays executables across a
+compiler upgrade (the round-5 measured fact: the terminal's older
+libtpu refused image-compiled executables — version skew is real on
+this project's one deployment). `obs.excache.cache_key` therefore takes
+every component as a mandatory keyword, and this rule makes omission a
+STATIC finding rather than a runtime TypeError in whatever process
+first takes the path:
+
+* `cache-key-missing-component` — a `cache_key(...)` /
+  `excache.cache_key(...)` call site that does not pass every required
+  component keyword (`jaxpr_fingerprint`, `avals`, `mesh`,
+  `backend_version`, `donation`, `static_args`). A literal `**kwargs`
+  splat at the call site is accepted (not statically analyzable); the
+  idiomatic `**key_components_from_traced(...)` splat is exactly that.
+
+Pure AST analysis, backend-free like every graftlint rule. Suppress
+with a trailing `# graftlint: disable=cache-key-missing-component`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
+                                                load_suppressions)
+
+__all__ = ["REQUIRED_COMPONENTS", "check_python_source",
+           "check_python_file"]
+
+# Mirrors the mandatory keywords of obs.excache.cache_key — the
+# components without which a persisted executable can be loaded into
+# the wrong topology/dtype/compiler (tests/test_excache.py pins the two
+# lists against each other so they cannot drift).
+REQUIRED_COMPONENTS = ("jaxpr_fingerprint", "avals", "mesh",
+                       "backend_version", "donation", "static_args")
+
+_RULE = "cache-key-missing-component"
+
+
+def _is_cache_key_call(func: ast.AST) -> bool:
+  if isinstance(func, ast.Name):
+    return func.id == "cache_key"
+  if isinstance(func, ast.Attribute):
+    return func.attr == "cache_key"
+  return False
+
+
+def check_python_source(path: str, source: str) -> List[Finding]:
+  try:
+    tree = ast.parse(source, filename=path)
+  except SyntaxError:
+    return []  # tracer_check already reports unparseable files
+  findings: List[Finding] = []
+  for node in ast.walk(tree):
+    if not isinstance(node, ast.Call) or not _is_cache_key_call(node.func):
+      continue
+    if any(kw.arg is None for kw in node.keywords):
+      continue  # **splat: components arrive as a dict, not analyzable
+    passed = {kw.arg for kw in node.keywords}
+    missing = [c for c in REQUIRED_COMPONENTS if c not in passed]
+    if missing:
+      findings.append(Finding(
+          path=path, line=node.lineno, rule=_RULE,
+          end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+          message=(f"cache_key call omits key component(s) "
+                   f"{', '.join(missing)} — an under-keyed executable "
+                   "cache can serve a mismatched executable (wrong "
+                   "mesh/dtype/compiler); pass every component, e.g. "
+                   "**excache.key_components_from_traced(traced, args)")))
+  return findings
+
+
+def check_python_file(path: str) -> List[Finding]:
+  with open(path, encoding="utf-8", errors="replace") as f:
+    source = f.read()
+  return filter_findings(check_python_source(path, source),
+                         load_suppressions(source))

@@ -27,8 +27,9 @@ import os
 import sys
 from typing import List, Tuple
 
-from tensor2robot_tpu.analysis import (config_check, native_check,
-                                       spec_check, tracer_check)
+from tensor2robot_tpu.analysis import (cache_check, config_check,
+                                       native_check, spec_check,
+                                       tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -58,6 +59,15 @@ tracer rules (.py):
                          dispatch without a host-fetch barrier (measures
                          dispatch, not execution, over the tunnel);
                          obs/ and utils/backend.py are exempt
+
+cache rules (.py):
+  cache-key-missing-component  a `cache_key(...)` call site omits one
+                         of the mandatory executable-cache key
+                         components (jaxpr fingerprint, aval shapes/
+                         dtypes, mesh topology, backend version,
+                         donation layout, static args) — an under-keyed
+                         cache can serve a mismatched executable;
+                         a `**splat` call site is accepted
 
 native rules (native/__init__.py ↔ native/*.cc):
   native-binding-missing a .cc source exports a `t2r_*` symbol the
@@ -113,6 +123,7 @@ def run(paths: List[str]) -> List[Finding]:
   for path in py_files:
     findings.extend(tracer_check.check_python_file(path))
     findings.extend(spec_check.check_python_file(path, mesh_axes))
+    findings.extend(cache_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked
     # directly — the wrapper is the unit whose drift matters).

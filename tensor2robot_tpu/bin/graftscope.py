@@ -26,6 +26,13 @@ docs/ARCHITECTURE.md "Observability"); this is the read side:
       <dir> is a bundle dir, a flightrec/ dir, a model_dir (searched
       recursively; latest bundle by default, select with --index), or
       a postmortem.json path; --list enumerates bundles.
+  python -m tensor2robot_tpu.bin.graftscope cache <cache_dir>
+      list graftcache executable-cache entries (obs.excache metadata
+      sidecars: name, bytes, age, key); --verify checksums every blob
+      (exit 1 on corruption), --evict removes entries (all, --key K,
+      --older-than SECS, or --name-prefix P for one namespace of a
+      shared dir). Metadata-only: never deserializes an executable,
+      so it is backend-free like every other subcommand.
 
 Robustness contract: a torn tail line of a live run, a truncated trace
 JSON, or binary garbage in any telemetry file is skipped with a warning
@@ -398,6 +405,80 @@ def _main_diff(argv: List[str]) -> int:
   return 3 if any(d["regressed"] for d in deltas) else 0
 
 
+def _main_cache(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope cache",
+      description="List, verify, or evict graftcache executable-cache "
+                  "entries (obs.excache). Metadata sidecars only — "
+                  "backend-free, safe on the tunnel machine while a "
+                  "job owns the TPU.")
+  parser.add_argument("cache_dir",
+                      help="cache directory (e.g. .graftcache or "
+                           "<model_dir>/excache)")
+  parser.add_argument("--verify", action="store_true",
+                      help="checksum every entry's blob against its "
+                           "sidecar; exit 1 if any entry is bad")
+  parser.add_argument("--evict", action="store_true",
+                      help="remove entries (ALL, including the xla/ "
+                           "tier, without --key/--older-than/"
+                           "--name-prefix)")
+  parser.add_argument("--key", help="restrict --evict to one entry key")
+  parser.add_argument("--older-than", type=float, metavar="SECS",
+                      help="restrict --evict to entries created more "
+                           "than SECS seconds ago")
+  parser.add_argument("--name-prefix", metavar="PREFIX",
+                      help="restrict --evict to entries whose recorded "
+                           "name starts with PREFIX (e.g. serve/ or "
+                           "cache_smoke/) — clears one namespace of a "
+                           "shared cache dir without re-taxing every "
+                           "other probe's entries")
+  args = parser.parse_args(argv)
+  if not os.path.isdir(args.cache_dir):
+    print(f"graftscope: no cache directory at {args.cache_dir}",
+          file=sys.stderr)
+    return 2
+  from tensor2robot_tpu.obs import excache as excache_lib
+
+  cache = excache_lib.ExecutableCache(args.cache_dir)
+  if args.evict:
+    removed = cache.evict(key=args.key, older_than_secs=args.older_than,
+                          name_prefix=args.name_prefix)
+    print(f"graftcache: evicted {removed} entr"
+          f"{'y' if removed == 1 else 'ies'} from {args.cache_dir}")
+    return 0
+  entries = cache.entries()
+  bad: List[str] = []
+  if args.verify:
+    _, bad = cache.verify()
+  print(f"graftcache: {args.cache_dir} ({len(entries)} entr"
+        f"{'y' if len(entries) == 1 else 'ies'})")
+  header = (f"  {'name':<28}{'bytes':>12}{'age':>10}"
+            f"{'  key':<40}{'  status' if args.verify else ''}")
+  print(header)
+  now = time.time()
+  total_bytes = 0
+  for entry in entries:
+    size = int(entry.get("blob_bytes") or 0)
+    total_bytes += size
+    age = now - float(entry.get("created_unix") or now)
+    status = ""
+    if args.verify:
+      status = "  CORRUPT" if entry["key"] in bad else "  ok"
+    if entry.get("orphan"):
+      status = "  ORPHAN-BLOB" if args.verify else ""
+    name = str(entry.get("name") or "?")[:27]
+    print(f"  {name:<28}{size:>12}{age:>9.0f}s  {entry['key']:<38}"
+          f"{status}")
+  print(f"  total {total_bytes} bytes")
+  if args.verify and bad:
+    print(f"graftcache: {len(bad)} bad entr"
+          f"{'y' if len(bad) == 1 else 'ies'} "
+          "(evict with --evict --key <key>, or rely on the automatic "
+          "quarantine-on-load)", file=sys.stderr)
+    return 1
+  return 0
+
+
 def _stamp(unix_time) -> str:
   try:
     return time.strftime("%Y-%m-%d %H:%M:%S",
@@ -622,7 +703,8 @@ def _main_postmortem(argv: List[str]) -> int:
 
 
 _SUBCOMMANDS = {"report": _main_report, "history": _main_history,
-                "diff": _main_diff, "postmortem": _main_postmortem}
+                "diff": _main_diff, "postmortem": _main_postmortem,
+                "cache": _main_cache}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -18,6 +18,12 @@ timing that diagnosed every perf round by hand (PERFORMANCE.md):
   state/batch/HBM-watermark accounting;
 * `runlog`    — schema-versioned append-only run history
   (`runs.jsonl`) with direction-aware regression diffing;
+* `excache`   — graftcache: persistent on-disk executable/AOT cache
+  (content-addressed `serialize_executable` round-trips of the xray
+  AOT executables + the XLA compilation-cache backstop), so trainer
+  restarts, serving cold starts, and bench probes deserialize warm
+  executables instead of recompiling; read back / maintained with
+  `graftscope cache`;
 * `sentinel`  — online anomaly detection over the stepstats stream:
   EWMA/MAD step-time spikes, data starvation, non-finite divergence
   (piggybacked on the barrier fetch — zero extra tunnel round trips),
@@ -40,8 +46,8 @@ Read telemetry back with `python -m tensor2robot_tpu.bin.graftscope
 `... graftscope diff <runA> <runB>` / `... graftscope history <dir>`.
 """
 
-from tensor2robot_tpu.obs import (flightrec, metrics, runlog, sentinel,
-                                  stepstats, trace, xray)
+from tensor2robot_tpu.obs import (excache, flightrec, metrics, runlog,
+                                  sentinel, stepstats, trace, xray)
 
-__all__ = ["flightrec", "metrics", "runlog", "sentinel", "stepstats",
-           "trace", "xray"]
+__all__ = ["excache", "flightrec", "metrics", "runlog", "sentinel",
+           "stepstats", "trace", "xray"]

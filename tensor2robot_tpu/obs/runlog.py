@@ -73,6 +73,18 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # PERFORMANCE.md "Reading a data bench"). 15%: the per-run median
     # still wobbles 1.85-1.90x on this VM.
     "stager_vs_python_chain": ("down", 0.15),
+    # Train-smoke data-path ratio (bench.py CPU fallback): record-fed vs
+    # synthetic device-resident throughput, paired back-to-back — the
+    # load-invariant gate for the REAL train data path (ROADMAP item 5).
+    "data_vs_synthetic": ("down", 0.20),
+    # graftcache cold-start gates (bench.py --cache / engine warmup,
+    # PERFORMANCE.md "Reading a cache bench"): warmup_ms is wall-clock
+    # (host noise — loose band), cold_vs_warm_warmup is the paired
+    # cold/warm speedup ratio (>= 1; a drop toward 1 means the cache
+    # stopped saving compiles — the load-invariant down-bad gate of the
+    # ISSUE 7 acceptance).
+    "warmup_ms": ("up", 0.50),
+    "cold_vs_warm_warmup": ("down", 0.30),
 }
 
 
@@ -246,6 +258,30 @@ def step_stats_summary(snapshot: Dict[str, float]) -> Dict[str, float]:
   return out
 
 
+def _primary_compile_record(record: Dict[str, Any]
+                            ) -> Optional[Dict[str, Any]]:
+  """The PRIMARY compile record — the first train-named one (the main
+  loop/step, analyzed on first dispatch), falling back to the first.
+  Summing across records would diff the telemetry SHAPE, not the
+  compiler: a run that also analyzed a loop tail or an in-process
+  predictor must not read as a compile-time regression against one
+  that didn't."""
+  compiles = record.get("compile") or []
+  if not compiles:
+    return None
+  return next((r for r in compiles
+               if "train" in str(r.get("name", ""))), compiles[0])
+
+
+def _primary_compile_cache_hit(record: Dict[str, Any]) -> Optional[bool]:
+  """Whether the primary executable came out of graftcache (None when
+  the record carries no compile records or no cache block)."""
+  primary = _primary_compile_record(record)
+  if primary is None or "cache" not in primary:
+    return None
+  return bool((primary.get("cache") or {}).get("hit"))
+
+
 def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
   """The canonical comparable metrics of one record (diff vocabulary).
 
@@ -269,16 +305,17 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["mfu"] = float(bench["mfu"])
   if bench.get("stager_vs_python_chain") is not None:
     out["stager_vs_python_chain"] = float(bench["stager_vs_python_chain"])
+  if bench.get("data_vs_synthetic") is not None:
+    out["data_vs_synthetic"] = float(bench["data_vs_synthetic"])
+  # graftcache cold-start metrics (bench.py --cache headlines; the
+  # serve headline's engine warmup lands here too when present).
+  if bench.get("warmup_ms") is not None:
+    out["warmup_ms"] = float(bench["warmup_ms"])
+  if bench.get("cold_vs_warm_warmup") is not None:
+    out["cold_vs_warm_warmup"] = float(bench["cold_vs_warm_warmup"])
   compiles = record.get("compile") or []
   if compiles:
-    # All compile/cost metrics come from the PRIMARY executable — the
-    # first train-named record (the main loop/step, analyzed on first
-    # dispatch), falling back to the first record. Summing across
-    # records would diff the telemetry SHAPE, not the compiler: a run
-    # that also analyzed a loop tail or an in-process predictor must
-    # not read as a compile-time regression against one that didn't.
-    primary = next((r for r in compiles
-                    if "train" in str(r.get("name", ""))), compiles[0])
+    primary = _primary_compile_record(record)
     out["compile_time_s"] = (
         float(primary.get("trace_s") or 0.0)
         + float(primary.get("lower_s") or 0.0)
@@ -294,6 +331,23 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
   return out
 
 
+def _bench_not_comparable(a: Dict[str, Any], b: Dict[str, Any]) -> bool:
+  """True when two bench records' headline numbers measure different
+  things: different metric names, or the same smoke metric across the
+  PR-7 record-fed semantic boundary (`data_vs_synthetic` on one side
+  only). `diff_records` lists-but-never-flags across these; the
+  matching `comparability_warnings` entries do the shouting."""
+  metric_a = (a.get("bench") or {}).get("metric")
+  metric_b = (b.get("bench") or {}).get("metric")
+  if not metric_a or not metric_b:
+    return False
+  if metric_a != metric_b:
+    return True
+  has_dvs_a = (a.get("bench") or {}).get("data_vs_synthetic") is not None
+  has_dvs_b = (b.get("bench") or {}).get("data_vs_synthetic") is not None
+  return has_dvs_a != has_dvs_b
+
+
 def diff_records(a: Dict[str, Any], b: Dict[str, Any],
                  thresholds: Optional[Dict[str, Tuple[str, float]]] = None,
                  default_threshold: float = 0.10
@@ -304,10 +358,19 @@ def diff_records(a: Dict[str, Any], b: Dict[str, Any],
   metrics absent from both maps regress on |relative change| >
   `default_threshold`. A metric present in only one record is listed
   (delta None) but never flagged — new telemetry must not read as a
-  regression.
+  regression. Two bench records with DIFFERENT bench metric names
+  (TPU headline vs CPU fallback, cold-start vs warm-start, serve vs
+  data) are likewise listed-not-flagged: `comparability_warnings`
+  already shouts that the deltas are not meaningful, and a bogus
+  exit-3 across that boundary would train people to ignore the gate.
   """
   merged = dict(DEFAULT_THRESHOLDS)
   merged.update(thresholds or {})
+  cross_metric = _bench_not_comparable(a, b)
+  hit_a, hit_b = (_primary_compile_cache_hit(a),
+                  _primary_compile_cache_hit(b))
+  cache_hit_differs = (hit_a is not None and hit_b is not None
+                       and hit_a != hit_b)
   metrics_a, metrics_b = key_metrics(a), key_metrics(b)
   deltas: List[Dict[str, Any]] = []
   for name in sorted(set(metrics_a) | set(metrics_b)):
@@ -328,6 +391,14 @@ def diff_records(a: Dict[str, Any], b: Dict[str, Any],
         entry["regressed"] = rel < -threshold
       else:
         entry["regressed"] = abs(rel) > threshold
+      if cross_metric:
+        entry["regressed"] = False
+      if name == "compile_time_s" and cache_hit_differs:
+        # A cache HIT rewrites compile_s to ~0 (the compile was paid by
+        # an earlier process); hit-vs-miss compile-time deltas price
+        # cache economics, not the compiler. Listed + warned, never
+        # flagged.
+        entry["regressed"] = False
     deltas.append(entry)
   return deltas
 
@@ -359,6 +430,24 @@ def comparability_warnings(a: Dict[str, Any], b: Dict[str, Any]
   metric_b = (b.get("bench") or {}).get("metric")
   if metric_a and metric_b and metric_a != metric_b:
     warnings.append(f"bench metric differs: {metric_a} vs {metric_b}")
+  # PR-7 semantic boundary: qtopt_grasps_per_sec_cpu_smoke switched
+  # from a synthetic device-resident feed to the real record pipeline
+  # (ISSUE 7 kept the name — ROADMAP item 5 tracks it). A record-fed
+  # headline carries data_vs_synthetic; diffing it against a pre-PR-7
+  # record is a ~4x apparent drop that is a measurement change, not a
+  # regression.
+  has_dvs_a = (a.get("bench") or {}).get("data_vs_synthetic") is not None
+  has_dvs_b = (b.get("bench") or {}).get("data_vs_synthetic") is not None
+  if metric_a and metric_a == metric_b and has_dvs_a != has_dvs_b:
+    warnings.append(
+        "smoke headline semantics differ: one side is record-fed "
+        "(data_vs_synthetic present), the other synthetic (pre-PR-7)")
+  hit_a, hit_b = (_primary_compile_cache_hit(a),
+                  _primary_compile_cache_hit(b))
+  if hit_a is not None and hit_b is not None and hit_a != hit_b:
+    warnings.append(
+        "graftcache hit/miss differs for the primary executable: "
+        "compile_time_s deltas price cache economics, not the compiler")
   return warnings
 
 

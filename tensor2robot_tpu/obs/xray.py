@@ -30,10 +30,17 @@ the backend is already up (tests/test_observability.py proves the
 import under a poisoned JAX_PLATFORMS). Telemetry must never take down
 a train loop: `XrayedFunction` falls back to the plain jitted callable
 on ANY analysis or compiled-call failure.
+
+graftcache (PR 7): `analyze_jit`/`XrayedFunction` take a `cache=` seam
+(`obs.excache`) that persists the AOT executables they produce and
+short-circuits lower+compile with a deserialize on later processes —
+trainer restarts, serving cold starts, and bench probes warm-start in
+milliseconds. All cache failure modes degrade to the fresh compile.
 """
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -168,7 +175,8 @@ def analytic_mfu(flops: float, step_sec: float,
 
 def analyze_jit(name: str, fn, *args,
                 registry: Optional[metrics_lib.Registry] = None,
-                collect: bool = True) -> Tuple[Any, Dict[str, Any]]:
+                collect: bool = True,
+                cache=None) -> Tuple[Any, Dict[str, Any]]:
   """AOT trace->lower->compile of a jitted `fn` at `args`, instrumented.
 
   Returns `(compiled, record)` where `compiled` is the executable
@@ -185,16 +193,86 @@ def analyze_jit(name: str, fn, *args,
   the executable — it answers "what SHOULD this step cost on the chip",
   which is exactly the number the round-5 valley violated 16x.
 
-  Raises on failure — callers that must not die use `XrayedFunction`
-  (or wrap in try/except) and keep the plain jitted fn.
+  `cache` (an `obs.excache.ExecutableCache` or a directory path)
+  short-circuits lower+compile with a persisted executable when the
+  content-addressed key (jaxpr fingerprint, abstract shapes/dtypes/
+  shardings, donation layout, static args, device topology, backend
+  version) hits: the record then carries the COLD process's cost/memory
+  analysis plus a `cache` block (`{hit, key, load_ms, bytes}`) and
+  `lower_s == compile_s == 0`. A load failure of any kind — corrupt
+  blob, version skew, key trouble — falls back to the fresh compile
+  below (cache trouble must never take down the run, the same contract
+  as every other telemetry path here); a miss stores the fresh
+  executable for the next process.
+
+  Raises on (compile) failure — callers that must not die use
+  `XrayedFunction` (or wrap in try/except) and keep the plain jitted fn.
   """
+  from tensor2robot_tpu.obs import excache as excache_lib
+
   reg = registry or metrics_lib.get_registry()
+  cache = excache_lib.as_cache(cache)
   t0 = time.perf_counter()
   traced = fn.trace(*args)
   t1 = time.perf_counter()
+
+  cache_key = None
+  if cache is not None:
+    try:
+      # Donating multi-device executables must not round-trip through
+      # serialize_executable (measured heap corruption on this jax —
+      # see excache.aot_cache_unsafe). They keep the XLA compilation-
+      # cache tier; only the serialized-AOT tier is skipped.
+      if excache_lib.aot_cache_unsafe(traced, args):
+        reg.counter("cache/skipped_donated_mesh").inc()
+        cache = None
+    except Exception:  # noqa: BLE001 - guard trouble = no caching
+      cache = None
+  if cache is not None:
+    try:
+      cache_key = excache_lib.cache_key(
+          name, **excache_lib.key_components_from_traced(traced, args))
+    except Exception as e:  # noqa: BLE001 - key trouble = no caching
+      reg.counter("cache/key_failures").inc()
+      print(f"graftcache: key computation for {name!r} failed "
+            f"({type(e).__name__}: {e}); compiling fresh",
+            file=sys.stderr)
+    if cache_key is not None:
+      entry = cache.load(cache_key)
+      if entry is not None:
+        donated, undonated = _donation_bytes(traced, args)
+        record = dict(entry["record"])
+        record.update({
+            "name": name,
+            "trace_s": t1 - t0,
+            "lower_s": 0.0,
+            "compile_s": 0.0,
+            "jaxpr_eqns": _count_eqns(traced.jaxpr),
+            "donated_bytes": donated,
+            "undonated_bytes": undonated,
+            "cache": {"hit": True, "key": cache_key,
+                      "load_ms": entry["load_ms"],
+                      "bytes": entry["bytes"]},
+        })
+        record.setdefault("flops", None)
+        record.setdefault("bytes_accessed", None)
+        reg.counter("xray/analyses").inc()
+        reg.gauge(f"xray/{name}/cache_load_ms").set(entry["load_ms"])
+        if collect:
+          _collect(record)
+        return entry["compiled"], record
+
   lowered = traced.lower()
   t2 = time.perf_counter()
-  compiled = lowered.compile()
+  if cache is not None and cache_key is not None:
+    # An AOT-tier miss about to be stored: compile WITHOUT the XLA
+    # persistent cache, or the artifact may come out of that cache
+    # non-serializable and the entry could never (re)fill — see
+    # excache.xla_cache_bypassed.
+    with excache_lib.xla_cache_bypassed():
+      compiled = lowered.compile()
+  else:
+    compiled = lowered.compile()
   t3 = time.perf_counter()
 
   donated, undonated = _donation_bytes(traced, args)
@@ -240,6 +318,13 @@ def analyze_jit(name: str, fn, *args,
   except Exception:  # noqa: BLE001 - memory analysis is backend-optional
     pass
 
+  if cache is not None and cache_key is not None:
+    # Persist for the NEXT process (best-effort, counted); the stored
+    # sidecar carries this record so a warm start keeps full compile
+    # telemetry without paying the compile.
+    stored = cache.store(cache_key, compiled, record=record, name=name)
+    record["cache"] = {"hit": False, "key": cache_key, "stored": stored}
+
   reg.counter("xray/analyses").inc()
   reg.gauge(f"xray/{name}/compile_s").set(record["compile_s"])
   reg.gauge(f"xray/{name}/jaxpr_eqns").set(float(record["jaxpr_eqns"]))
@@ -268,10 +353,15 @@ class XrayedFunction:
   """
 
   def __init__(self, name: str, fn,
-               registry: Optional[metrics_lib.Registry] = None):
+               registry: Optional[metrics_lib.Registry] = None,
+               cache=None):
     self._name = name
     self._fn = fn
     self._registry = registry or metrics_lib.get_registry()
+    # graftcache seam: a persisted executable turns the first call's
+    # compile into a deserialize (trainer restarts / bench probes warm-
+    # start); all cache failure modes already degrade inside analyze_jit.
+    self._cache = cache
     self._compiled = None
     self._record: Optional[Dict[str, Any]] = None
     self._failed = False
@@ -287,7 +377,8 @@ class XrayedFunction:
         return
       try:
         self._compiled, self._record = analyze_jit(
-            self._name, self._fn, *args, registry=self._registry)
+            self._name, self._fn, *args, registry=self._registry,
+            cache=self._cache)
       except Exception as e:  # noqa: BLE001 - degrade, never break the call
         self._failed = True
         self._registry.counter("xray/analyze_failures").inc()

@@ -102,15 +102,26 @@ class _JaxPredictorBase(AbstractPredictor):
   every predict whose END-TO-END latency (the `np.asarray` fetch is the
   tunnel barrier) exceeds it increments the counter — a latency
   regression becomes a counter delta in the graftscope report instead
-  of a percentile archaeology session. None disables."""
+  of a percentile archaeology session. None disables.
 
-  def __init__(self, latency_slo_ms: Optional[float] = None):
+  `executable_cache_dir` arms graftcache (`obs.excache`) on the
+  in-process predict path: the `serve/predict` executable persists to
+  disk, so a robot-side predictor restart deserializes its warm
+  executable instead of recompiling (the cold-start tax the reference's
+  SavedModel reload also paid per process). None disables; serving
+  never breaks on cache trouble (excache fallback contract). The
+  graftserve `BucketedEngine` has its own `cache=` seam for the bucket
+  ladder."""
+
+  def __init__(self, latency_slo_ms: Optional[float] = None,
+               executable_cache_dir: Optional[str] = None):
     self._model = None
     self._state: Optional[ts.TrainState] = None
     self._predict_fn: Optional[Callable] = None
     self._jit_predict: Optional[Callable] = None
     self._global_step = -1
     self._latency_slo_ms = latency_slo_ms
+    self._executable_cache_dir = executable_cache_dir
 
   def _build_predict(self) -> None:
     model = self._model
@@ -125,7 +136,8 @@ class _JaxPredictorBase(AbstractPredictor):
     # executable; a batch-size change or an analysis failure silently
     # degrades to the plain jitted fn (serving must never break on
     # telemetry).
-    predict = obs_xray.XrayedFunction("serve/predict", self._jit_predict)
+    predict = obs_xray.XrayedFunction("serve/predict", self._jit_predict,
+                                      cache=self._executable_cache_dir)
     preprocessor = model.preprocessor
 
     def fn(features):
@@ -218,8 +230,10 @@ class CheckpointPredictor(_JaxPredictorBase):
 
   def __init__(self, model=None, model_dir: Optional[str] = None,
                timeout_secs: float = 0.0,
-               latency_slo_ms: Optional[float] = None):
-    super().__init__(latency_slo_ms=latency_slo_ms)
+               latency_slo_ms: Optional[float] = None,
+               executable_cache_dir: Optional[str] = None):
+    super().__init__(latency_slo_ms=latency_slo_ms,
+                     executable_cache_dir=executable_cache_dir)
     if model is None or model_dir is None:
       raise ValueError("model and model_dir are required.")
     self._model = model
@@ -302,8 +316,10 @@ class ExportedModelPredictor(_JaxPredictorBase):
 
   def __init__(self, export_dir: Optional[str] = None, model=None,
                timeout_secs: float = 0.0,
-               latency_slo_ms: Optional[float] = None):
-    super().__init__(latency_slo_ms=latency_slo_ms)
+               latency_slo_ms: Optional[float] = None,
+               executable_cache_dir: Optional[str] = None):
+    super().__init__(latency_slo_ms=latency_slo_ms,
+                     executable_cache_dir=executable_cache_dir)
     if export_dir is None:
       raise ValueError("export_dir is required.")
     self._export_dir = export_dir

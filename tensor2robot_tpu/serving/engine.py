@@ -24,6 +24,15 @@ startup, cover every request size.
   path (`obs.xray.analyze_jit`), so compile time, jaxpr size, roofline
   and per-bucket cost analysis land in the metrics registry and the
   run's `runs.jsonl` record like every other executable in this repo;
+* a graftcache seam (`cache=` — an `obs.excache.ExecutableCache` or a
+  directory path): warmup loads the whole bucket ladder from the
+  persistent executable cache, so a serving COLD START in a fresh
+  process pays N deserializes (~ms each) instead of N compiles
+  (20-40 s each over the tunnel). `compile_count` counts FRESH compiles
+  only — a fully warm start reports `compile_count == 0` with
+  `cache_loads == len(buckets)` (tests/test_excache.py pins it across
+  processes), and a stale/corrupt entry silently costs one fresh
+  compile (the excache fallback contract);
 * `predict(features)` pads the batch up to the smallest covering bucket
   (pad rows repeat row 0 — always in-distribution, never NaN fodder),
   dispatches the CACHED executable, host-fetches, and masks the pad
@@ -95,7 +104,8 @@ class BucketedEngine:
   def __init__(self, predictor=None,
                max_batch_size: int = 8,
                buckets: Optional[Sequence[int]] = None,
-               name: str = "serve/engine"):
+               name: str = "serve/engine",
+               cache=None):
     if predictor is None:
       raise ValueError("predictor is required.")
     self._predictor = predictor
@@ -109,8 +119,15 @@ class BucketedEngine:
     self._buckets = buckets
     self._max_batch_size = max_batch_size
     self._name = name
+    # graftcache (obs.excache): persistent executable cache for the
+    # bucket ladder. Deferred coercion — a str path must not import
+    # excache machinery at construction in backend-free contexts.
+    self._cache = cache
     self._compiled: Dict[int, Callable] = {}
     self._records: Dict[int, Dict[str, Any]] = {}
+    self._compile_count = 0
+    self._cache_loads = 0
+    self._warmup_ms: Optional[float] = None
     self._bundle = None
     self._lock = threading.Lock()
 
@@ -122,7 +139,21 @@ class BucketedEngine:
 
   @property
   def compile_count(self) -> int:
-    return len(self._compiled)
+    """FRESH compiles paid by this process (cache loads excluded) —
+    without a cache this equals `len(buckets)` after warmup (the pinned
+    zero-recompile guarantee); a fully warm cached start reports 0."""
+    return self._compile_count
+
+  @property
+  def cache_loads(self) -> int:
+    """Buckets served from the persistent executable cache at warmup."""
+    return self._cache_loads
+
+  @property
+  def warmup_ms(self) -> Optional[float]:
+    """Wall-clock of the last warmup that did work (None before warmup).
+    THE serving cold-start headline: graftscope diff gates it."""
+    return self._warmup_ms
 
   @property
   def compile_records(self) -> List[Dict[str, Any]]:
@@ -142,13 +173,18 @@ class BucketedEngine:
     state through the bundle's getter at every dispatch).
     """
     from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.obs import excache as excache_lib
     from tensor2robot_tpu.obs import xray as obs_xray
 
     with self._lock:
+      cache = excache_lib.as_cache(self._cache)
       bundle = self._bundle = self._predictor.serving_bundle()
+      warmup_start = time.perf_counter()
+      did_work = False
       for bucket in self._buckets:
         if bucket in self._compiled:
           continue
+        did_work = True
         wire = specs_lib.make_random_numpy(bundle.feature_spec,
                                            batch_size=bucket, seed=0)
         features = bundle.preprocess(wire)
@@ -156,7 +192,7 @@ class BucketedEngine:
         try:
           compiled, record = obs_xray.analyze_jit(
               f"{self._name}/bucket{bucket}", bundle.jit_predict,
-              bundle.get_state(), features)
+              bundle.get_state(), features, cache=cache)
         except Exception as e:  # noqa: BLE001 - AOT-less backends
           # No AOT support: dispatch the plain jit once at this shape —
           # jax's own per-shape cache then serves later calls without
@@ -169,10 +205,20 @@ class BucketedEngine:
                     "error": f"{type(e).__name__}: {e}"}
         self._compiled[bucket] = compiled
         self._records[bucket] = record
-        obs_metrics.counter("serve/engine/compiles").inc()
+        if (record.get("cache") or {}).get("hit"):
+          # Served from graftcache: a deserialize, not a compile — the
+          # cold-start economics this cache exists for.
+          self._cache_loads += 1
+          obs_metrics.counter("serve/engine/cache_loads").inc()
+        else:
+          self._compile_count += 1
+          obs_metrics.counter("serve/engine/compiles").inc()
         obs_metrics.gauge(
             f"serve/engine/bucket{bucket}/compile_s").set(
                 float(record.get("compile_s") or 0.0))
+      if did_work:
+        self._warmup_ms = (time.perf_counter() - warmup_start) * 1e3
+        obs_metrics.gauge("serve/engine/warmup_ms").set(self._warmup_ms)
     return self
 
   def _bucket_for(self, rows: int) -> int:
