@@ -1,12 +1,21 @@
 #!/usr/bin/env bash
-# Data-plane bench + regression gate.
+# Data-plane bench + regression gates.
 #
-# Runs `bench.py --data` (the qtopt_parse_ex_per_sec_cpu_smoke headline
-# — see PERFORMANCE.md "Reading a data bench"), then diffs the new
-# runs.jsonl record against the PREVIOUS data-bench record with
-# `graftscope diff` so a staging-throughput regression exits non-zero
-# exactly like a training one. Train/serve records interleave in the
-# same runs.jsonl; the index lookup below selects data records only.
+# Two headline runs, each diffed against ITS OWN previous record in
+# runs.jsonl with `graftscope diff` (train/serve/cache records
+# interleave in the same file; the index lookups below select per
+# metric family):
+#
+#   1. `bench.py --data`  — qtopt_parse_ex_per_sec_cpu_smoke, the
+#      records->parsed-batch staging plane (PERFORMANCE.md "Reading a
+#      data bench"; gated metric: stager_vs_python_chain).
+#   2. `bench.py --smoke` — qtopt_grasps_per_sec_cpu_smoke, the REAL
+#      record path through the overlapped host loader into the train
+#      step, paired A/B vs the synthetic device-resident feed
+#      (PERFORMANCE.md "Reading an overlap bench"; gated metric:
+#      data_vs_synthetic, the load-invariant up-good ratio).
+#
+# A regression in either exits non-zero exactly like a training one.
 #
 # Usage: scripts/data_bench.sh
 set -euo pipefail
@@ -14,30 +23,47 @@ cd "$(dirname "$0")/.."
 
 RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
 
-JAX_PLATFORMS=cpu python bench.py --data
-
-# Indices of the last two parse_ex records (empty when this was the
-# first data run — nothing to diff yet). The lookup runs OUTSIDE a
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# Extra args after the family name pass through to `graftscope diff`
+# (per-family threshold overrides). The index lookup runs OUTSIDE a
 # process substitution so a failure (unreadable runs.jsonl, broken
 # import) fails the script loudly instead of reading as "no baseline"
 # and silently skipping the gate.
-IDX_OUT=$(JAX_PLATFORMS=cpu python - "$RUNS" <<'EOF'
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
 import sys
 from tensor2robot_tpu.obs import runlog
 records = runlog.load_records(sys.argv[1])
 data = [i for i, r in enumerate(records)
-        if "parse_ex" in str((r.get("bench") or {}).get("metric", ""))]
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
 for i in data[-2:]:
     print(i)
 EOF
-) || { echo "data_bench: runs.jsonl index lookup failed" >&2; exit 1; }
-IDX=()
-[ -n "$IDX_OUT" ] && mapfile -t IDX <<< "$IDX_OUT"
+  ) || { echo "data_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "data_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
 
-if [ "${#IDX[@]}" -lt 2 ]; then
-  echo "data_bench: first data record in $RUNS; no diff baseline yet" >&2
-  exit 0
-fi
+JAX_PLATFORMS=cpu python bench.py --data
+gate_family parse_ex
 
-JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
-    "$RUNS#${IDX[0]}" "$RUNS#${IDX[1]}"
+JAX_PLATFORMS=cpu python bench.py --smoke
+# The smoke family gates on the load-INVARIANT data_vs_synthetic ratio
+# only: its absolute wall-clock metrics (examples_per_sec, step_ms, and
+# the xray block's compile_time_s) swing 4x with host load on this VM
+# (PERFORMANCE.md "Reading an overlap bench" — the headline carries
+# host_load for attribution), so the absolute thresholds are opened
+# wide here rather than training people to ignore a flappy gate.
+gate_family grasps_per_sec_cpu_smoke \
+    --threshold examples_per_sec=10.0 --threshold step_ms=10.0 \
+    --threshold compile_time_s=10.0

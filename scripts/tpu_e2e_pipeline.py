@@ -104,15 +104,21 @@ def gen(num_examples: int = 512) -> None:
         f"{FORMAT}) to {DATA_DIR}/train-*")
 
 
-def _pipeline_iter(model, batch_size: int):
+def _pipeline_iter(model, batch_size: int, overlap: bool = False):
   from tensor2robot_tpu import modes
   from tensor2robot_tpu.data import input_generators
 
   import jax
 
+  # overlap=False by default: this script's 'cpu pipeline' ceiling and
+  # 'e2e serial' phases price the SERIAL host chain on the consumer
+  # thread — the auto-on overlap plane (data/overlap.py) would hide
+  # exactly the work they exist to measure. The prefetched phase turns
+  # it on explicitly, measuring the full PR-8 overlapped stack.
   generator = input_generators.DefaultRecordInputGenerator(
       file_patterns=os.path.join(DATA_DIR, "train-*"),
-      batch_size=batch_size, shuffle_buffer_size=128, seed=0)
+      batch_size=batch_size, shuffle_buffer_size=128, seed=0,
+      overlap=overlap, prefetch_size=2 if overlap else 0)
   features, labels = _wire_specs(model)
   generator.set_specification(features, labels)
   generator.set_preprocess_fn(model.preprocessor.preprocess)
@@ -194,12 +200,15 @@ def run(steps: int = 30) -> None:
     state, _ = step(state, f, l)
   barrier(state)
   serial = steps * BATCH_SIZE / (time.perf_counter() - start)
+  if hasattr(dataset, "close"):
+    dataset.close()
   print(f"e2e serial (no prefetch): {serial:.1f} examples/sec")
 
-  # 3. e2e with the background DevicePrefetcher hiding host time.
-  dataset = _pipeline_iter(model, BATCH_SIZE)
+  # 3. e2e with the pipelined loader + DevicePrefetcher hiding host time.
+  dataset = _pipeline_iter(model, BATCH_SIZE, overlap=True)
   prefetcher = mesh_lib.DevicePrefetcher(dataset, mesh, depth=2,
-                                         max_batches=steps + 1)
+                                         max_batches=steps + 1,
+                                         close_source=True)
   f, l = next(prefetcher)  # warm
   start = time.perf_counter()
   count = 0

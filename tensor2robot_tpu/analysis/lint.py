@@ -29,7 +29,7 @@ from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
                                        native_check, spec_check,
-                                       tracer_check)
+                                       thread_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -68,6 +68,16 @@ cache rules (.py):
                          donation layout, static args) — an under-keyed
                          cache can serve a mismatched executable;
                          a `**splat` call site is accepted
+
+thread rules (.py):
+  thread-stage-missing-close     a class starts a threading.Thread but
+                         defines no close() — its worker can never be
+                         stopped/joined (the tunnel-wedging hazard);
+                         loader/stage classes must expose close()
+  thread-stage-missing-backstop  such a class has close() but neither
+                         __enter__ (context-manager use) nor a
+                         weakref.finalize backstop — an abandoned
+                         instance leaks its worker until process exit
 
 native rules (native/__init__.py ↔ native/*.cc):
   native-binding-missing a .cc source exports a `t2r_*` symbol the
@@ -124,6 +134,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(tracer_check.check_python_file(path))
     findings.extend(spec_check.check_python_file(path, mesh_axes))
     findings.extend(cache_check.check_python_file(path))
+    findings.extend(thread_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked
     # directly — the wrapper is the unit whose drift matters).

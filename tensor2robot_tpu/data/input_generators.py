@@ -49,6 +49,10 @@ class AbstractInputGenerator(abc.ABC):
     self._feature_spec: Optional[specs_lib.SpecStruct] = None
     self._label_spec: Optional[specs_lib.SpecStruct] = None
     self._preprocess_fn = None
+    # Host-overlap tuning injected by the trainer (train_eval_model's
+    # `host_overlap_workers` / `host_overlap_queue_mb` gin knobs) via
+    # `set_overlap_options` — only record-backed generators consume it.
+    self._overlap_options: dict = {}
 
   @property
   def batch_size(self) -> int:
@@ -86,6 +90,23 @@ class AbstractInputGenerator(abc.ABC):
   def set_preprocess_fn(self, preprocess_fn) -> None:
     self._preprocess_fn = preprocess_fn
 
+  def set_overlap_options(self,
+                          num_parallel_parses: Optional[int] = None,
+                          prefetch_size: Optional[int] = None,
+                          overlap: Optional[bool] = None,
+                          overlap_queue_mb: Optional[float] = None) -> None:
+    """Injects host-overlap pipeline tuning (parse worker count,
+    hand-off depth, byte caps) from the trainer — the slow-host-
+    fast-chip knobs of the pipelined loader (`data/overlap.py`).
+    None values keep the generator's own defaults; generators without
+    a record pipeline accept and ignore the call."""
+    for key, value in (("num_parallel_parses", num_parallel_parses),
+                       ("prefetch_size", prefetch_size),
+                       ("overlap", overlap),
+                       ("overlap_queue_mb", overlap_queue_mb)):
+      if value is not None:
+        self._overlap_options[key] = value
+
   def _assert_specs_initialized(self) -> None:
     if self._feature_spec is None:
       raise ValueError(
@@ -110,6 +131,9 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
                batch_size: int = 32,
                shuffle_buffer_size: int = 512,
                prefetch_size: int = 2,
+               num_parallel_parses: int = 2,
+               overlap: Optional[bool] = None,
+               overlap_queue_mb: Optional[float] = None,
                seed: Optional[int] = None,
                process_index: Optional[int] = None,
                process_count: Optional[int] = None):
@@ -118,7 +142,10 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
       raise ValueError("file_patterns must be provided.")
     self._file_patterns = file_patterns
     self._shuffle_buffer_size = shuffle_buffer_size
-    self._prefetch_size = prefetch_size
+    self.set_overlap_options(num_parallel_parses=num_parallel_parses,
+                             prefetch_size=prefetch_size,
+                             overlap=overlap,
+                             overlap_queue_mb=overlap_queue_mb)
     self._seed = seed
     # Host-sharding info is injected by the trainer (which owns the JAX
     # runtime); defaults are single-host. Querying jax.process_index() here
@@ -133,13 +160,17 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
   def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
     self._assert_specs_initialized()
     parse_fn = parsing.create_parse_fn(self._feature_spec, self._label_spec)
+    opts = self._overlap_options
     return iter(pipeline.RecordBatchPipeline(
         self._file_patterns,
         parse_fn,
         batch_size=self._batch_size,
         mode=mode,
         shuffle_buffer_size=self._shuffle_buffer_size,
-        prefetch_size=self._prefetch_size,
+        prefetch_size=opts.get("prefetch_size", 2),
+        num_parallel_parses=opts.get("num_parallel_parses", 2),
+        overlap=opts.get("overlap"),
+        overlap_queue_mb=opts.get("overlap_queue_mb"),
         seed=self._seed,
         preprocess_fn=self._preprocess_fn,
         process_index=self._process_index or 0,
@@ -338,8 +369,12 @@ class WeightedRecordInputGenerator(AbstractInputGenerator):
   def create_dataset(self, mode: str) -> Iterator[specs_lib.SpecStruct]:
     self._assert_specs_initialized()
     parse_fn = parsing.create_parse_fn(self._feature_spec, self._label_spec)
+    opts = dict(self._overlap_options)
+    kwargs = {k: opts[k] for k in ("prefetch_size", "num_parallel_parses",
+                                   "overlap", "overlap_queue_mb")
+              if k in opts}
     return iter(pipeline.WeightedRecordPipeline(
         self._groups, self._weights, parse_fn,
         batch_size=self._batch_size, mode=mode, seed=self._seed,
         shuffle_buffer_size=self._shuffle_buffer_size,
-        preprocess_fn=self._preprocess_fn))
+        preprocess_fn=self._preprocess_fn, **kwargs))

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Iterator, List, Mapping, Optional, Seque
 import numpy as np
 
 from tensor2robot_tpu import specs as specs_lib
+from tensor2robot_tpu.data import overlap as overlap_lib
 from tensor2robot_tpu.data import parsing, tfrecord
 from tensor2robot_tpu.data import stager as stager_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
@@ -266,6 +267,21 @@ class RecordBatchPipeline:
   either side, which the parity tests use. Multi-dataset zip keeps the
   per-record Python zip but streams each dataset's records through the
   native plane in record mode.
+
+  Overlap plane (`data/overlap.py`): with `overlap` on (None = auto:
+  whenever `prefetch_size` > 0), iteration returns an
+  `OverlappedLoader` — arena/record parsing runs on an ordered
+  `num_parallel_parses`-thread pool and preprocessing on its own worker
+  downstream of the staging plane, with bounded stop-aware hand-off
+  queues (`overlap_queue_mb` byte-caps the preprocessed-batch queue),
+  so the consumer only ever dequeues finished batches. Output is
+  byte-identical to the serial chain over the same record stream (same
+  seeds, same order; tests/test_overlap.py pins it). The returned
+  iterator has `close()` joining every stage thread — callers that
+  abandon iteration early (finished eval rounds) should close it; the
+  train loop's DevicePrefetcher does so on its own close.
+  `overlap=False` restores the serial generator chain, which the
+  data-bench A/B and parity tests use.
   """
 
   def __init__(self,
@@ -284,7 +300,9 @@ class RecordBatchPipeline:
                num_parallel_parses: int = 2,
                process_index: int = 0,
                process_count: int = 1,
-               use_native_stager: Optional[bool] = None):
+               use_native_stager: Optional[bool] = None,
+               overlap: Optional[bool] = None,
+               overlap_queue_mb: Optional[float] = None):
     self._parse_fn = parse_fn
     self._batch_size = batch_size
     self._mode = mode
@@ -299,6 +317,10 @@ class RecordBatchPipeline:
     self._prefetch_size = prefetch_size
     self._num_parallel_parses = num_parallel_parses
     self._use_native_stager = use_native_stager
+    self._overlap = overlap
+    self._overlap_queue_bytes = (
+        overlap_lib.DEFAULT_QUEUE_BYTES if overlap_queue_mb is None
+        else max(int(overlap_queue_mb * (1 << 20)), 1))
     self._warned_stager_unavailable = False
     dataset_keys = parse_fn.dataset_keys
     if isinstance(file_patterns, Mapping):
@@ -422,6 +444,15 @@ class RecordBatchPipeline:
         return
       epoch += 1
 
+  def _overlap_enabled(self, prefetch_size: int) -> bool:
+    """The overlap-plane decision: explicit `overlap` wins; auto (None)
+    pipelines whenever the caller wants background behavior at all
+    (`prefetch_size` > 0). `overlap=False` keeps the serial generator
+    chain — the data-bench A/B and the parity tests force it."""
+    if self._overlap is not None:
+      return self._overlap
+    return prefetch_size > 0
+
   def _assemble(self, raw: Iterator[Any],
                 prefetch_size: Optional[int] = None,
                 num_parallel_parses: Optional[int] = None
@@ -432,9 +463,21 @@ class RecordBatchPipeline:
     deterministic behavior. Shared with WeightedRecordPipeline, which
     passes its OWN `num_parallel_parses` as a parameter — overwriting
     this pipeline's attribute instead (the pre-round-6 behavior) leaked
-    the override into the template source's later iterations."""
+    the override into the template source's later iterations.
+
+    With the overlap plane on this returns an `OverlappedLoader`
+    (parse pool + preprocess worker + byte-capped hand-off queues,
+    `data/overlap.py`) whose output is byte-identical to the serial
+    chain below; otherwise the legacy chain: ordered parallel parse map
+    + serial preprocess + `prefetch` thread."""
     workers = (self._num_parallel_parses if num_parallel_parses is None
                else num_parallel_parses)
+    size = self._prefetch_size if prefetch_size is None else prefetch_size
+    if self._overlap_enabled(size):
+      return overlap_lib.OverlappedLoader(
+          iter(raw), self._parse_only, self._apply_preprocess,
+          parse_workers=max(workers, 1), depth=max(size, 1),
+          max_bytes=self._overlap_queue_bytes)
     if workers > 1:
       parsed = parallel_map_ordered(self._parse_only, raw,
                                     num_workers=workers)
@@ -442,13 +485,9 @@ class RecordBatchPipeline:
           self._apply_preprocess, parsed)
     else:
       stream = map(self._finalize, raw)
-    size = self._prefetch_size if prefetch_size is None else prefetch_size
     if size:
       stream = prefetch(stream, size)
     return stream
-
-  def _batches(self) -> Iterator[specs_lib.SpecStruct]:
-    return self._assemble(self._raw_batches(), prefetch_size=0)
 
   def _parse_only(self, batch: Any) -> specs_lib.SpecStruct:
     if isinstance(batch, stager_lib.StagedBatch):
@@ -482,10 +521,7 @@ class RecordBatchPipeline:
     return self._apply_preprocess(self._parse_only(batch))
 
   def __iter__(self) -> Iterator[specs_lib.SpecStruct]:
-    stream = self._batches()
-    if self._prefetch_size:
-      stream = prefetch(stream, self._prefetch_size)
-    return stream
+    return self._assemble(self._raw_batches())
 
 
 class WeightedRecordPipeline:

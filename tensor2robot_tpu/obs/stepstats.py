@@ -11,7 +11,16 @@ dependable way, a host fetch through `utils.backend.state_barrier`
 
 Accounting per measured window (`every_n_steps` dispatches, default 1):
 
-* `data_wait_ms`  — host time staging batches (`data_wait()` windows);
+* `data_wait_ms`  — host time staging batches (`data_wait()` windows).
+  Under the overlapped host loader (`data/overlap.py` stages feeding a
+  `DevicePrefetcher`), the loop's `data_wait()` wraps only the DEQUEUE
+  of an already-placed batch, so parse/preprocess/place work running in
+  worker threads concurrently with device compute inflates NEITHER
+  `data_wait_ms` NOR `device_ms` (pinned by the synthetic
+  overlapped-producer test in tests/test_overlap.py): a near-zero
+  `data_wait_ms` with healthy throughput means the pipeline keeps up,
+  and a growing one means the consumer outran it — read the
+  `data/overlap_*` stage timings to see which stage binds;
 * `device_ms`     — un-overlapped device wait: dispatch-call time plus
   the closing barrier fetch. Host staging that overlaps device compute
   is deliberately NOT charged to the device — the split answers "what

@@ -150,7 +150,7 @@ def place_batch(mesh: Mesh, batch, batch_spec=None):
 
 
 class DevicePrefetcher:
-  """Background-thread device infeed: parses AND places batches ahead.
+  """Background-thread device infeed: places finished host batches ahead.
 
   The train loop's async dispatch already overlaps ONE host batch with
   device compute; on a slow host feeding a fast chip that single step of
@@ -159,28 +159,59 @@ class DevicePrefetcher:
   iterator in a daemon thread that keeps up to `depth` batches already
   resident on device (the JAX-native replacement for TPUEstimator's
   per-host infeed threads, /root/reference/models/tpu_model_wrapper.py
-  infeed path).
+  infeed path). It is also the device-side consumer of the pipelined
+  host loader (`data/overlap.py`): upstream stages hand it finished
+  numpy batches, it pays only the device transfer.
 
   Iterating yields (features, labels) pairs already placed with
-  `put_host_batch`. Exceptions in the worker re-raise in the consumer;
-  `close()` (also called on exhaustion) stops the worker promptly.
-  `close()` is MANDATORY for library users — an abandoned prefetcher
-  pins `depth` device-resident batches until its finalizer runs. The
-  context-manager protocol closes on exit; a `weakref.finalize` backstop
-  stops the worker of a collected-but-unclosed instance.
+  `put_host_batch` — or, with a custom `place_fn`, whatever that
+  returns (the train loop's stacked-group path places K-step groups
+  under the loop spec; the bench data probe device_puts to one device).
+  Exceptions in the worker re-raise in the consumer; `close()` (also
+  called on exhaustion) stops the worker promptly, and with
+  `close_source` also closes a closable `dataset` (e.g. an
+  `OverlappedLoader`, joining its stage threads) once the worker is
+  down. `close()` is MANDATORY for library users — an abandoned
+  prefetcher pins `depth` device-resident batches until its finalizer
+  runs. The context-manager protocol closes on exit; a
+  `weakref.finalize` backstop stops the worker of a
+  collected-but-unclosed instance.
+
+  graftscope telemetry: `data/overlap_place_ms` (device-placement time
+  per batch, worker-side) and `data/overlap_device_queue_depth`
+  (device-resident batches ready) ride the standard registry into
+  runs.jsonl with the host-stage `data/overlap_*` metrics.
   """
 
   _STOP = object()
 
-  def __init__(self, dataset, mesh: Mesh, batch_spec=None,
-               depth: int = 2, max_batches: Optional[int] = None):
+  def __init__(self, dataset, mesh: Optional[Mesh] = None, batch_spec=None,
+               depth: int = 2, max_batches: Optional[int] = None,
+               place_fn=None, close_source: bool = False, source=None):
     import itertools
     import queue
     import threading
+    import time as time_lib
     import weakref
+
+    from tensor2robot_tpu.obs import metrics as obs_metrics
 
     if depth < 1:
       raise ValueError(f"depth must be >= 1, got {depth}")
+    if place_fn is None:
+      if mesh is None:
+        raise ValueError("DevicePrefetcher needs a mesh (default "
+                         "place_batch) or an explicit place_fn.")
+      place_fn = lambda batch: place_batch(mesh, batch,  # noqa: E731
+                                           batch_spec=batch_spec)
+    # What close() closes under close_source: by default the dataset
+    # itself; pass `source=` when `dataset` is a derived generator and
+    # the closable thing is the loader BEHIND it — a generator that is
+    # mid-`next` in the worker thread cannot be closed from another
+    # thread (ValueError: generator already executing), while a loader
+    # close is thread-safe and unsticks the worker.
+    self._source = (source if source is not None else dataset) \
+        if close_source else None
     if max_batches is not None:
       # Bound the worker to what the consumer will actually take —
       # otherwise it eagerly parses + device-places `depth` extra batches
@@ -189,7 +220,7 @@ class DevicePrefetcher:
     out_queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
     # Worker phase, readable by close(): "source" while blocked in
-    # next(dataset), "transfer" during place_batch (an in-flight TPU
+    # next(dataset), "transfer" during place_fn (an in-flight TPU
     # op — NEVER safe to abandon over the axon tunnel), "queue"/"done"
     # otherwise. A plain one-slot list: writes are atomic under the GIL.
     phase = ["source"]
@@ -198,6 +229,9 @@ class DevicePrefetcher:
     self._phase = phase
     self._done = False
     sentinel = self._STOP
+    place_hist = obs_metrics.histogram("data/overlap_place_ms")
+    depth_gauge = obs_metrics.gauge("data/overlap_device_queue_depth")
+    perf_counter_ns = time_lib.perf_counter_ns
 
     # The worker closes over locals only — never `self` — so an
     # abandoned-without-close() prefetcher is actually collectable (the
@@ -215,22 +249,24 @@ class DevicePrefetcher:
       try:
         for batch in dataset:
           if stop.is_set():
-            # Checked between next(dataset) and place_batch so a stop
+            # Checked between next(dataset) and place_fn so a stop
             # requested while the source was producing skips the device
             # transfer and exits without touching the queue.
             return
           phase[0] = "transfer"
-          features, labels = place_batch(mesh, batch,
-                                         batch_spec=batch_spec)
+          t0 = perf_counter_ns()
+          placed = place_fn(batch)
+          place_hist.record((perf_counter_ns() - t0) * 1e-6)
           phase[0] = "queue"
           while not stop.is_set():
             try:
-              out_queue.put((features, labels), timeout=0.1)
+              out_queue.put(placed, timeout=0.1)
               break
             except queue.Full:
               continue
           if stop.is_set():
             return
+          depth_gauge.set(float(out_queue.qsize()))
           phase[0] = "source"
         _put_final(sentinel)
       except BaseException as e:  # noqa: BLE001 - surfaced to consumer
@@ -290,6 +326,7 @@ class DevicePrefetcher:
     while True:
       self._thread.join(timeout=1.0)
       if not self._thread.is_alive():
+        self._close_source()
         return
       if self._phase[0] == "transfer":
         deadline = None  # device op in flight: wait it out, full stop
@@ -300,12 +337,44 @@ class DevicePrefetcher:
         deadline = time.monotonic() + timeout
       elif time.monotonic() >= deadline:
         break
+    # Stalled inside next(dataset): closing a closable source (e.g. an
+    # OverlappedLoader — its get() watches the loader's own stop event)
+    # is exactly what unsticks the worker, so try that before giving up
+    # on the thread (only when this prefetcher actually owns a source).
+    if self._close_source():
+      self._thread.join(timeout=5.0)
+      if not self._thread.is_alive():
+        return
     from absl import logging
 
     logging.error(
         "DevicePrefetcher.close(): worker still alive after %.0fs in "
         "phase %r — blocked in next(dataset) on a stalled data source; "
         "abandoning the daemon thread.", timeout, self._phase[0])
+
+  def _close_source(self) -> bool:
+    """Closes a `close_source=True` source exactly once (best-effort:
+    teardown must not mask the consumer's own error path). Returns
+    True when the close succeeded (so close() knows a stalled worker
+    may now be unstuck and a short rejoin is worth it)."""
+    source, self._source = self._source, None
+    if source is None or not hasattr(source, "close"):
+      return False
+    try:
+      source.close()
+      return True
+    except ValueError:
+      # A plain generator currently executing in the worker thread:
+      # not closable from here (and closing it would not unstick
+      # anything anyway). Expected on the stalled path when no
+      # loader-backed `source=` was provided.
+      return False
+    except Exception:  # noqa: BLE001
+      from absl import logging
+
+      logging.exception("DevicePrefetcher: closing the data source "
+                        "failed")
+      return False
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

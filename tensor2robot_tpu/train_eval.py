@@ -98,6 +98,16 @@ def _device_batch(mesh, batch, batch_spec=None):
   return mesh_lib.place_batch(mesh, batch, batch_spec=batch_spec)
 
 
+def _close_dataset(dataset) -> None:
+  """Closes a closable batch source (an `OverlappedLoader`'s stage
+  threads, a generator's frame) — best-effort, never raises."""
+  if dataset is not None and hasattr(dataset, "close"):
+    try:
+      dataset.close()
+    except Exception:  # noqa: BLE001 - teardown must not mask errors
+      logging.exception("train_eval: closing a data source failed")
+
+
 def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
               batch_spec=None, prefetch_depth: int = 2,
               eval_loop=None, eval_loop_k: int = 1):
@@ -146,7 +156,7 @@ def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
   if prefetch_depth:
     batches = mesh_lib.DevicePrefetcher(
         dataset, mesh, batch_spec=batch_spec, depth=prefetch_depth,
-        max_batches=remaining)
+        max_batches=remaining, close_source=True)
   else:
     batches = (_device_batch(mesh, b, batch_spec) for b in dataset)
   try:
@@ -159,7 +169,9 @@ def _run_eval(eval_step, state, dataset: Iterator, mesh, eval_steps: int,
       _accumulate(metrics, 1)
   finally:
     if prefetch_depth:
-      batches.close()
+      batches.close()  # also closes `dataset` (close_source)
+    else:
+      _close_dataset(dataset)
   return {k: float(np.asarray(v)) / max(count, 1)
           for k, v in totals.items()}
 
@@ -189,6 +201,8 @@ def train_eval_model(
     use_ema_for_eval: bool = True,
     log_every_n_steps: int = 100,
     device_prefetch_depth: int = 2,
+    host_overlap_workers: Optional[int] = None,
+    host_overlap_queue_mb: Optional[float] = None,
     iterations_per_loop: int = 1,
     step_stats_every_n_steps: Optional[int] = None,
     enable_sentinel: bool = True,
@@ -196,6 +210,20 @@ def train_eval_model(
     executable_cache_dir: Optional[str] = "auto",
 ) -> dict:
   """Runs the requested mode; returns final metrics.
+
+  Host data plane (`data/overlap.py` + `parallel.mesh.DevicePrefetcher`):
+  the record chain (stager arena -> parse -> preprocess) runs as
+  overlapped pipeline stages inside the input generator's loader, and
+  the train loop consumes batches that a background worker has ALREADY
+  placed on device — the loop thread only dequeues. Tuning knobs, all
+  gin-configurable for slow-host-fast-chip deployments:
+  `device_prefetch_depth` device-resident batches held ahead (in
+  `iterations_per_loop` mode each held item is a K-step GROUP — budget
+  HBM accordingly; 0 restores inline staging), `host_overlap_workers`
+  parse worker threads, `host_overlap_queue_mb` byte-cap on the
+  preprocessed-batch hand-off queue (None keeps the generator's
+  defaults). Per-stage `data/overlap_*` timings + queue depths land in
+  the run's registry snapshot and runs.jsonl record.
 
   `iterations_per_loop` > 1 dispatches K train steps per host round trip
   via the on-device scan loop (`train_step.make_train_loop`) — the
@@ -266,13 +294,40 @@ def train_eval_model(
   # when telemetry is on. "auto" keys the cache to the model_dir so
   # restarts warm up by themselves.
   executable_cache = None
+  xla_tier_skipped_train = False
   if executable_cache_dir:
     cache_dir = (os.path.join(model_dir, "excache")
                  if executable_cache_dir == "auto"
                  else executable_cache_dir)
     try:
       executable_cache = excache_lib.ExecutableCache(cache_dir)
-      excache_lib.enable_xla_cache(cache_dir)
+      if mode in ("evaluate", "continuous_eval"):
+        excache_lib.enable_xla_cache(cache_dir)
+      else:
+        # Training modes must NOT arm the XLA persistent-cache tier on
+        # this jax (0.4.37): once a process has LOADED any executable
+        # from a warm XLA cache (e.g. the param-init compile on a
+        # resume), the next donating mesh-typed dispatch — the train
+        # step — corrupts the heap (measured: deterministic SIGSEGV on
+        # the checkpoint-resume path, the XLA-tier sibling of
+        # excache.aot_cache_unsafe). Eval-only runs never dispatch a
+        # donating executable, so they keep the tier; trainers keep the
+        # serialized tier-1 cache, which validates its entries and
+        # skips donating-mesh executables by the same guard. The
+        # counter is bumped AFTER the per-run registry reset below so
+        # it survives into the run record. DISARM explicitly, not just
+        # skip: jax_compilation_cache_dir is process-global, so an
+        # eval-mode run (or external config) earlier in this process
+        # may have armed it — training with it live is the SIGSEGV.
+        try:
+          jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:  # noqa: BLE001 - config knob may not exist
+          pass
+        xla_tier_skipped_train = True
+        logging.info(
+            "graftcache: XLA compilation-cache tier left OFF for "
+            "training mode %r (donating-mesh resume SIGSEGV guard); "
+            "the serialized tier at %s stays armed.", mode, cache_dir)
     except Exception:  # noqa: BLE001 - caching never takes down a run
       logging.exception("graftcache: cache setup failed; compiling fresh")
   if mesh is None:
@@ -303,51 +358,16 @@ def train_eval_model(
   # -- data + state bring-up -----------------------------------------------
   needs_train = mode in ("train", "train_and_evaluate")
   needs_eval = mode != "train"
-  train_dataset = eval_dataset = None
-  if needs_train:
-    if input_generator_train is None:
-      raise ValueError("input_generator_train is required for training.")
-    provide_input_generator_with_model_information(
-        input_generator_train, model, modes_lib.TRAIN)
-    train_dataset = input_generator_train.create_dataset(modes_lib.TRAIN)
-  if needs_eval:
-    if input_generator_eval is None:
-      raise ValueError("input_generator_eval is required for evaluation.")
-    provide_input_generator_with_model_information(
-        input_generator_eval, model, modes_lib.EVAL)
-
-  if train_dataset is not None:
-    first_batch = next(train_dataset)
-    sample_features = first_batch["features"]
-  else:
-    # Eval-only modes: synthesize an init batch from the preprocessor's
-    # out-specs instead of spinning up (and leaking) a data pipeline.
-    first_batch = None
-    sample_features = specs_lib.make_random_numpy(
-        model.preprocessor.get_out_feature_specification(modes_lib.EVAL),
-        batch_size=input_generator_eval.batch_size, seed=seed)
-
-  state, shardings = ts.create_train_state(
-      model, jax.random.PRNGKey(seed), sample_features, mesh=mesh,
-      rules=partition_rules)
-  restored_step = manager.latest_step()
-  if restored_step is None and model.init_checkpoint:
-    # Warm start from a foreign checkpoint (pretrained towers etc.);
-    # only on fresh runs — a resume keeps its own weights.
-    merged, restored_paths = checkpoints_lib.warm_start_params(
-        jax.device_get(state.params), model.init_checkpoint,
-        filter_fn=model.init_checkpoint_filter)
-    state = state.replace(params=jax.device_put(
-        merged, jax.tree_util.tree_map(lambda x: x.sharding, state.params)))
-    logging.info("Warm-started %d param arrays from %s",
-                 len(restored_paths), model.init_checkpoint)
-  if restored_step is not None:
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                       sharding=x.sharding), state)
-    state = manager.restore(restored_step, abstract_state=abstract)
-    logging.info("Resumed from checkpoint step %d", restored_step)
-
+  if needs_train and input_generator_train is None:
+    raise ValueError("input_generator_train is required for training.")
+  if needs_eval and input_generator_eval is None:
+    raise ValueError("input_generator_eval is required for evaluation.")
+  # Host-overlap tuning flows trainer -> generator -> RecordBatchPipeline
+  # (generators without a record pipeline accept and ignore it).
+  for gen in (input_generator_train, input_generator_eval):
+    if gen is not None and hasattr(gen, "set_overlap_options"):
+      gen.set_overlap_options(num_parallel_parses=host_overlap_workers,
+                              overlap_queue_mb=host_overlap_queue_mb)
   if step_stats_every_n_steps is None:
     # Per-step barriers are ~free on CPU; over the axon tunnel each
     # measured window costs a ~0.1 s host fetch AND serializes the
@@ -358,174 +378,246 @@ def train_eval_model(
   step_stats = stepstats_lib.StepStatsRecorder(
       batch_size=(input_generator_train.batch_size if needs_train else 0),
       every_n_steps=step_stats_every_n_steps if needs_train else 0)
-  run_memory: dict = {}
-  sentinel = flight_recorder = None
   if step_stats.enabled:
-    hooks.append(hooks_lib.StepStatsHook())
-    if enable_sentinel:
-      # Online third leg of graftscope: sentinel rides the stepstats
-      # cadence (observer below — zero extra barriers/round trips) and
-      # fans incidents out to incidents.jsonl + the flight recorder,
-      # whose ring buffers back the postmortem bundle on crash/SIGTERM/
-      # hang/fatal incident.
-      flight_recorder = flightrec_lib.FlightRecorder(
-          os.path.join(model_dir, flightrec_lib.FLIGHTREC_DIRNAME),
-          hang_timeout_secs=watchdog_timeout_secs)
-      incidents_path = os.path.join(model_dir,
-                                    runlog_lib.INCIDENTS_FILENAME)
-      sentinel = sentinel_lib.Sentinel(sinks=[
-          lambda record: runlog_lib.append_record(incidents_path, record),
-          flight_recorder.record_incident])
-      # Order matters: the recorder must ring a window BEFORE the
-      # sentinel sees it — a fatal incident dumps the bundle
-      # synchronously from the sentinel's sink, and the bundle must
-      # include the very window that triggered it.
-      step_stats.add_observer(flight_recorder.record_step)
-      step_stats.add_observer(sentinel.observe_step_record)
-      hooks.append(hooks_lib.SentinelHook())
     # Per-run telemetry: clear the process-global trace buffer, metrics
     # registry and xray compile-record collector so the saved trace,
     # final snapshot and run record cover exactly this run (the tracer
     # itself is enabled inside the train loop's try so any exit path
-    # disables it again).
+    # disables it again). This MUST precede data-pipeline spin-up: the
+    # overlapped loader and prefetcher cache their histogram objects at
+    # construction, and a later registry reset would orphan them — the
+    # run's data/overlap_* stage attribution would silently vanish from
+    # the final snapshot.
     trace_lib.clear()
     metrics_registry_lib.reset()
     xray_lib.clear_records()
-    try:
-      run_memory = xray_lib.memory_accounting(
-          state, batch=first_batch,
-          num_data_shards=int(mesh.shape.get("data", mesh.devices.size)))
-    except Exception:  # noqa: BLE001 - telemetry never kills a run
-      logging.exception("graftscope-xray: memory accounting failed")
+  if xla_tier_skipped_train:
+    # After the reset (when telemetry is on) so the SIGSEGV-guard
+    # telemetry actually reaches the final snapshot and run record.
+    metrics_registry_lib.counter("cache/xla_tier_skipped_train_mode").inc()
+  train_dataset = eval_dataset = None
+  if needs_train:
+    provide_input_generator_with_model_information(
+        input_generator_train, model, modes_lib.TRAIN)
+    train_dataset = input_generator_train.create_dataset(modes_lib.TRAIN)
+  # The loader behind the (possibly itertools-wrapped) train stream —
+  # closed in the loop's finally so its stage threads never outlive the
+  # run.
+  raw_train_dataset = train_dataset
+  if needs_eval:
+    provide_input_generator_with_model_information(
+        input_generator_eval, model, modes_lib.EVAL)
 
-  ctx = hooks_lib.TrainContext(model, model_dir,
-                               get_state=lambda: state,
-                               summary_writer=writer, mesh=mesh,
-                               step_stats=(step_stats if step_stats.enabled
-                                           else None),
-                               sentinel=sentinel,
-                               flight_recorder=flight_recorder)
-  for hook in hooks:
-    hook.begin(ctx)
+  # Everything between data-pipeline spin-up and the train loop's
+  # own try/finally (which owns the loader from there on): a
+  # failure here — unreadable first batch, corrupted checkpoint
+  # restore, a step-factory trace error, a hook.begin crash —
+  # must close the loader's stage threads rather than leak them
+  # to GC (the zero-leaked-threads discipline the thread-stage
+  # lint rules mechanize). Eval-only modes return from inside
+  # this block normally; their train loader is None.
+  try:
+    if train_dataset is not None:
+      first_batch = next(train_dataset)
+      sample_features = first_batch["features"]
+    else:
+      # Eval-only modes: synthesize an init batch from the preprocessor's
+      # out-specs instead of spinning up (and leaking) a data pipeline.
+      first_batch = None
+      sample_features = specs_lib.make_random_numpy(
+          model.preprocessor.get_out_feature_specification(modes_lib.EVAL),
+          batch_size=input_generator_eval.batch_size, seed=seed)
 
-  final_metrics: dict = {}
-  saved_steps = set(manager.all_steps())
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(seed), sample_features, mesh=mesh,
+        rules=partition_rules)
+    restored_step = manager.latest_step()
+    if restored_step is None and model.init_checkpoint:
+      # Warm start from a foreign checkpoint (pretrained towers etc.);
+      # only on fresh runs — a resume keeps its own weights.
+      merged, restored_paths = checkpoints_lib.warm_start_params(
+          jax.device_get(state.params), model.init_checkpoint,
+          filter_fn=model.init_checkpoint_filter)
+      state = state.replace(params=jax.device_put(
+          merged, jax.tree_util.tree_map(lambda x: x.sharding, state.params)))
+      logging.info("Warm-started %d param arrays from %s",
+                   len(restored_paths), model.init_checkpoint)
+    if restored_step is not None:
+      abstract = jax.tree_util.tree_map(
+          lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                         sharding=x.sharding), state)
+      state = manager.restore(restored_step, abstract_state=abstract)
+      logging.info("Resumed from checkpoint step %d", restored_step)
 
-  def _checkpoint(step: int, force: bool = False) -> None:
-    if step in saved_steps:
-      return
-    if manager.save(step, state, force=force):
-      saved_steps.add(step)
-      for hook in hooks:
-        hook.after_checkpoint(ctx, step)
-
-  # -- evaluate-only modes --------------------------------------------------
-  batch_spec = getattr(model, "batch_partition_spec", None)
-  # Eval twin of iterations_per_loop: K eval batches per dispatch,
-  # summed on device (built lazily so train-only runs pay no compile).
-  eval_loop_k = max(1, min(int(iterations_per_loop), int(eval_steps)))
-  _eval_loop_cache: list = []
-
-  def _eval_loop():
-    if eval_loop_k <= 1:
-      return None
-    if not _eval_loop_cache:
-      _eval_loop_cache.append(ts.make_eval_loop(
-          model, eval_loop_k, mesh=mesh, shardings=shardings,
-          batch_spec=batch_spec, use_ema=use_ema_for_eval))
-    return _eval_loop_cache[0]
-
-  if mode == "evaluate":
-    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
-                                  batch_spec=batch_spec,
-                                  use_ema=use_ema_for_eval)
-    eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
-    final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                              eval_steps, batch_spec,
-                              prefetch_depth=device_prefetch_depth,
-                              eval_loop=_eval_loop(),
-                              eval_loop_k=eval_loop_k)
-    writer.write_scalars(int(state.step), final_metrics)
-    for hook in hooks:
-      hook.after_eval(ctx, int(state.step), final_metrics)
-      hook.end(ctx)
-    manager.close()
-    writer.close()
-    return final_metrics
-
-  if mode == "continuous_eval":
-    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
-                                  batch_spec=batch_spec,
-                                  use_ema=use_ema_for_eval)
-    ckpt_dir = os.path.join(model_dir, CHECKPOINT_DIRNAME)
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                       sharding=x.sharding), state)
-    for step in checkpoints_lib.checkpoints_iterator(
-        ckpt_dir, timeout_secs=5.0,
-        total_timeout_secs=continuous_eval_timeout_secs):
-      # Copy the checkpoint out of the writer's GC reach, restore from the
-      # copy, delete it when the eval is done (reference :616-684).
-      backup = checkpoints_lib.backup_checkpoint(ckpt_dir, step)
+    run_memory: dict = {}
+    sentinel = flight_recorder = None
+    if step_stats.enabled:
+      hooks.append(hooks_lib.StepStatsHook())
+      if enable_sentinel:
+        # Online third leg of graftscope: sentinel rides the stepstats
+        # cadence (observer below — zero extra barriers/round trips) and
+        # fans incidents out to incidents.jsonl + the flight recorder,
+        # whose ring buffers back the postmortem bundle on crash/SIGTERM/
+        # hang/fatal incident.
+        flight_recorder = flightrec_lib.FlightRecorder(
+            os.path.join(model_dir, flightrec_lib.FLIGHTREC_DIRNAME),
+            hang_timeout_secs=watchdog_timeout_secs)
+        incidents_path = os.path.join(model_dir,
+                                      runlog_lib.INCIDENTS_FILENAME)
+        sentinel = sentinel_lib.Sentinel(sinks=[
+            lambda record: runlog_lib.append_record(incidents_path, record),
+            flight_recorder.record_incident])
+        # Order matters: the recorder must ring a window BEFORE the
+        # sentinel sees it — a fatal incident dumps the bundle
+        # synchronously from the sentinel's sink, and the bundle must
+        # include the very window that triggered it.
+        step_stats.add_observer(flight_recorder.record_step)
+        step_stats.add_observer(sentinel.observe_step_record)
+        hooks.append(hooks_lib.SentinelHook())
       try:
-        if backup is not None:
-          backup_manager = checkpoints_lib.CheckpointManager(
-              os.path.dirname(backup), async_checkpointing=False)
-          state = backup_manager.restore(step, abstract_state=abstract)
-          backup_manager.close()
-        else:
-          state = manager.restore(step, abstract_state=abstract)
-        eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
-        final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
-                                  eval_steps, batch_spec,
-                                  prefetch_depth=device_prefetch_depth,
-                                  eval_loop=_eval_loop(),
-                                  eval_loop_k=eval_loop_k)
-      finally:
-        if backup is not None:
-          import shutil
+        run_memory = xray_lib.memory_accounting(
+            state, batch=first_batch,
+            num_data_shards=int(mesh.shape.get("data", mesh.devices.size)))
+      except Exception:  # noqa: BLE001 - telemetry never kills a run
+        logging.exception("graftscope-xray: memory accounting failed")
 
-          shutil.rmtree(backup, ignore_errors=True)
-      writer.write_scalars(step, final_metrics)
-      for hook in hooks:
-        hook.after_eval(ctx, step, final_metrics)
-      logging.info("continuous eval @%d: %s", step, final_metrics)
-      if step >= max_train_steps:
-        break
+    ctx = hooks_lib.TrainContext(model, model_dir,
+                                 get_state=lambda: state,
+                                 summary_writer=writer, mesh=mesh,
+                                 step_stats=(step_stats if step_stats.enabled
+                                             else None),
+                                 sentinel=sentinel,
+                                 flight_recorder=flight_recorder)
     for hook in hooks:
-      hook.end(ctx)
-    manager.close()
-    writer.close()
-    return final_metrics
+      hook.begin(ctx)
 
-  # -- training loop --------------------------------------------------------
-  train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
-                                  batch_spec=batch_spec)
-  loop_k = max(1, int(iterations_per_loop))
-  train_loop = loop_spec = None
-  if loop_k > 1:
-    train_loop = ts.make_train_loop(model, loop_k, mesh=mesh,
-                                    shardings=shardings,
+    final_metrics: dict = {}
+    saved_steps = set(manager.all_steps())
+
+    def _checkpoint(step: int, force: bool = False) -> None:
+      if step in saved_steps:
+        return
+      if manager.save(step, state, force=force):
+        saved_steps.add(step)
+        for hook in hooks:
+          hook.after_checkpoint(ctx, step)
+
+    # -- evaluate-only modes --------------------------------------------------
+    batch_spec = getattr(model, "batch_partition_spec", None)
+    # Eval twin of iterations_per_loop: K eval batches per dispatch,
+    # summed on device (built lazily so train-only runs pay no compile).
+    eval_loop_k = max(1, min(int(iterations_per_loop), int(eval_steps)))
+    _eval_loop_cache: list = []
+
+    def _eval_loop():
+      if eval_loop_k <= 1:
+        return None
+      if not _eval_loop_cache:
+        _eval_loop_cache.append(ts.make_eval_loop(
+            model, eval_loop_k, mesh=mesh, shardings=shardings,
+            batch_spec=batch_spec, use_ema=use_ema_for_eval))
+      return _eval_loop_cache[0]
+
+    if mode == "evaluate":
+      eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                    batch_spec=batch_spec,
+                                    use_ema=use_ema_for_eval)
+      eval_loop = _eval_loop()  # compile (or fetch) BEFORE the
+      # dataset spins up its loader threads: a compile failure must
+      # not leak a just-created loader.
+      eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+      final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                                eval_steps, batch_spec,
+                                prefetch_depth=device_prefetch_depth,
+                                eval_loop=eval_loop,
+                                eval_loop_k=eval_loop_k)
+      writer.write_scalars(int(state.step), final_metrics)
+      for hook in hooks:
+        hook.after_eval(ctx, int(state.step), final_metrics)
+        hook.end(ctx)
+      manager.close()
+      writer.close()
+      return final_metrics
+
+    if mode == "continuous_eval":
+      eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                    batch_spec=batch_spec,
+                                    use_ema=use_ema_for_eval)
+      ckpt_dir = os.path.join(model_dir, CHECKPOINT_DIRNAME)
+      abstract = jax.tree_util.tree_map(
+          lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                         sharding=x.sharding), state)
+      for step in checkpoints_lib.checkpoints_iterator(
+          ckpt_dir, timeout_secs=5.0,
+          total_timeout_secs=continuous_eval_timeout_secs):
+        # Copy the checkpoint out of the writer's GC reach, restore from the
+        # copy, delete it when the eval is done (reference :616-684).
+        backup = checkpoints_lib.backup_checkpoint(ckpt_dir, step)
+        try:
+          if backup is not None:
+            backup_manager = checkpoints_lib.CheckpointManager(
+                os.path.dirname(backup), async_checkpointing=False)
+            state = backup_manager.restore(step, abstract_state=abstract)
+            backup_manager.close()
+          else:
+            state = manager.restore(step, abstract_state=abstract)
+          eval_loop = _eval_loop()  # compile (or fetch) BEFORE the
+          # dataset spins up its loader threads: a compile failure must
+          # not leak a just-created loader.
+          eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
+          final_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
+                                    eval_steps, batch_spec,
+                                    prefetch_depth=device_prefetch_depth,
+                                    eval_loop=eval_loop,
+                                    eval_loop_k=eval_loop_k)
+        finally:
+          if backup is not None:
+            import shutil
+
+            shutil.rmtree(backup, ignore_errors=True)
+        writer.write_scalars(step, final_metrics)
+        for hook in hooks:
+          hook.after_eval(ctx, step, final_metrics)
+        logging.info("continuous eval @%d: %s", step, final_metrics)
+        if step >= max_train_steps:
+          break
+      for hook in hooks:
+        hook.end(ctx)
+      manager.close()
+      writer.close()
+      return final_metrics
+
+    # -- training loop --------------------------------------------------------
+    train_step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
                                     batch_spec=batch_spec)
-    loop_spec = ts.loop_batch_spec(batch_spec)
-  if step_stats.enabled:
-    # Compile telemetry (obs.xray): the first dispatch AOT-compiles
-    # through analyze_jit — per-executable compile time, jaxpr size,
-    # donation bytes, XLA cost/memory analysis into the run record —
-    # and every later call runs the SAME executable (no double compile;
-    # any failure degrades to the plain jitted fn).
-    train_step = xray_lib.XrayedFunction("train_step", train_step,
-                                         cache=executable_cache)
-    if train_loop is not None:
-      train_loop = xray_lib.XrayedFunction(f"train_loop_k{loop_k}",
-                                           train_loop,
+    loop_k = max(1, int(iterations_per_loop))
+    train_loop = loop_spec = None
+    if loop_k > 1:
+      train_loop = ts.make_train_loop(model, loop_k, mesh=mesh,
+                                      shardings=shardings,
+                                      batch_spec=batch_spec)
+      loop_spec = ts.loop_batch_spec(batch_spec)
+    if step_stats.enabled:
+      # Compile telemetry (obs.xray): the first dispatch AOT-compiles
+      # through analyze_jit — per-executable compile time, jaxpr size,
+      # donation bytes, XLA cost/memory analysis into the run record —
+      # and every later call runs the SAME executable (no double compile;
+      # any failure degrades to the plain jitted fn).
+      train_step = xray_lib.XrayedFunction("train_step", train_step,
                                            cache=executable_cache)
-  eval_step = None
-  if mode == "train_and_evaluate":
-    eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
-                                  batch_spec=batch_spec,
-                                  use_ema=use_ema_for_eval)
+      if train_loop is not None:
+        train_loop = xray_lib.XrayedFunction(f"train_loop_k{loop_k}",
+                                             train_loop,
+                                             cache=executable_cache)
+    eval_step = None
+    if mode == "train_and_evaluate":
+      eval_step = ts.make_eval_step(model, mesh=mesh, shardings=shardings,
+                                    batch_spec=batch_spec,
+                                    use_ema=use_ema_for_eval)
 
+  except BaseException:
+    _close_dataset(raw_train_dataset)
+    raise
   step = int(state.step)
   last_log = time.time()
   last_eval_time = 0.0
@@ -583,6 +675,43 @@ def train_eval_model(
     return (mesh_lib.place_batch(mesh, _next_host(stream),
                                  batch_spec=batch_spec), 1)
 
+  def _host_items(budget: int, stream):
+    """Host-side producer for the DevicePrefetcher: yields (batch, k)
+    via the SAME `_stacked_group` the inline path uses — stacked loop_k
+    groups while the step budget allows (the np.stack runs HERE, in the
+    prefetcher worker, overlapped with device compute), singles
+    otherwise, including batches parked by a mid-group StopIteration.
+    Ends at budget exhaustion (the loop stops consuming exactly then)
+    or stream end (surfaces as the documented StopIteration exhaustion
+    contract). Runs ONLY in the prefetcher worker, so
+    pending_host_batches stays single-threaded."""
+    while budget > 0:
+      if (train_loop is not None and budget >= loop_k
+          and not pending_host_batches):
+        try:
+          stacked = _stacked_group(stream, loop_k)
+        except StopIteration:  # empty group at a boundary: stream done
+          return
+        if stacked is not None:
+          yield stacked, loop_k
+          budget -= loop_k
+          continue
+        # None = mid-group park: drain pending as singles below.
+      try:
+        batch = _next_host(stream)
+      except StopIteration:
+        return
+      yield batch, 1
+      budget -= 1
+
+  def _place_item(item):
+    """Prefetcher-side placement: K-step groups under the loop spec,
+    singles under the step spec — the shared `place_batch` either way
+    (runs in the worker's tunnel-safe 'transfer' phase)."""
+    batch, k = item
+    return (mesh_lib.place_batch(
+        mesh, batch, batch_spec=loop_spec if k > 1 else batch_spec), k)
+
   try:
     if step_stats.enabled:
       trace_lib.enable()
@@ -607,10 +736,20 @@ def train_eval_model(
         with step_stats.data_wait():
           placed = _device_batch(mesh, first_batch, batch_spec)
         placed_k = 1
-        if device_prefetch_depth:
-          prefetcher = mesh_lib.DevicePrefetcher(
-              train_dataset, mesh, batch_spec=batch_spec,
-              depth=device_prefetch_depth)
+      if device_prefetch_depth:
+        # One prefetcher for BOTH dispatch shapes: the host producer
+        # yields (batch, k) per the same grouping rules the inline path
+        # uses, the worker stacks + places them overlapped with device
+        # compute, and the loop thread only dequeues. In loop mode each
+        # queued item is a K-step group. `source=` points close() at
+        # the LOADER behind the producer generator: a generator
+        # mid-next cannot be closed from another thread, while closing
+        # the loader (its dequeue watches the loader's own stop event)
+        # is exactly what unsticks a worker stalled in next(dataset).
+        prefetcher = mesh_lib.DevicePrefetcher(
+            _host_items(max_train_steps - step - placed_k, train_dataset),
+            mesh, place_fn=_place_item, depth=device_prefetch_depth,
+            close_source=True, source=raw_train_dataset)
     last_log_step = step
     while step < max_train_steps:
       if flight_recorder is not None:
@@ -637,9 +776,14 @@ def train_eval_model(
       if step < max_train_steps:
         try:
           if prefetcher is not None:
+            # The worker already parsed, stacked AND placed this item
+            # while the device ran the previous dispatch: data_wait_ms
+            # here is pure dequeue wait (0 in steady state = the host
+            # keeps up; growing = the pipeline is the bottleneck —
+            # read the data/overlap_* stage timings to see which
+            # stage).
             with step_stats.data_wait():
-              placed = next(prefetcher)
-            placed_k = 1
+              placed, placed_k = next(prefetcher)
           else:
             with step_stats.data_wait():
               placed, placed_k = _place_next(max_train_steps - step,
@@ -697,11 +841,14 @@ def train_eval_model(
                      and now - last_eval_time < eval_throttle_secs)
         if not throttled:
           last_eval_time = now
+          eval_loop = _eval_loop()  # compile (or fetch) BEFORE the
+          # dataset spins up its loader threads: a compile failure must
+          # not leak a just-created loader.
           eval_dataset = input_generator_eval.create_dataset(modes_lib.EVAL)
           eval_metrics = _run_eval(eval_step, state, eval_dataset, mesh,
                                    eval_steps, batch_spec,
                                    prefetch_depth=device_prefetch_depth,
-                                   eval_loop=_eval_loop(),
+                                   eval_loop=eval_loop,
                                    eval_loop_k=eval_loop_k)
           writer.write_scalars(step, {f"eval/{k}": v
                                       for k, v in eval_metrics.items()})
@@ -739,7 +886,11 @@ def train_eval_model(
     if step_stats.enabled:
       trace_lib.disable()
     if prefetcher is not None:
-      prefetcher.close()
+      prefetcher.close()  # also closes its _host_items producer
+    # The loader's own stage threads (parse pool/preprocess worker)
+    # must not outlive the run either — the prefetcher only owns the
+    # producer generator, not the loader behind it.
+    _close_dataset(raw_train_dataset)
 
   _checkpoint(step, force=True)
   for hook in hooks:
@@ -836,11 +987,14 @@ def predict_from_model(
   predict = ts.make_predict_fn(model, use_ema=use_ema)
   outputs = []
   batch = first
-  for i in range(num_batches):
-    outputs.append(jax.device_get(predict(state, batch["features"])))
-    if i + 1 < num_batches:
-      try:
-        batch = next(dataset)
-      except StopIteration:
-        break
+  try:
+    for i in range(num_batches):
+      outputs.append(jax.device_get(predict(state, batch["features"])))
+      if i + 1 < num_batches:
+        try:
+          batch = next(dataset)
+        except StopIteration:
+          break
+  finally:
+    _close_dataset(dataset)  # joins the loader's stage threads
   return outputs

@@ -711,27 +711,67 @@ class TestColdStartGating:
     assert "data_vs_synthetic" in flagged
 
 
-def test_train_eval_arms_cache_without_step_stats(tmp_path):
-  """`executable_cache_dir` must work independent of the telemetry
-  gate: with step stats OFF, the XLA compilation-cache tier still arms
-  (the documented contract — eval-only and telemetry-off runs get warm
-  restarts via tier 2)."""
+def test_train_eval_xla_tier_off_for_train_on_for_eval(tmp_path):
+  """The XLA compilation-cache tier is mode-gated: OFF for training
+  modes (measured on jax 0.4.37: a process that has loaded ANY
+  executable from a warm XLA cache heap-corrupts on its next
+  donating-mesh dispatch — the checkpoint-RESUME SIGSEGV this repo hit
+  deterministically), ON for eval-only modes, which never dispatch a
+  donating executable. The serialized tier-1 cache dir arms either
+  way."""
   import jax
 
   from tensor2robot_tpu import train_eval
+  from tensor2robot_tpu.obs import metrics as metrics_lib
   from tensor2robot_tpu.utils import mocks
 
   model_dir = str(tmp_path / "m")
   try:
+    with metrics_lib.isolated():
+      train_eval.train_eval_model(
+          model=mocks.MockT2RModel(device_type="cpu"),
+          model_dir=model_dir, mode="train", max_train_steps=2,
+          checkpoint_every_n_steps=2,
+          input_generator_train=mocks.MockInputGenerator(batch_size=8),
+          step_stats_every_n_steps=0, log_every_n_steps=2)
+      assert jax.config.jax_compilation_cache_dir is None
+      assert metrics_lib.snapshot().get(
+          "counter/cache/xla_tier_skipped_train_mode") == 1.0
+    # With telemetry ON (the default-train shape), the per-run registry
+    # reset must not wipe the guard counter: it lands in the run
+    # record's cache block.
+    import json
+
     train_eval.train_eval_model(
         model=mocks.MockT2RModel(device_type="cpu"),
-        model_dir=model_dir, mode="train", max_train_steps=2,
-        checkpoint_every_n_steps=2,
+        model_dir=model_dir, mode="train", max_train_steps=4,
+        checkpoint_every_n_steps=4,
         input_generator_train=mocks.MockInputGenerator(batch_size=8),
-        step_stats_every_n_steps=0, log_every_n_steps=2)
+        step_stats_every_n_steps=1, log_every_n_steps=2)
+    records = [json.loads(line)
+               for line in open(os.path.join(model_dir, "runs.jsonl"))]
+    cache_block = records[-1]["extra"]["cache"]
+    assert cache_block.get(
+        "counter/cache/xla_tier_skipped_train_mode") == 1.0, cache_block
+    # Eval-only mode on the SAME model_dir arms the XLA tier.
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="evaluate", eval_steps=1,
+        input_generator_eval=mocks.MockInputGenerator(batch_size=8),
+        step_stats_every_n_steps=0)
     assert jax.config.jax_compilation_cache_dir == os.path.join(
         model_dir, "excache", "xla")
     assert os.path.isdir(os.path.join(model_dir, "excache", "xla"))
+    # Reversed order: a TRAIN run after the eval run must DISARM the
+    # process-global tier the eval run armed — leaving it live is the
+    # donating-mesh SIGSEGV this guard exists for.
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=6,
+        checkpoint_every_n_steps=6,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        step_stats_every_n_steps=0, log_every_n_steps=2)
+    assert jax.config.jax_compilation_cache_dir is None
   finally:
     jax.config.update("jax_compilation_cache_dir", None)
 
