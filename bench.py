@@ -50,6 +50,11 @@ carries per-stage timing attribution, and EVERY bench headline embeds
 a `host_load` block (loadavg/cpu_count/concurrent-bench flock guard)
 so load-masked readings are attributable at diff time.
 
+Pipeline schedules (PR 9): `bench.py --pp` prices the interleaved-1F1B
+schedule against GPipe on the virtual 8-device mesh (paired A/B,
+`onefonb_vs_gpipe` + static `pp_bubble_fraction` diff-gated via
+`scripts/pp_bench.sh`; PERFORMANCE.md "Reading a pipeline bench").
+
 graftcache (PR 7): every probe routes trace->compile through the
 persistent executable cache at GRAFTCACHE_DIR (default `.graftcache`),
 so re-benching an unchanged config deserializes instead of recompiling;
@@ -1216,6 +1221,217 @@ def cache_main(phase: str) -> None:
                 compile_records=engine.compile_records + [train_rec])
 
 
+PP_STAGES = 4            # pp ranks on the virtual 8-device mesh (2x4x1)
+PP_VIRTUAL = 2           # 1F1B chunks per rank (8 layers total)
+PP_MICRO = 8             # microbatches per step
+PP_MICRO_BATCH = 32      # rows per microbatch (sharded over 'data')
+PP_DIM = 512             # stage width: compute must dominate per-tick
+                         # scan/ppermute overhead or the tick-count win
+                         # is invisible on the time-shared CPU mesh
+                         # (PERFORMANCE.md "Reading a pipeline bench"
+                         # prices the asymptote)
+PP_MEASURE_STEPS = 8
+PP_RERUNS = 5
+
+
+def pp_main() -> None:
+  """Pipeline-schedule bench: ONE JSON headline line (CPU smoke path).
+
+  Prices the interleaved-1F1B schedule win over GPipe on the virtual
+  8-device CPU mesh (the tests' 2x4x1 topology — pp=4 ranks, batch rows
+  sharded over 'data'): the SAME 8-layer residual-MLP trunk trains once
+  as GPipe (4 coarse stages of 2 depth-contiguous layers, v=1) and once
+  as interleaved 1F1B (8 single-layer virtual chunks, v=2), through
+  `make_pipelined_train_step(audit_name=...)` so both executables carry
+  the analyze_jit donation audit and the `pp/*` schedule gauges.
+
+  Every rank computes on every tick of the lockstep scan (idle slots
+  compute masked zeros), so even on a time-shared CPU mesh wall time
+  tracks TOTAL layer-tick slots — GPipe's 2*(M+S-1)=22 per rank vs
+  1F1B's v*ceil(M/S)*S+S-1=19 — and the paired step-time ratio
+  `onefonb_vs_gpipe` (~22/19 analytic) is load-invariant the same way
+  `data_vs_synthetic` is: arms run back-to-back with alternating order
+  and the median per-pair ratio is the gated number. The headline value
+  is the 1F1B schedule's STATIC bubble fraction (idle-tick accounting,
+  deterministic from (S, M, v)); measured per-tick wall time rides
+  alongside (`tick_ms`). Diff-gated by `scripts/pp_bench.sh` via
+  `graftscope diff` (PERFORMANCE.md "Reading a pipeline bench").
+  """
+  backend_lib.pin_cpu(n_devices=8)
+  backend_lib.assert_cpu_backend()
+  import jax
+  import jax.numpy as jnp
+  import numpy as np
+  import optax
+
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.parallel import pipeline_parallel as pp_lib
+
+  mesh = mesh_lib.create_mesh(mesh_shape=(2, PP_STAGES, 1),
+                              axis_names=("data", "pp", "model"))
+  s, v, m_count, mb, dim = (PP_STAGES, PP_VIRTUAL, PP_MICRO,
+                            PP_MICRO_BATCH, PP_DIM)
+  rng = np.random.RandomState(0)
+  layers = [{"w": jnp.asarray(rng.randn(dim, dim).astype(np.float32)
+                              / np.sqrt(dim)),
+             "b": jnp.zeros((dim,), jnp.float32)} for _ in range(s * v)]
+  micro = jnp.asarray(rng.randn(m_count, mb, dim).astype(np.float32))
+  targets = jnp.asarray(rng.randn(m_count, mb, dim).astype(np.float32))
+
+  def layer_fn(p, x):
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+  def coarse_stage_fn(p, x):
+    # One GPipe stage = v depth-contiguous layers ([v, ...] leaves).
+    def body(h, lp):
+      return layer_fn(lp, h), None
+
+    h, _ = jax.lax.scan(body, x, p)
+    return h
+
+  def loss_fn(outputs, tgt):
+    return jnp.mean((outputs - tgt) ** 2)
+
+  optimizer = optax.adam(1e-3)
+
+  def build(arm):
+    if arm == "gpipe":
+      stacked = pp_lib.stack_stage_params(
+          [jax.tree_util.tree_map(lambda *xs: jnp.stack(xs),
+                                  *layers[i * v:(i + 1) * v])
+           for i in range(s)])
+      step = pp_lib.make_pipelined_train_step(
+          coarse_stage_fn, loss_fn, optimizer, mesh, axis_name="pp",
+          batch_axis="data", num_virtual_stages=1,
+          audit_name="bench/pp_gpipe_train_step")
+      accounting = pp_lib.schedule_accounting(s, m_count, 1)
+      layer_ticks = accounting["total_ticks"] * v
+    else:
+      # Pre-permuted interleaved layout (the production path): the
+      # per-step depth->interleaved gather and its backward scatter stay
+      # out of the hot loop.
+      stacked = pp_lib.interleave_stage_stack(
+          pp_lib.stack_stage_params(layers), s, v)
+      step = pp_lib.make_pipelined_train_step(
+          layer_fn, loss_fn, optimizer, mesh, axis_name="pp",
+          batch_axis="data", num_virtual_stages=v,
+          params_layout="interleaved",
+          audit_name="bench/pp_onefonb_train_step")
+      accounting = pp_lib.schedule_accounting(s, m_count, v)
+      layer_ticks = accounting["total_ticks"]
+    n_virtual = 1 if arm == "gpipe" else v
+    params = pp_lib.shard_pipeline_tree(stacked, mesh, "pp", n_virtual)
+    opt_state = pp_lib.shard_pipeline_tree(optimizer.init(stacked), mesh,
+                                           "pp", n_virtual)
+    return step, params, opt_state, accounting, layer_ticks
+
+  def time_arm(step, params, opt_state):
+    first_loss = None
+    for _ in range(2):  # warmup: first call compiles through analyze_jit
+      params, opt_state, loss = step(params, opt_state, micro, targets)
+      first_loss = first_loss if first_loss is not None else float(loss)
+    backend_lib.sync(jax.tree_util.tree_leaves(params)[0])
+    t0 = time.perf_counter()
+    for _ in range(PP_MEASURE_STEPS):
+      params, opt_state, _ = step(params, opt_state, micro, targets)
+    backend_lib.sync(jax.tree_util.tree_leaves(params)[0])
+    step_ms = (time.perf_counter() - t0) * 1e3 / PP_MEASURE_STEPS
+    return step_ms, params, opt_state, first_loss
+
+  arms = {}
+  for arm in ("gpipe", "onefonb"):
+    step, params, opt_state, accounting, layer_ticks = build(arm)
+    arms[arm] = {"step": step, "params": params, "opt_state": opt_state,
+                 "accounting": accounting, "layer_ticks": layer_ticks,
+                 "runs": [], "first_loss": None}
+  ratios = []
+  for rerun in range(PP_RERUNS):
+    order = (("onefonb", "gpipe") if rerun % 2 else ("gpipe", "onefonb"))
+    pair = {}
+    for arm in order:
+      a = arms[arm]
+      step_ms, a["params"], a["opt_state"], first = time_arm(
+          a["step"], a["params"], a["opt_state"])
+      a["runs"].append(step_ms)
+      if a["first_loss"] is None:
+        a["first_loss"] = first
+      pair[arm] = step_ms
+    ratios.append(pair["gpipe"] / pair["onefonb"])
+    print(f"bench-pp: pair {rerun}: gpipe {pair['gpipe']:.1f} ms/step, "
+          f"1f1b {pair['onefonb']:.1f} ms/step "
+          f"({ratios[-1]:.2f}x)", file=sys.stderr)
+
+  def median(values):
+    return sorted(values)[len(values) // 2]
+
+  gpipe, onefonb = arms["gpipe"], arms["onefonb"]
+  # Same init, same data: the two schedules are the same function, so
+  # their first-step losses must agree to fp32 tolerance — the bench
+  # re-checks the equivalence contract the tests pin, every run.
+  loss_parity_err = abs(gpipe["first_loss"] - onefonb["first_loss"])
+  if loss_parity_err > 1e-4 * max(1.0, abs(gpipe["first_loss"])):
+    raise SystemExit(
+        f"bench --pp: schedule equivalence violated: gpipe first-step "
+        f"loss {gpipe['first_loss']} vs 1f1b {onefonb['first_loss']}")
+
+  def arm_block(a):
+    step_ms = median(a["runs"])
+    return {
+        "step_ms": round(step_ms, 3),
+        # Measured per layer-tick slot (every rank runs one LAYER of
+        # compute per slot; GPipe's coarse stage = v layer slots/tick).
+        "tick_ms": round(step_ms / a["layer_ticks"], 4),
+        "layer_ticks": a["layer_ticks"],
+        "bubble_fraction": round(a["accounting"]["bubble_fraction"], 4),
+        "accounting": a["accounting"],
+        "first_step_loss": round(a["first_loss"], 6),
+    }
+
+  bubble = onefonb["accounting"]["bubble_fraction"]
+  gpipe_bubble = gpipe["accounting"]["bubble_fraction"]
+  gpipe_rec = getattr(gpipe["step"], "record", None)
+  onefonb_rec = getattr(onefonb["step"], "record", None)
+  headline = {
+      "metric": "qtopt_pp_bubble_frac_cpu_smoke",
+      # The headline value is STATIC schedule accounting — deterministic
+      # from (S, M, v), so the gate band can be tight; the measured side
+      # lives in onefonb_vs_gpipe / tick_ms.
+      "value": round(bubble, 4),
+      "unit": "bubble_fraction",
+      "vs_baseline": round(gpipe_bubble / bubble, 3),
+      "pp_bubble_fraction": round(bubble, 4),
+      "gpipe_bubble_fraction": round(gpipe_bubble, 4),
+      # The load-invariant paired step-time ratio (>= ~22/19 analytic
+      # when compute dominates tick overhead), diff-gated down-bad.
+      "onefonb_vs_gpipe": round(median(ratios), 3),
+      "gpipe": arm_block(gpipe),
+      "onefonb": arm_block(onefonb),
+      "loss_parity_abs_err": loss_parity_err,
+      "num_stages": s,
+      "num_virtual_stages": v,
+      "num_micro": m_count,
+      "micro_batch": mb,
+      "stage_dim": dim,
+      "pairs": len(ratios),
+      "measure_steps": PP_MEASURE_STEPS,
+      # pp/* gauges the schedules registered at trace time + the xray
+      # donation audit (donated_bytes > 0 proves the donated in-place
+      # optimizer flow survived the schedule change).
+      "schedule_gauges": obs_metrics.snapshot(prefix="pp/"),
+      "donated_bytes": {
+          "gpipe": (gpipe_rec or {}).get("donated_bytes"),
+          "onefonb": (onefonb_rec or {}).get("donated_bytes"),
+      },
+      "device_kind": jax.devices()[0].device_kind,
+      "platform": jax.devices()[0].platform,
+      "host_load": _host_load_block(),
+      "graftscope": _graftscope_block(),
+  }
+  print(json.dumps(headline))
+  _write_runlog(headline, platform="cpu", device_kind="host-pp-smoke",
+                compile_records=[r for r in (gpipe_rec, onefonb_rec) if r])
+
+
 SERVE_CONCURRENCY = 8
 SERVE_MAX_BATCH = 8
 SERVE_SWEEP = (1, 2, 4, 8)
@@ -1370,6 +1586,9 @@ def main() -> None:
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--smoke":
     smoke_main()
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--pp":
+    pp_main()
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--cache":
     cache_main(sys.argv[2] if len(sys.argv) > 2 else "cold")

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Pipeline-schedule bench + regression gate.
+#
+# One headline run, diffed against ITS OWN previous record in runs.jsonl
+# with `graftscope diff` (train/serve/cache/data records interleave in
+# the same file; the index lookup below selects the pp family):
+#
+#   `bench.py --pp` — qtopt_pp_bubble_frac_cpu_smoke: the GPipe-vs-
+#   interleaved-1F1B cold A/B on the virtual 8-device mesh
+#   (PERFORMANCE.md "Reading a pipeline bench"). Gated metrics:
+#     pp_bubble_fraction  — STATIC idle-tick accounting of the 1F1B
+#                           schedule (deterministic; any growth is a
+#                           real schedule change, up-bad 2%),
+#     onefonb_vs_gpipe    — the load-invariant paired step-time ratio
+#                           GPipe/1F1B (down-bad 15%; reads ~1.0 on the
+#                           1-core emulated mesh, the structural win is
+#                           the bubble row above).
+#
+# A regression in either exits non-zero exactly like a training one.
+#
+# Usage: scripts/pp_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# The index lookup runs OUTSIDE a process substitution so a failure
+# (unreadable runs.jsonl, broken import) fails the script loudly
+# instead of reading as "no baseline" and silently skipping the gate.
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+  ) || { echo "pp_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "pp_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
+
+JAX_PLATFORMS=cpu python bench.py --pp
+# The pp family gates on the two schedule metrics only: its wall-clock
+# step/compile times swing 4x with host load on this VM (the headline
+# carries host_load for attribution), so the absolute thresholds are
+# opened wide here rather than training people to ignore a flappy gate.
+gate_family pp_bubble_frac \
+    --threshold compile_time_s=10.0 --threshold flops_per_step=10.0 \
+    --threshold bytes_per_step=10.0 --threshold jaxpr_eqns=10.0

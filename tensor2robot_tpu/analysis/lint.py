@@ -28,7 +28,7 @@ import sys
 from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       native_check, spec_check,
+                                       native_check, pp_check, spec_check,
                                        thread_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
@@ -68,6 +68,15 @@ cache rules (.py):
                          donation layout, static args) — an under-keyed
                          cache can serve a mismatched executable;
                          a `**splat` call site is accepted
+
+pipeline rules (.py):
+  pp-schedule-unaudited  a `make_pipelined_train_step(...)` call site
+                         that passes no `audit_name=` (or an explicit
+                         None) — the step skips the analyze_jit path,
+                         so per-stage donation bytes and the
+                         pp/bubble_fraction schedule telemetry never
+                         reach runs.jsonl; a `**splat` call site is
+                         accepted
 
 thread rules (.py):
   thread-stage-missing-close     a class starts a threading.Thread but
@@ -134,6 +143,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(tracer_check.check_python_file(path))
     findings.extend(spec_check.check_python_file(path, mesh_axes))
     findings.extend(cache_check.check_python_file(path))
+    findings.extend(pp_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked

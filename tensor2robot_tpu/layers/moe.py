@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+
 __all__ = ["MixtureOfExperts", "EXPERT_AXIS_PARAM_RULE",
            "expert_axis_param_rule"]
 
@@ -242,10 +244,9 @@ class MixtureOfExperts(nn.Module):
 
     spec_tok = PartitionSpec(axis, None)
     spec_exp = PartitionSpec(axis, None, None)
-    sharded = jax.shard_map(
+    sharded = mesh_lib.shard_map(
         local_fn, mesh=self.mesh,
         in_specs=(spec_tok, spec_tok, spec_tok,
                   spec_exp, spec_exp, spec_exp, spec_exp),
-        out_specs=(spec_tok, PartitionSpec()),
-        check_vma=False)
+        out_specs=(spec_tok, PartitionSpec()))
     return sharded(tokens, top_probs, top_idx, w1, b1, w2, b2)

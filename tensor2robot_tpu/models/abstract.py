@@ -178,14 +178,18 @@ class T2RModel(ModelInterface):
 
   @staticmethod
   def _validate_pp_stage_count(mesh, pp_axis: str, num_stages: int,
-                               what: str = "trunk") -> None:
+                               what: str = "trunk",
+                               num_virtual_stages: int = 1) -> None:
     """A >1 `pp_axis` must match the pipelined trunk's stage count —
-    the GPipe schedule places exactly one stage per pp rank."""
+    the pipeline schedules place `num_virtual_stages` stage chunks per
+    pp rank (one for GPipe, v for interleaved 1F1B)."""
     if pp_axis in mesh.shape and mesh.shape[pp_axis] > 1 \
-        and mesh.shape[pp_axis] != num_stages:
+        and mesh.shape[pp_axis] * num_virtual_stages != num_stages:
       raise ValueError(
-          f"mesh axis {pp_axis!r} has size {mesh.shape[pp_axis]} but "
-          f"the {what} has {num_stages} stages; they must match.")
+          f"mesh axis {pp_axis!r} has size {mesh.shape[pp_axis]} and "
+          f"num_virtual_stages={num_virtual_stages} but the {what} has "
+          f"{num_stages} stages; stages must match ranks x virtual "
+          "chunks.")
 
   # -- abstract model surface ----------------------------------------------
 

@@ -1,14 +1,18 @@
-"""A T2RModel whose trunk is GPipe-pipelined over a mesh axis — the
+"""A T2RModel whose trunk is pipelined over a mesh axis — the
 training-path carrier for pipeline parallelism.
 
 Beyond the reference (SURVEY.md §2.5: PP absent there). Round-2 scoping
 left `parallel/pipeline_parallel.py` a standalone op; this model closes
 that gap: a homogeneous residual-MLP trunk whose stacked stage params
-(`stages_*`, leading [S] dim) shard over a `pp` mesh axis via
+(`stages_*`, leading [num_stages] dim) shard over a `pp` mesh axis via
 `pipeline_parallel_rules()`, with the batch split into microbatches that
-flow through the GPipe fill/drain schedule (`pipelined_apply`'s
-scan+ppermute ring). Trained through `train_eval_model` like any model —
-see `configs/train_pipelined_pp.gin`.
+flow through the pipeline schedule (`pipelined_apply`'s scan+ppermute
+ring): GPipe fill/drain at `num_virtual_stages=1`, interleaved 1F1B at
+`num_virtual_stages=v>1`, where each pp rank holds v of the trunk's
+stages as virtual chunks and microbatches loop the ring v times — see
+parallel/pipeline_parallel.py for the schedule and bubble accounting.
+Trained through `train_eval_model` like any model — see
+`configs/train_pipelined_pp.gin`.
 
 Without a mesh (unit tests, single chip) the trunk runs the SAME stage
 params through a sequential `lax.scan`, which is mathematically identical
@@ -57,6 +61,7 @@ class _PipelinedTrunk(nn.Module):
   hidden_size: int = 64
   num_stages: int = 4
   num_microbatches: int = 4
+  num_virtual_stages: int = 1  # chunks per pp rank (1=GPipe, >1=1F1B)
   mesh: Optional[Any] = None  # jax.sharding.Mesh with a `pp` axis
   axis_name: str = "pp"
   batch_axis: str = "data"  # microbatch dim stays sharded over this
@@ -89,6 +94,13 @@ class _PipelinedTrunk(nn.Module):
       hidden = jnp.tanh(act @ p["w1"] + p["b1"])
       return act + hidden @ p["w2"] + p["b2"]
 
+    # For v>1 the checkpoint LAYOUT is interleaved (stack position r*v+j
+    # holds depth layer j*S+r — exactly what contiguous `pp` sharding
+    # wants), so the hot pipelined step pays NO per-step depth->
+    # interleaved permute; only the sequential fallback gathers the
+    # depth order back (loop-invariant, off the production path).
+    v = self.num_virtual_stages
+
     if self.mesh is not None and self.mesh.shape.get(self.axis_name,
                                                      1) > 1:
       batch = x.shape[0]
@@ -103,12 +115,19 @@ class _PipelinedTrunk(nn.Module):
             f"microbatches) not divisible over the {data_size}-way "
             f"{self.batch_axis!r} mesh axis")
       micro = x.reshape(m, batch // m, h)
-      out = pp_lib.pipelined_apply(stage_fn, stage_params, micro,
-                                   self.mesh, axis_name=self.axis_name,
-                                   batch_axis=self.batch_axis)
+      out = pp_lib.pipelined_apply(
+          stage_fn, stage_params, micro, self.mesh,
+          axis_name=self.axis_name, batch_axis=self.batch_axis,
+          num_virtual_stages=v,
+          params_layout="interleaved" if v > 1 else "layer")
       x = out.reshape(batch, h)
     else:
       # Sequential schedule: same function, no pipeline overlap.
+      if v > 1:
+        depth_order = np.argsort(pp_lib.interleave_order(s // v, v))
+        stage_params = jax.tree_util.tree_map(
+            lambda p: p[depth_order], stage_params)
+
       def body(act, p):
         return stage_fn(p, act), None
 
@@ -132,13 +151,23 @@ class PipelinedRegressionModel(abstract_model.T2RModel):
 
   def __init__(self, obs_size: int = 16, action_size: int = 7,
                hidden_size: int = 64, num_stages: int = 4,
-               num_microbatches: int = 4, pp_axis: str = "pp", **kwargs):
+               num_microbatches: int = 4, num_virtual_stages: int = 1,
+               pp_axis: str = "pp", **kwargs):
     super().__init__(**kwargs)
+    # Mesh-independent: the sequential (no-mesh) schedule also splits
+    # the stack into num_stages/num_virtual_stages chunk columns — a
+    # non-divisible count would silently drop stages there, where the
+    # mesh-gated set_mesh validation never runs.
+    if num_virtual_stages < 1 or num_stages % num_virtual_stages:
+      raise ValueError(
+          f"num_stages={num_stages} must be a positive multiple of "
+          f"num_virtual_stages={num_virtual_stages}")
     self._obs_size = obs_size
     self._action_size = action_size
     self._hidden_size = hidden_size
     self._num_stages = num_stages
     self._num_microbatches = num_microbatches
+    self._num_virtual_stages = num_virtual_stages
     self._pp_axis = pp_axis
     self._mesh = None
 
@@ -148,7 +177,8 @@ class PipelinedRegressionModel(abstract_model.T2RModel):
     otherwise the trunk runs the sequential schedule."""
     self._set_mesh_guarded(
         mesh, lambda m: self._validate_pp_stage_count(
-            m, self._pp_axis, self._num_stages))
+            m, self._pp_axis, self._num_stages,
+            num_virtual_stages=self._num_virtual_stages))
 
   def get_feature_specification(self, mode):
     return SpecStruct({
@@ -170,6 +200,7 @@ class PipelinedRegressionModel(abstract_model.T2RModel):
         action_size=self._action_size, hidden_size=self._hidden_size,
         num_stages=self._num_stages,
         num_microbatches=self._num_microbatches,
+        num_virtual_stages=self._num_virtual_stages,
         mesh=mesh if use_pp else None, axis_name=self._pp_axis,
         dtype=self.compute_dtype if self.use_bfloat16 else None)
 

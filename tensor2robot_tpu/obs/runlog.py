@@ -91,6 +91,16 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # ISSUE 7 acceptance).
     "warmup_ms": ("up", 0.50),
     "cold_vs_warm_warmup": ("down", 0.30),
+    # Pipeline-schedule gates (bench.py --pp / scripts/pp_bench.sh,
+    # PERFORMANCE.md "Reading a pipeline bench"): onefonb_vs_gpipe is
+    # the paired step-time ratio GPipe/1F1B on the virtual 8-device
+    # mesh (>= 1 when the interleaved schedule wins; back-to-back pairs
+    # make it load-invariant like data_vs_synthetic — 15% band for the
+    # same reason). pp_bubble_fraction is the STATIC idle-tick fraction
+    # of the 1F1B schedule — deterministic from (S, M, v), so any
+    # growth is a real schedule change, not noise (tightest band).
+    "onefonb_vs_gpipe": ("down", 0.15),
+    "pp_bubble_fraction": ("up", 0.02),
 }
 
 
@@ -342,6 +352,12 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["warmup_ms"] = float(bench["warmup_ms"])
   if bench.get("cold_vs_warm_warmup") is not None:
     out["cold_vs_warm_warmup"] = float(bench["cold_vs_warm_warmup"])
+  # Pipeline-schedule bench (bench.py --pp): the load-invariant paired
+  # step-time ratio and the static 1F1B bubble fraction.
+  if bench.get("onefonb_vs_gpipe") is not None:
+    out["onefonb_vs_gpipe"] = float(bench["onefonb_vs_gpipe"])
+  if bench.get("pp_bubble_fraction") is not None:
+    out["pp_bubble_fraction"] = float(bench["pp_bubble_fraction"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec
 
+from tensor2robot_tpu.parallel import mesh as mesh_lib
+
 __all__ = ["attention", "flash_attention", "ring_attention",
            "ulysses_attention"]
 
@@ -529,11 +531,10 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
       out = attention(q_g, k_g, v_g, causal=causal)
     return heads_to_seq(out)
 
-  sharded = jax.shard_map(
+  sharded = mesh_lib.shard_map(
       local_fn, mesh=mesh,
       in_specs=(io_spec, io_spec, io_spec),
-      out_specs=io_spec,
-      check_vma=False)
+      out_specs=io_spec)
   return sharded(q, k, v)
 
 
@@ -615,9 +616,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
     return _finalize(o, l).astype(q_local.dtype)
 
-  sharded = jax.shard_map(
+  sharded = mesh_lib.shard_map(
       local_fn, mesh=mesh,
       in_specs=(io_spec, io_spec, io_spec),
-      out_specs=io_spec,
-      check_vma=False)
+      out_specs=io_spec)
   return sharded(q, k, v)

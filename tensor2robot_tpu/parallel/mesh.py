@@ -31,10 +31,31 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["create_mesh", "data_sharding", "replicated",
            "put_host_batch", "place_batch", "local_batch_size",
-           "DevicePrefetcher",
+           "DevicePrefetcher", "shard_map",
            "initialize_multihost"]
 
 DEFAULT_AXES = ("data", "fsdp", "model")
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+  """THE repo's shard_map entry point, jax-version tolerant.
+
+  `jax.shard_map` (with its `check_vma` replication check) only exists on
+  newer jax; this toolchain's 0.4.37 ships the same primitive as
+  `jax.experimental.shard_map.shard_map` (`check_rep`). Every explicit
+  SPMD region in this repo (pipeline schedules, ring/ulysses attention,
+  MoE all_to_all dispatch) routes through this one wrapper so the
+  version split is handled in exactly one place. Replication checking is
+  disabled on both paths — these regions use psum-broadcast outputs the
+  checker cannot prove replicated.
+  """
+  if hasattr(jax, "shard_map"):
+    return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+  from jax.experimental import shard_map as _shard_map_lib
+
+  return _shard_map_lib.shard_map(f, mesh=mesh, in_specs=in_specs,
+                                  out_specs=out_specs, check_rep=False)
 
 
 @config.configurable

@@ -23,6 +23,13 @@ import jax  # noqa: E402  (import after env setup)
 # Belt and braces: the env var alone can be overridden by site hooks that
 # registered a hardware platform before conftest runs.
 jax.config.update("jax_platforms", "cpu")
+# Newer jax defaults this ON; 0.4.37 defaults it OFF, where GSPMD-
+# partitioned RNG ops (sharded `create_train_state` init, pp/sp
+# schedules) generate DIFFERENT values under jit+mesh than eagerly —
+# breaking every same-seed sharded-vs-sequential parity test. Pin the
+# partitionable implementation so the suite sees one RNG semantics on
+# every toolchain.
+jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402
 

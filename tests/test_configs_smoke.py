@@ -133,6 +133,23 @@ def test_pipelined_pp_config_trains_on_mesh(tmp_path):
   assert_output_files(model_dir, expect_operative_config=False)
 
 
+def test_pipelined_1f1b_config_trains_on_mesh(tmp_path):
+  """Interleaved 1F1B through the full training path:
+  train_pipelined_1f1b.gin trains the 8-stage trunk as 2 virtual chunks
+  per rank of the 4-wide 'pp' axis ((2, 4, 1) mesh), stage params
+  sharded over 'pp' — the schedule twin of the GPipe config above."""
+  config_path = os.path.join(REPO_ROOT, "tensor2robot_tpu", "configs",
+                             "train_pipelined_1f1b.gin")
+  model_dir = str(tmp_path / "pp_1f1b")
+  bindings = [b for b in _SHRINK
+              if "mesh_shape" not in b and "batch_size" not in b]
+  bindings.append(f"train_eval_model.model_dir = {model_dir!r}")
+  config.parse_config_files_and_bindings([config_path], bindings)
+  metrics = train_eval.train_eval_model()
+  assert metrics
+  assert_output_files(model_dir, expect_operative_config=False)
+
+
 def test_bcz_pp_config_trains_on_mesh(tmp_path):
   """Heterogeneous PP through a REAL research family: train_bcz_pp.gin
   trains BCZ with its conv trunk GPipe-pipelined over the 'pp' axis of a

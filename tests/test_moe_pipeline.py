@@ -809,3 +809,572 @@ class TestGrasp2VecPipelined:
         image_size=32, tower="pipelined_conv", device_type="cpu")
     with pytest.raises(ValueError, match="must match"):
       model.set_mesh(mesh)
+
+
+class TestScheduleAccounting:
+  """Static idle-tick accounting: the observable the 1F1B upgrade is
+  gated on (pure Python — the poisoned trap below imports it with no
+  usable backend)."""
+
+  def test_gpipe_formula(self):
+    acc = pp.schedule_accounting(4, 8, 1)
+    assert acc["schedule"] == "gpipe"
+    assert acc["total_ticks"] == 8 + 4 - 1
+    assert acc["busy_ticks_per_rank"] == 8
+    assert acc["bubble_fraction"] == pytest.approx(3 / 11)
+    assert acc["padded_microbatches"] == 0
+
+  def test_interleaved_strictly_beats_gpipe_at_s4_m8(self):
+    """The ISSUE acceptance pin: bubble fraction strictly below GPipe's
+    for v>1 at S=4, M=8 — and exactly the (S-1)/(v*M + S-1) closed
+    form when S | M."""
+    gpipe = pp.schedule_accounting(4, 8, 1)
+    onefonb = pp.schedule_accounting(4, 8, 2)
+    assert onefonb["total_ticks"] == 2 * 8 + 4 - 1  # v*M + S - 1
+    assert onefonb["bubble_fraction"] == pytest.approx(3 / 19)
+    assert onefonb["bubble_fraction"] < gpipe["bubble_fraction"]
+    # more virtual stages keep shrinking the bubble
+    v4 = pp.schedule_accounting(4, 8, 4)
+    assert v4["bubble_fraction"] < onefonb["bubble_fraction"]
+
+  def test_ragged_group_pays_padding(self):
+    acc = pp.schedule_accounting(4, 5, 2)
+    assert acc["padded_microbatches"] == 3
+    # padded slots are idle: busy counts only REAL microbatch work
+    assert acc["busy_ticks_per_rank"] == 5 * 2
+    assert acc["total_ticks"] == 2 * 4 * 2 + 4 - 1
+
+  def test_validation(self):
+    with pytest.raises(ValueError, match="num_stages"):
+      pp.schedule_accounting(0, 8, 1)
+    with pytest.raises(ValueError, match="num_stages"):
+      pp.schedule_accounting(4, 0, 1)
+
+  def test_interleave_order_places_loop_major_chunks(self):
+    # position r*v + j holds layer j*S + r
+    order = pp.interleave_order(4, 2)
+    assert order.tolist() == [0, 4, 1, 5, 2, 6, 3, 7]
+    stacked = jnp.arange(8.0)
+    inter = pp.interleave_stage_stack(stacked, 4, 2)
+    assert inter.tolist() == [0.0, 4.0, 1.0, 5.0, 2.0, 6.0, 3.0, 7.0]
+
+
+class TestInterleavedPipeline:
+  """1F1B equivalence: loss AND gradient parity vs the sequential
+  schedule across (S, M, v, batch_axis) combos on the 8-device mesh."""
+
+  @pytest.fixture(scope="class")
+  def pp_mesh(self):
+    return mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+
+  def _sequential(self, layers, micro):
+    out = micro
+    for params in layers:
+      out = jax.vmap(lambda x, p=params: _stage_fn(p, x))(out)
+    return out
+
+  @pytest.mark.parametrize("num_micro,v,batch_axis",
+                           [(8, 2, None), (5, 2, None), (3, 2, None),
+                            (8, 2, "data"), (4, 1, "data"), (8, 4, None)])
+  def test_forward_matches_sequential(self, pp_mesh, num_micro, v,
+                                      batch_axis):
+    dim, mb = 6, 4
+    layers = _stages(4 * v, dim)
+    stacked = pp.stack_stage_params(layers)
+    micro = jax.random.normal(jax.random.PRNGKey(2), (num_micro, mb, dim))
+    out = pp.pipelined_apply(_stage_fn, stacked, micro, pp_mesh,
+                             axis_name="pp", batch_axis=batch_axis,
+                             num_virtual_stages=v)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(self._sequential(layers, micro)),
+                               atol=1e-5)
+
+  def test_forward_interleaved_layout_matches(self, pp_mesh):
+    """Pre-permuted stacks (`params_layout='interleaved'`) are the same
+    function — the production layout that keeps the permute gather off
+    the per-step program."""
+    dim, num_micro, v = 6, 8, 2
+    layers = _stages(4 * v, dim)
+    stacked = pp.interleave_stage_stack(pp.stack_stage_params(layers), 4, v)
+    micro = jax.random.normal(jax.random.PRNGKey(2), (num_micro, 4, dim))
+    out = pp.pipelined_apply(_stage_fn, stacked, micro, pp_mesh,
+                             axis_name="pp", num_virtual_stages=v,
+                             params_layout="interleaved")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(self._sequential(layers, micro)),
+                               atol=1e-5)
+
+  @pytest.mark.parametrize("batch_axis", [None, "data"])
+  def test_gradients_match_sequential(self, pp_mesh, batch_axis):
+    dim, num_micro, v = 6, 8, 2
+    layers = _stages(4 * v, dim)
+    stacked = pp.stack_stage_params(layers)
+    micro = jax.random.normal(jax.random.PRNGKey(3), (num_micro, 4, dim))
+
+    def loss_pp(p):
+      out = pp.pipelined_apply(_stage_fn, p, micro, pp_mesh, "pp",
+                               batch_axis=batch_axis,
+                               num_virtual_stages=v)
+      return (out ** 2).mean()
+
+    def loss_seq(p):
+      out = micro
+      for i in range(4 * v):
+        sp = jax.tree_util.tree_map(lambda l, i=i: l[i], p)
+        out = jax.vmap(lambda a, sp=sp: _stage_fn(sp, a))(out)
+      return (out ** 2).mean()
+
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pp),
+                    jax.tree_util.tree_leaves(g_seq)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                 rtol=1e-4, atol=1e-6)
+
+  def test_heterogeneous_interleaved_matches_sequential(self, pp_mesh):
+    """The lax.switch flat-buffer path on the SAME 1F1B skeleton: 8
+    different stages (2 chunks per rank), forward AND gradients vs the
+    `sequential_apply_heterogeneous` oracle, composed with batch DP."""
+    key = jax.random.split(jax.random.PRNGKey(0), 9)
+    dims = [10, 12, 8, 9, 7, 11, 6, 5, 4]
+    params, fns = [], []
+    for i in range(8):
+      params.append({"w": jax.random.normal(key[i],
+                                            (dims[i], dims[i + 1])) * 0.2})
+
+      def fn(p, x, d_in=dims[i]):
+        return jnp.tanh(x[:, :d_in] @ p["w"])
+
+      fns.append(fn)
+    stacked, unravels, sizes, = pp.ravel_stage_stack(params)
+    a_max = max(dims)
+    micro = jnp.pad(
+        jax.random.normal(key[8], (8, 2, dims[0])),
+        ((0, 0), (0, 0), (0, a_max - dims[0])))
+
+    seq = pp.sequential_apply_heterogeneous(fns, unravels, sizes, stacked,
+                                            micro)
+    out = pp.pipelined_apply_heterogeneous(
+        fns, unravels, sizes, stacked, micro, pp_mesh,
+        batch_axis="data", num_virtual_stages=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(seq),
+                               rtol=1e-5, atol=1e-6)
+
+    def loss_seq(sp):
+      o = pp.sequential_apply_heterogeneous(fns, unravels, sizes, sp,
+                                            micro)
+      return jnp.mean(o[..., :dims[-1]] ** 2)
+
+    def loss_pp(sp):
+      o = pp.pipelined_apply_heterogeneous(
+          fns, unravels, sizes, sp, micro, pp_mesh,
+          batch_axis="data", num_virtual_stages=2)
+      return jnp.mean(o[..., :dims[-1]] ** 2)
+
+    g_seq = jax.grad(loss_seq)(stacked)
+    g_pp = jax.jit(jax.grad(loss_pp))(stacked)
+    np.testing.assert_allclose(np.asarray(g_pp), np.asarray(g_seq),
+                               rtol=1e-4, atol=1e-7)
+
+  def test_heterogeneous_stage_count_mismatch_raises(self, pp_mesh):
+    fns, unravels, sizes, stacked, micro = (
+        TestHeterogeneousPipeline()._setup())
+    with pytest.raises(ValueError, match="stage functions"):
+      pp.pipelined_apply_heterogeneous(fns, unravels, sizes, stacked,
+                                       micro, pp_mesh,
+                                       num_virtual_stages=2)
+
+  def test_homogeneous_stage_count_mismatch_raises(self, pp_mesh):
+    stacked = pp.stack_stage_params(_stages(6, 4))
+    micro = jax.random.normal(jax.random.PRNGKey(0), (4, 2, 4))
+    with pytest.raises(ValueError, match="leading dim"):
+      pp.pipelined_apply(_stage_fn, stacked, micro, pp_mesh, "pp",
+                         num_virtual_stages=2)
+
+  def test_num_micro_validation_and_degenerate_warning(self, pp_mesh):
+    from tensor2robot_tpu.obs import metrics as obs_metrics
+
+    stacked = pp.stack_stage_params(_stages(4, 4))
+    with pytest.raises(ValueError, match="num_micro"):
+      pp.pipelined_apply(_stage_fn, stacked,
+                         jnp.zeros((0, 2, 4)), pp_mesh, "pp")
+    with obs_metrics.isolated():
+      micro = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 4))
+      pp.pipelined_apply(_stage_fn, stacked, micro, pp_mesh, "pp")
+      snap = obs_metrics.snapshot(prefix="pp/")
+    # M=2 < S=4: >50% bubble — counted via the telemetry registry.
+    assert snap["counter/pp/degenerate_microbatching"] == 1.0
+    assert snap["gauge/pp/bubble_fraction"] == pytest.approx(3 / 5)
+
+
+class TestInterleavedTrainStep:
+  """1F1B as a *training capability*: donated optimizer flow, the
+  analyze_jit audit seam, schedule telemetry, and a zero-recompile pin."""
+
+  @pytest.fixture(scope="class")
+  def pp_mesh(self):
+    return mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+
+  def _setup(self, v=2, dim=6, num_micro=8, mb=3):
+    import optax
+
+    layers = _stages(4 * v, dim)
+    stacked = pp.stack_stage_params(layers)
+    optimizer = optax.adam(1e-2)
+    x = jax.random.normal(jax.random.PRNGKey(0), (num_micro, mb, dim))
+    y = jax.random.normal(jax.random.PRNGKey(1), (num_micro, mb, dim))
+
+    def loss_fn(outputs, targets):
+      return ((outputs - targets) ** 2).mean()
+
+    return layers, stacked, optimizer, x, y, loss_fn
+
+  def test_1f1b_step_gradients_match_sequential_and_loss_decreases(
+      self, pp_mesh):
+    from tensor2robot_tpu.obs import metrics as obs_metrics
+
+    v = 2
+    layers, stacked, optimizer, x, y, loss_fn = self._setup(v=v)
+
+    def sequential_loss(p):
+      out = x
+      for i in range(4 * v):
+        stage_p = jax.tree_util.tree_map(lambda l, i=i: l[i], p)
+        out = jax.vmap(lambda a, sp=stage_p: _stage_fn(sp, a))(out)
+      return loss_fn(out, y)
+
+    g_seq = jax.grad(sequential_loss)(stacked)
+    g_pipe = jax.grad(lambda p: loss_fn(
+        pp.pipelined_apply(_stage_fn, p, x, pp_mesh, "pp",
+                           num_virtual_stages=v), y))(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    with obs_metrics.isolated():
+      step = pp.make_pipelined_train_step(
+          _stage_fn, loss_fn, optimizer, pp_mesh, axis_name="pp",
+          num_virtual_stages=v, audit_name="test/pp_1f1b_train_step")
+      params = pp.shard_pipeline_tree(stacked, pp_mesh, "pp", v)
+      opt_state = pp.shard_pipeline_tree(optimizer.init(stacked), pp_mesh,
+                                         "pp", v)
+      first = None
+      for _ in range(80):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        first = first if first is not None else float(loss)
+      snap = obs_metrics.snapshot(prefix="pp/")
+    assert float(loss) < first * 0.5, (first, float(loss))
+    # params stayed sharded over the pp axis
+    assert params["w"].sharding.spec == PartitionSpec("pp")
+    # the audit seam delivered: per-stage donation bytes + schedule
+    # telemetry from the SAME build (the pp-schedule-unaudited contract)
+    assert step.record is not None
+    assert step.record["donated_bytes"] > 0
+    assert snap["gauge/pp/bubble_fraction"] == pytest.approx(3 / 19)
+    assert snap["gauge/pp/num_virtual_stages"] == v
+
+  def test_zero_recompile_across_step_counts(self, pp_mesh):
+    """The jitted 1F1B step compiles ONCE whatever the invocation
+    count — the scan's tick structure is static, so step count cannot
+    leak into trace shape."""
+    _, stacked, optimizer, x, y, loss_fn = self._setup()
+    step = pp.make_pipelined_train_step(  # graftlint: disable=pp-schedule-unaudited
+        _stage_fn, loss_fn, optimizer, pp_mesh, axis_name="pp",
+        num_virtual_stages=2)
+    params = pp.shard_pipeline_tree(stacked, pp_mesh, "pp", 2)
+    opt_state = pp.shard_pipeline_tree(optimizer.init(stacked), pp_mesh,
+                                       "pp", 2)
+    for n_steps in (1, 3, 7):
+      for _ in range(n_steps):
+        params, opt_state, _ = step(params, opt_state, x, y)
+    assert step._cache_size() == 1
+
+  def test_donation_declared_on_state(self, pp_mesh):
+    """donate=True really donates (params, opt_state) and nothing else:
+    the audited record's donated bytes equal the state pytree's bytes."""
+    from tensor2robot_tpu.obs import xray as xray_lib
+
+    _, stacked, optimizer, x, y, loss_fn = self._setup()
+    step = pp.make_pipelined_train_step(
+        _stage_fn, loss_fn, optimizer, pp_mesh, axis_name="pp",
+        num_virtual_stages=2, audit_name="test/pp_donation_audit")
+    params = pp.shard_pipeline_tree(stacked, pp_mesh, "pp", 2)
+    opt_state = pp.shard_pipeline_tree(optimizer.init(stacked), pp_mesh,
+                                       "pp", 2)
+    params, opt_state, _ = step(params, opt_state, x, y)
+    expected = (xray_lib.pytree_bytes(params)
+                + xray_lib.pytree_bytes(opt_state))
+    assert step.record["donated_bytes"] == expected
+
+
+class TestPPScheduleLintRule:
+  """graftlint `pp-schedule-unaudited` (analysis/pp_check.py): building
+  a pipelined train step outside the analyze_jit audit path is a static
+  finding, like thread_check/cache_check siblings."""
+
+  def _findings(self, source):
+    from tensor2robot_tpu.analysis import pp_check
+    from tensor2robot_tpu.analysis.findings import (filter_findings,
+                                                    load_suppressions)
+
+    return filter_findings(pp_check.check_python_source("x.py", source),
+                           load_suppressions(source))
+
+  def test_flags_unaudited_call(self):
+    findings = self._findings(
+        "step = pp.make_pipelined_train_step(fn, loss, opt, mesh)\n")
+    assert [f.rule for f in findings] == ["pp-schedule-unaudited"]
+    assert "audit_name" in findings[0].message
+
+  def test_flags_explicit_none(self):
+    findings = self._findings(
+        "step = make_pipelined_train_step(fn, loss, opt, mesh,\n"
+        "                                 audit_name=None)\n")
+    assert len(findings) == 1
+
+  def test_audited_and_splat_clean(self):
+    assert not self._findings(
+        "s = make_pipelined_train_step(fn, loss, opt, mesh,\n"
+        "                              audit_name='run/pp_step')\n")
+    assert not self._findings(
+        "s = make_pipelined_train_step(fn, loss, opt, mesh, **kw)\n")
+
+  def test_suppression(self):
+    assert not self._findings(
+        "s = make_pipelined_train_step(fn, loss, opt, mesh)"
+        "  # graftlint: disable=pp-schedule-unaudited\n")
+
+  def test_wired_into_lint_run(self, tmp_path):
+    from tensor2robot_tpu.analysis import lint
+
+    bad = tmp_path / "bad_pp.py"
+    bad.write_text("s = make_pipelined_train_step(f, l, o, m)\n")
+    findings = lint.run([str(bad)])
+    assert any(f.rule == "pp-schedule-unaudited" for f in findings)
+    assert "pp-schedule-unaudited" in lint._RULE_CATALOG
+
+
+class TestPPBenchGating:
+  """runs.jsonl vocabulary for the pipeline bench: key_metrics folds the
+  two schedule metrics and diff_records gates them direction-aware."""
+
+  def _rec(self, ratio, bubble):
+    from tensor2robot_tpu.obs import runlog
+
+    return runlog.make_record(
+        "bench", platform="cpu", device_kind="host-pp-smoke",
+        bench={"metric": "qtopt_pp_bubble_frac_cpu_smoke",
+               "value": bubble, "unit": "bubble_fraction",
+               "onefonb_vs_gpipe": ratio,
+               "pp_bubble_fraction": bubble})
+
+  def test_key_metrics_and_thresholds(self):
+    from tensor2robot_tpu.obs import runlog
+
+    metrics = runlog.key_metrics(self._rec(1.02, 3 / 19))
+    assert metrics["onefonb_vs_gpipe"] == pytest.approx(1.02)
+    assert metrics["pp_bubble_fraction"] == pytest.approx(3 / 19)
+    # the bubble-fraction value must NOT masquerade as a throughput
+    assert "examples_per_sec" not in metrics
+    assert runlog.DEFAULT_THRESHOLDS["onefonb_vs_gpipe"] == ("down", 0.15)
+    assert runlog.DEFAULT_THRESHOLDS["pp_bubble_fraction"][0] == "up"
+
+  def test_ratio_collapse_and_bubble_growth_flagged(self):
+    from tensor2robot_tpu.obs import runlog
+
+    deltas = {d["metric"]: d
+              for d in runlog.diff_records(self._rec(1.0, 3 / 19),
+                                           self._rec(0.7, 3 / 19))}
+    assert deltas["onefonb_vs_gpipe"]["regressed"]
+    assert not deltas["pp_bubble_fraction"]["regressed"]
+    # a schedule edit that grows the static bubble is flagged even when
+    # the measured ratio holds (e.g. the host masked it)
+    deltas = {d["metric"]: d
+              for d in runlog.diff_records(self._rec(1.0, 3 / 19),
+                                           self._rec(1.0, 3 / 11))}
+    assert deltas["pp_bubble_fraction"]["regressed"]
+    # small wobble inside both bands: clean
+    deltas = {d["metric"]: d
+              for d in runlog.diff_records(self._rec(1.0, 3 / 19),
+                                           self._rec(0.95, 3 / 19))}
+    assert not any(d["regressed"] for d in deltas.values())
+
+
+def test_pp_schedule_code_backend_free(tmp_path):
+  """Poisoned-platform trap over the schedule-selection/accounting code
+  and the pp lint rule: importing pipeline_parallel, pricing schedules,
+  computing the interleave permutation, and linting a call site must
+  never initialize a JAX backend (same trap as tests/test_stager.py —
+  on this machine a backend init is also a TPU-tunnel hazard)."""
+  import os as os_lib
+  import subprocess
+  import sys
+
+  repo_root = os_lib.path.dirname(
+      os_lib.path.dirname(os_lib.path.abspath(__file__)))
+  code = """
+from tensor2robot_tpu.parallel import pipeline_parallel as pp
+acc = pp.schedule_accounting(4, 8, 2)
+assert acc["total_ticks"] == 19 and acc["idle_ticks_per_rank"] == 3
+gpipe = pp.schedule_accounting(4, 8, 1)
+assert acc["bubble_fraction"] < gpipe["bubble_fraction"]
+assert pp.interleave_order(4, 2).tolist() == [0, 4, 1, 5, 2, 6, 3, 7]
+from tensor2robot_tpu.analysis import pp_check
+findings = pp_check.check_python_source(
+    "x.py", "s = make_pipelined_train_step(f, l, o, m)\\n")
+assert [f.rule for f in findings] == ["pp-schedule-unaudited"]
+from tensor2robot_tpu.analysis import lint
+assert "pp-schedule-unaudited" in lint._RULE_CATALOG
+from jax._src import xla_bridge
+live = getattr(xla_bridge, "_backends", None)
+assert not live, f"jax backends were initialized: {sorted(live)}"
+print("NO_BACKEND_OK")
+"""
+  env = {**os_lib.environ, "PYTHONPATH": repo_root,
+         "JAX_PLATFORMS": "pp_schedule_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=600,
+                          cwd=repo_root, env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "NO_BACKEND_OK" in result.stdout
+
+
+class TestPipelinedModelVirtualStages:
+  """The T2RModel carrier on the 1F1B schedule: num_virtual_stages=2
+  through the generic step factory (configs/train_pipelined_1f1b.gin)."""
+
+  def _model(self, **kwargs):
+    import optax
+
+    from tensor2robot_tpu.models import pipelined_model
+
+    kwargs.setdefault("obs_size", 8)
+    kwargs.setdefault("action_size", 3)
+    kwargs.setdefault("hidden_size", 16)
+    kwargs.setdefault("num_stages", 8)
+    kwargs.setdefault("num_virtual_stages", 2)
+    kwargs.setdefault("num_microbatches", 8)
+    kwargs.setdefault("device_type", "cpu")
+    kwargs.setdefault("optimizer_fn", lambda: optax.adam(3e-3))
+    return pipelined_model.PipelinedRegressionModel(**kwargs)
+
+  def test_1f1b_step_matches_sequential_step(self):
+    """Same init, one train step: the interleaved schedule on a pp mesh
+    produces the same loss and updated params as the sequential trunk
+    (1F1B is a schedule, not a different function)."""
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.models import pipelined_model
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    results = {}
+    for name, use_mesh in (("seq", False), ("pp", True)):
+      model = self._model()
+      features = specs_lib.make_random_numpy(
+          model.get_feature_specification("train"), batch_size=16, seed=0)
+      labels = specs_lib.make_random_numpy(
+          model.get_label_specification("train"), batch_size=16, seed=1)
+      if use_mesh:
+        model.set_mesh(mesh)
+        state, shardings = ts.create_train_state(
+            model, jax.random.PRNGKey(0), features, mesh=mesh,
+            rules=pipelined_model.pipeline_parallel_rules())
+        step = ts.make_train_step(model, mesh=mesh, shardings=shardings,
+                                  donate=False)
+        f = mesh_lib.put_host_batch(mesh, features)
+        l = mesh_lib.put_host_batch(mesh, labels)
+      else:
+        state, _ = ts.create_train_state(model, jax.random.PRNGKey(0),
+                                         features)
+        step = ts.make_train_step(model, donate=False)
+        f, l = features, labels
+      new_state, metrics = step(state, f, l)
+      results[name] = (float(metrics["loss"]),
+                       jax.device_get(new_state.params))
+    assert results["pp"][0] == pytest.approx(results["seq"][0], rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(results["pp"][1]),
+                    jax.tree_util.tree_leaves(results["seq"][1])):
+      np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+  def test_stage_params_sharded_and_loss_decreases(self):
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.models import pipelined_model
+
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    model = self._model()
+    model.set_mesh(mesh)
+    features = specs_lib.make_random_numpy(
+        model.get_feature_specification("train"), batch_size=32, seed=0)
+    labels = specs_lib.make_random_numpy(
+        model.get_label_specification("train"), batch_size=32, seed=1)
+    state, shardings = ts.create_train_state(
+        model, jax.random.PRNGKey(0), features, mesh=mesh,
+        rules=pipelined_model.pipeline_parallel_rules())
+    # [S*v] stacked stage params sharded over the 4-wide pp axis
+    w1 = state.params["stages_w1"]
+    assert w1.shape[0] == 8
+    assert w1.sharding.spec == PartitionSpec("pp", None, None), w1.sharding
+    step = ts.make_train_step(model, mesh=mesh, shardings=shardings)
+    f = mesh_lib.put_host_batch(mesh, features)
+    l = mesh_lib.put_host_batch(mesh, labels)
+    first = None
+    for _ in range(40):
+      state, metrics = step(state, f, l)
+      first = first if first is not None else float(metrics["loss"])
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["loss"]) < first, (first, float(metrics["loss"]))
+
+  def test_set_mesh_rejects_chunk_mismatch(self):
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    model = self._model(num_stages=6)  # 6 != 4 ranks x 2 chunks
+    with pytest.raises(ValueError, match="virtual"):
+      model.set_mesh(mesh)
+
+
+class TestVirtualStageSharpEdges:
+  """Review-hardening pins: mesh-independent divisibility validation and
+  the shard_pipeline_tree v>1 placement."""
+
+  def test_model_rejects_indivisible_virtual_stages(self):
+    from tensor2robot_tpu.models import pipelined_model
+
+    with pytest.raises(ValueError, match="multiple"):
+      pipelined_model.PipelinedRegressionModel(num_stages=6,
+                                               num_virtual_stages=4)
+    with pytest.raises(ValueError, match="multiple"):
+      pipelined_model.PipelinedRegressionModel(num_stages=4,
+                                               num_virtual_stages=0)
+
+  def test_shard_pipeline_tree_places_any_stage_multiple(self):
+    """A v>1 stage stack placed WITHOUT the num_virtual_stages argument
+    still lands sharded over 'pp' (the silent-replication trap), while
+    scalars and non-multiple leaves stay replicated."""
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    tree = {"v2_stack": jnp.zeros((8, 3)),   # S*v with v=2, arg omitted
+            "v1_stack": jnp.zeros((4, 3)),
+            "count": jnp.zeros(()),
+            "odd": jnp.zeros((6, 3))}        # not a multiple of 4 ranks
+    placed = pp.shard_pipeline_tree(tree, mesh, "pp")
+    assert placed["v2_stack"].sharding.spec == PartitionSpec("pp")
+    assert placed["v1_stack"].sharding.spec == PartitionSpec("pp")
+    assert placed["count"].sharding.spec == PartitionSpec()
+    assert placed["odd"].sharding.spec == PartitionSpec()
+
+  def test_heterogeneous_rejects_wrong_stack_dim(self):
+    """A [S, P_max] stack fed to an S*v-function call must raise, not
+    silently clamp chunk gathers onto chunk 0's params."""
+    mesh = mesh_lib.create_mesh(mesh_shape=(2, 4, 1),
+                                axis_names=("data", "pp", "model"))
+    fns, unravels, sizes, stacked, micro = (
+        TestHeterogeneousPipeline()._setup())
+    with pytest.raises(ValueError, match="leading dim"):
+      pp.pipelined_apply_heterogeneous(
+          fns * 2, unravels * 2, sizes * 2, stacked, micro, mesh,
+          num_virtual_stages=2)
