@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Stateful-session serve bench + regression gate.
+#
+# One headline run, diffed against ITS OWN previous record in runs.jsonl
+# with `graftscope diff` (train/serve/cache/data/pp records interleave
+# in the same file; the index lookup below selects the session family):
+#
+#   `bench.py --session` — seq_session_tick_ms_cpu_smoke: paired
+#   stateless-full-prefix vs cached-decode episodes over the causal
+#   sequence model at T in {8, 32} (PERFORMANCE.md "Reading a session
+#   bench"). Gated metrics:
+#     session_vs_stateless — the load-invariant paired per-tick cost
+#                            ratio at T=32 (down-bad 15%; the ISSUE 11
+#                            acceptance floor is 2.0x),
+#     decode_tick_ms       — absolute cached tick cost (up-bad 50%;
+#                            wall-clock on the 1-core host, loose band
+#                            — host_load in the headline attributes
+#                            noise).
+#
+# A regression in either exits non-zero exactly like a training one.
+#
+# Usage: scripts/session_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# The index lookup runs OUTSIDE a process substitution so a failure
+# (unreadable runs.jsonl, broken import) fails the script loudly
+# instead of reading as "no baseline" and silently skipping the gate.
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+  ) || { echo "session_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "session_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
+
+JAX_PLATFORMS=cpu python bench.py --session
+# The session family gates on its two purpose-built metrics; every
+# other wall-clock (warmup/compile) swings 4x with host load on this
+# VM, so those absolute thresholds are opened wide rather than training
+# people to ignore a flappy gate.
+gate_family seq_session_tick \
+    --threshold compile_time_s=10.0 --threshold flops_per_step=10.0 \
+    --threshold bytes_per_step=10.0 --threshold jaxpr_eqns=10.0 \
+    --threshold warmup_ms=10.0

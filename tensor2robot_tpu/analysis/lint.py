@@ -28,7 +28,8 @@ import sys
 from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       native_check, pp_check, spec_check,
+                                       native_check, pp_check,
+                                       session_check, spec_check,
                                        thread_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
@@ -77,6 +78,16 @@ pipeline rules (.py):
                          pp/bubble_fraction schedule telemetry never
                          reach runs.jsonl; a `**splat` call site is
                          accepted
+
+session rules (.py):
+  session-state-leak     a decode-step call site that discards the
+                         returned session state (bare expression, or
+                         the state slot bound to an underscore name) —
+                         later ticks replay the stale cache — or an
+                         np.asarray/device_get host fetch of a
+                         session_state/arena value, which re-buys the
+                         stateless per-tick cost (and ~1.5 s per eager
+                         fetch over the tunnel)
 
 thread rules (.py):
   thread-stage-missing-close     a class starts a threading.Thread but
@@ -144,6 +155,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(spec_check.check_python_file(path, mesh_axes))
     findings.extend(cache_check.check_python_file(path))
     findings.extend(pp_check.check_python_file(path))
+    findings.extend(session_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked

@@ -219,6 +219,36 @@ class T2RModel(ModelInterface):
       return dict(inference_outputs.items())
     return {"output": inference_outputs}
 
+  # -- session-decode seam (ISSUE 11: stateful serving sessions) ------------
+
+  @property
+  def supports_sessions(self) -> bool:
+    """True when the model exposes the O(1)-per-tick decode seam below
+    (`serving.session.SessionEngine` checks this before building decode
+    executables). Sequential models override all three members."""
+    return False
+
+  def init_session_state(self, batch_size: int):
+    """Fresh per-session recurrent/KV state as a HOST pytree of numpy
+    zeros with leading dim `batch_size` — one row per session, including
+    an `index` leaf ([batch] int32, the session's current tick). The
+    serving arena stacks these rows device-side; backend-free by
+    contract (no jax import on this path)."""
+    raise NotImplementedError(
+        f"{type(self).__name__} has no session-decode seam; set "
+        "supports_sessions/init_session_state/decode_step_fn to serve "
+        "it through stateful sessions.")
+
+  def decode_step_fn(self):
+    """A PURE `fn(state, session_state, features) -> (new_session_state,
+    outputs)` advancing every session row ONE tick: `features` holds
+    model-layout per-tick slices (e.g. observation [B, obs]), and the
+    returned state must be rebound by the caller — the graftlint
+    `session-state-leak` rule flags call sites that drop it. Jitted and
+    bucket-compiled by `serving.session.SessionEngine`."""
+    raise NotImplementedError(
+        f"{type(self).__name__} has no session-decode seam.")
+
   def create_optimizer(self) -> optax.GradientTransformation:
     """Optax chain; gin-injected factory wins (reference create_optimizer +
     MovingAverage wrapping, abstract_model.py:836-871). Subclasses may

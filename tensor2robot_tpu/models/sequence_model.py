@@ -16,11 +16,22 @@ are born sequence-sharded.
 Backends 'reference' (plain XLA attention) and 'flash' (the Pallas
 kernel) use the same module single-chip — the SAME function, so tests
 pin ring == reference numerics through the full train step.
+
+Session-decode seam (ISSUE 11): both models here implement the
+`supports_sessions`/`init_session_state`/`decode_step_fn` contract from
+`models.abstract` so `serving.session.SessionEngine` can advance live
+robot episodes one O(1) tick at a time instead of re-running the O(T)
+prefix per control tick — causal-attention KV append for this trunk
+(`ops.attention.cached_attention`), LSTM carry threading for
+`LSTMRegressionModel`. The decode path is pure functions over the SAME
+param pytree the full forward trains (flax submodules applied
+functionally per piece), and tests/test_session.py pins tick-by-tick
+numerical parity against the stateless full-prefix forward.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import flax.linen as nn
 import jax
@@ -31,10 +42,27 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.layers import attention_layers
 from tensor2robot_tpu.models import abstract as abstract_model
+from tensor2robot_tpu.ops import attention as attention_ops
 from tensor2robot_tpu.specs import SpecStruct, TensorSpec
 from tensor2robot_tpu.utils import config
 
-__all__ = ["SequenceRegressionModel"]
+__all__ = ["SequenceRegressionModel", "LSTMRegressionModel"]
+
+
+# -- functional decode pieces -------------------------------------------------
+#
+# The decode path re-applies the TRAINED flax submodules functionally on
+# per-tick slices (nn.Dense/nn.LayerNorm `.apply` over the extracted
+# param subtree), so full-forward and decode share one set of weights
+# and one numerics contract — no shadow implementation to drift.
+
+
+def _dense(p, x):
+  return nn.Dense(features=p["kernel"].shape[-1]).apply({"params": p}, x)
+
+
+def _layernorm(p, x):
+  return nn.LayerNorm().apply({"params": p}, x)
 
 
 class _AttentionTrunk(nn.Module):
@@ -180,3 +208,180 @@ class SequenceRegressionModel(abstract_model.T2RModel):
   def model_train_fn(self, features, labels, inference_outputs, mode):
     loss = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
     return loss, {"mse": loss}
+
+  # -- session-decode seam (ISSUE 11) ---------------------------------------
+
+  @property
+  def supports_sessions(self) -> bool:
+    return True
+
+  @property
+  def decode_observation_spec(self) -> SpecStruct:
+    """Per-TICK wire layout (the feature spec minus the time dim): what
+    one session hands the decode dispatch each control tick."""
+    return SpecStruct({
+        "observation": TensorSpec(shape=(self._obs_size,),
+                                  dtype=np.float32, name="observation"),
+    })
+
+  @property
+  def decode_max_ticks(self) -> int:
+    """Decode horizon == KV-cache capacity: a tick at index >= T would
+    be an out-of-bounds scatter that XLA silently DROPS (the write
+    vanishes, the mask stays all-true, outputs go quietly wrong) — the
+    engine enforces this bound with a loud error instead."""
+    return self._sequence_length
+
+  def init_session_state(self, batch_size: int) -> Dict[str, np.ndarray]:
+    """Zeroed KV cache rows, [B, T, H, D] per block (T-major so the
+    arena's per-session append is one advanced-index write) + the [B]
+    tick index. Numpy on purpose — backend-free until the serving arena
+    places it."""
+    head_dim = self._hidden_size // self._num_heads
+    kv_shape = (batch_size, self._sequence_length, self._num_heads,
+                head_dim)
+    state: Dict[str, np.ndarray] = {
+        "index": np.zeros((batch_size,), np.int32)}
+    for i in range(self._num_blocks):
+      state[f"k_{i}"] = np.zeros(kv_shape, np.float32)
+      state[f"v_{i}"] = np.zeros(kv_shape, np.float32)
+    return state
+
+  def decode_step_fn(self):
+    """Pure per-tick forward: embed -> N x (pre-LN cached-attention +
+    pre-LN MLP, residual) -> head, appending this tick's K/V at each
+    session's own index (`ops.attention.cached_attention` pins the
+    masked-softmax numerics to the causal full-prefix row)."""
+    num_blocks = self._num_blocks
+    num_heads = self._num_heads
+    head_dim = self._hidden_size // self._num_heads
+
+    def decode_step(state, session_state, features):
+      params = state.eval_params()
+      obs = features["observation"]  # [B, obs]
+      b = obs.shape[0]
+      index = session_state["index"]  # [B] int32, this tick's position
+      rows = jnp.arange(b)
+      x = _dense(params["embed"], obs)  # [B, hidden]
+      new_state = {"index": index + 1}
+      for i in range(num_blocks):
+        y = _layernorm(params[f"ln_attn_{i}"], x)
+        attn = params[f"attn_{i}"]
+        q = _dense(attn["q_proj"], y).reshape(b, num_heads, head_dim)
+        k_t = _dense(attn["k_proj"], y).reshape(b, num_heads, head_dim)
+        v_t = _dense(attn["v_proj"], y).reshape(b, num_heads, head_dim)
+        k_cache = session_state[f"k_{i}"].at[rows, index].set(k_t)
+        v_cache = session_state[f"v_{i}"].at[rows, index].set(v_t)
+        new_state[f"k_{i}"] = k_cache
+        new_state[f"v_{i}"] = v_cache
+        out = attention_ops.cached_attention(q, k_cache, v_cache, index)
+        y = _dense(attn["out_proj"], out.reshape(b, num_heads * head_dim))
+        x = x + y
+        y = _layernorm(params[f"ln_mlp_{i}"], x)
+        y = _dense(params[f"mlp_out_{i}"],
+                   nn.gelu(_dense(params[f"mlp_in_{i}"], y)))
+        x = x + y
+      action = _dense(params["head"], x)  # [B, act]
+      return new_state, {"action": action, "inference_output": action}
+
+    return decode_step
+
+
+class _LSTMTrunk(nn.Module):
+  """obs [B, T, obs] -> LSTM over time -> Dense head -> [B, T, act].
+
+  The §2.3/§2.4 recurrent-family stand-in for serving: the reference's
+  LSTM policies (LSTMCEMPolicy hidden-state threading,
+  /root/reference/policies/policies.py:188-218) carried recurrent state
+  HOST-side between predicts; here the carry is the session-decode
+  state, resident on device between control ticks."""
+
+  action_size: int = 7
+  hidden_size: int = 64
+
+  @nn.compact
+  def __call__(self, features, mode: str = modes_lib.TRAIN,
+               train: bool = False):
+    x = features["observation"]  # [B, T, obs]
+    cell = nn.OptimizedLSTMCell(features=self.hidden_size,
+                                name="lstm_cell")
+    h = nn.RNN(cell, name="rnn")(x)  # [B, T, hidden]
+    action = nn.Dense(self.action_size, name="head")(h)
+    return specs_lib.SpecStruct({
+        "action": action,
+        "inference_output": action,
+    })
+
+
+@config.configurable
+class LSTMRegressionModel(abstract_model.T2RModel):
+  """[B, T, obs] -> [B, T, action] LSTM regression; the recurrent-carry
+  carrier for the session-decode seam (one `OptimizedLSTMCell` step per
+  control tick, carry resident in the serving arena)."""
+
+  def __init__(self, obs_size: int = 16, action_size: int = 7,
+               sequence_length: int = 32, hidden_size: int = 64,
+               **kwargs):
+    super().__init__(**kwargs)
+    self._obs_size = obs_size
+    self._action_size = action_size
+    self._sequence_length = sequence_length
+    self._hidden_size = hidden_size
+
+  def get_feature_specification(self, mode):
+    return SpecStruct({
+        "observation": TensorSpec(
+            shape=(self._sequence_length, self._obs_size),
+            dtype=np.float32, name="observation"),
+    })
+
+  def get_label_specification(self, mode):
+    return SpecStruct({
+        "action": TensorSpec(
+            shape=(self._sequence_length, self._action_size),
+            dtype=np.float32, name="action"),
+    })
+
+  def create_module(self):
+    return _LSTMTrunk(action_size=self._action_size,
+                      hidden_size=self._hidden_size)
+
+  def model_train_fn(self, features, labels, inference_outputs, mode):
+    loss = jnp.mean((inference_outputs["action"] - labels["action"]) ** 2)
+    return loss, {"mse": loss}
+
+  # -- session-decode seam (ISSUE 11) ---------------------------------------
+
+  @property
+  def supports_sessions(self) -> bool:
+    return True
+
+  @property
+  def decode_observation_spec(self) -> SpecStruct:
+    return SpecStruct({
+        "observation": TensorSpec(shape=(self._obs_size,),
+                                  dtype=np.float32, name="observation"),
+    })
+
+  def init_session_state(self, batch_size: int) -> Dict[str, np.ndarray]:
+    """Zeroed LSTM carry (matches `initialize_carry`, which is zeros for
+    LSTM cells) + the [B] tick index."""
+    carry = np.zeros((batch_size, self._hidden_size), np.float32)
+    return {"index": np.zeros((batch_size,), np.int32),
+            "carry_c": carry, "carry_h": carry.copy()}
+
+  def decode_step_fn(self):
+    hidden_size = self._hidden_size
+
+    def decode_step(state, session_state, features):
+      params = state.eval_params()
+      obs = features["observation"]  # [B, obs]
+      cell = nn.OptimizedLSTMCell(features=hidden_size)
+      carry = (session_state["carry_c"], session_state["carry_h"])
+      carry, h = cell.apply({"params": params["lstm_cell"]}, carry, obs)
+      action = _dense(params["head"], h)
+      new_state = {"index": session_state["index"] + 1,
+                   "carry_c": carry[0], "carry_h": carry[1]}
+      return new_state, {"action": action, "inference_output": action}
+
+    return decode_step

@@ -31,8 +31,8 @@ from jax.sharding import Mesh, PartitionSpec
 
 from tensor2robot_tpu.parallel import mesh as mesh_lib
 
-__all__ = ["attention", "flash_attention", "ring_attention",
-           "ulysses_attention"]
+__all__ = ["attention", "cached_attention", "flash_attention",
+           "ring_attention", "ulysses_attention"]
 
 
 def _mask_value(dtype) -> jnp.ndarray:
@@ -50,6 +50,32 @@ def attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     scores = jnp.where(mask, scores, _mask_value(scores.dtype))
   weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
   return jnp.einsum("bhqk,bhkd->bhqd", weights.astype(q.dtype), v)
+
+
+def cached_attention(q_t: jnp.ndarray, k_cache: jnp.ndarray,
+                     v_cache: jnp.ndarray, index: jnp.ndarray
+                     ) -> jnp.ndarray:
+  """One decode tick against a per-session KV cache: O(1) attention work
+  per step instead of the O(T) full-prefix re-run (ISSUE 11 / PAPERS.md
+  "Portable O(1) Autoregressive Caching for Inference").
+
+  q_t: [B, H, D] — this tick's single query per session;
+  k_cache/v_cache: [B, T_max, H, D] — T-major so the serving arena's
+  per-session append is one advanced-index `.at[rows, index].set`;
+  index: [B] int32 — each session's CURRENT tick (sessions in one
+  continuous-batching dispatch sit at different episode positions).
+
+  Numerics are pinned to row `index` of `attention(..., causal=True)`:
+  positions past a session's index score `_mask_value` — exactly what
+  the causal mask assigns them there — so the f32 softmax sees the same
+  masked score row and `exp` underflows them to exactly 0.
+  """
+  scale = 1.0 / math.sqrt(q_t.shape[-1])
+  scores = jnp.einsum("bhd,bthd->bht", q_t, k_cache) * scale
+  valid = jnp.arange(k_cache.shape[1])[None, :] <= index[:, None]  # [B,T]
+  scores = jnp.where(valid[:, None, :], scores, _mask_value(scores.dtype))
+  weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+  return jnp.einsum("bht,bthd->bhd", weights.astype(q_t.dtype), v_cache)
 
 
 # -- online-softmax block update (shared by flash + ring) -------------------

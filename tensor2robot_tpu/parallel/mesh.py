@@ -208,7 +208,8 @@ class DevicePrefetcher:
 
   def __init__(self, dataset, mesh: Optional[Mesh] = None, batch_spec=None,
                depth: int = 2, max_batches: Optional[int] = None,
-               place_fn=None, close_source: bool = False, source=None):
+               place_fn=None, close_source: bool = False, source=None,
+               overlap_place: bool = True):
     import itertools
     import queue
     import threading
@@ -254,8 +255,8 @@ class DevicePrefetcher:
     depth_gauge = obs_metrics.gauge("data/overlap_device_queue_depth")
     perf_counter_ns = time_lib.perf_counter_ns
 
-    # The worker closes over locals only — never `self` — so an
-    # abandoned-without-close() prefetcher is actually collectable (the
+    # The workers close over locals only — never `self` — so an
+    # abandoned-without-close() prefetcher is actually collectable (a
     # live thread would otherwise keep `self` reachable forever and the
     # finalizer below could never fire).
     def _put_final(item):
@@ -267,6 +268,9 @@ class DevicePrefetcher:
           continue
 
     def _worker():
+      # Serial fallback (overlap_place=False): one thread does
+      # next(dataset) then place_fn — the pre-ROADMAP-6 shape, kept for
+      # A/Bs and for place_fns that must not overlap their source.
       try:
         for batch in dataset:
           if stop.is_set():
@@ -295,12 +299,86 @@ class DevicePrefetcher:
       finally:
         phase[0] = "done"
 
-    self._thread = threading.Thread(target=_worker, daemon=True,
-                                    name="device-prefetch")
+    # Overlapped placement (ROADMAP item 6: "unserialize device_put
+    # placement"): the single worker used to SERIALIZE next(dataset)
+    # with place_fn, so the device transfer of batch N blocked the
+    # host-pipeline dequeue of batch N+1. Split into a feeder (host
+    # dequeue) and a placer (device_put) over a bounded host queue —
+    # batch N+1's source wait now overlaps batch N's transfer. FIFO
+    # hand-off on both sides keeps the stream byte-identical to the
+    # serial worker (tests/test_overlap.py pins it).
+    host_queue = queue.Queue(maxsize=depth) if overlap_place else None
+    host_depth_gauge = obs_metrics.gauge("data/overlap_host_queue_depth")
+
+    def _hq_put(item) -> bool:
+      while not stop.is_set():
+        try:
+          host_queue.put(item, timeout=0.1)
+          return True
+        except queue.Full:
+          continue
+      return False
+
+    def _feeder():
+      try:
+        for batch in dataset:
+          if stop.is_set():
+            return
+          if not _hq_put(batch):
+            return
+          host_depth_gauge.set(float(host_queue.qsize()))
+        _hq_put(sentinel)
+      except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+        _hq_put(e)
+
+    def _placer():
+      try:
+        while not stop.is_set():
+          try:
+            item = host_queue.get(timeout=0.1)
+          except queue.Empty:
+            continue
+          if item is sentinel:
+            _put_final(sentinel)
+            return
+          if isinstance(item, BaseException):
+            _put_final(item)
+            return
+          phase[0] = "transfer"
+          t0 = perf_counter_ns()
+          placed = place_fn(item)
+          place_hist.record((perf_counter_ns() - t0) * 1e-6)
+          phase[0] = "queue"
+          while not stop.is_set():
+            try:
+              out_queue.put(placed, timeout=0.1)
+              break
+            except queue.Full:
+              continue
+          if stop.is_set():
+            return
+          depth_gauge.set(float(out_queue.qsize()))
+          phase[0] = "host"
+      except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+        _put_final(e)
+      finally:
+        phase[0] = "done"
+
+    if overlap_place:
+      self._feeder = threading.Thread(target=_feeder, daemon=True,
+                                      name="device-prefetch-feed")
+      self._thread = threading.Thread(target=_placer, daemon=True,
+                                      name="device-prefetch")
+      self._feeder.start()
+    else:
+      self._feeder = None
+      self._thread = threading.Thread(target=_worker, daemon=True,
+                                      name="device-prefetch")
     self._thread.start()
     # Backstop for abandoned instances: stop (but never join, which is
-    # illegal from a GC callback) the worker so it cannot spin at 10 Hz
-    # holding device batches forever. close() remains the correct path.
+    # illegal from a GC callback) the workers so they cannot spin at
+    # 10 Hz holding device batches forever. close() remains the correct
+    # path.
     self._finalizer = weakref.finalize(self, stop.set)
 
   def __iter__(self):
@@ -330,48 +408,60 @@ class DevicePrefetcher:
 
     The join matters on the axon tunnel: a daemon thread killed at
     interpreter shutdown mid device_put is a killed TPU client — the
-    documented tunnel-wedging hazard (CLAUDE.md). The worker checks the
-    stop event at least every 0.1 s, so the join is normally bounded by
-    one in-flight put_host_batch. The `timeout` applies ONLY while the
-    worker is blocked inside next(dataset) on a stalled data source
-    (which never sees the stop event): close() then returns, logging
-    loudly, rather than hang — which matters on the preemption
-    save-and-exit path where a timely SystemExit beats a clean thread
-    shutdown. While the worker is mid device transfer ("transfer"
-    phase), close() keeps waiting regardless of `timeout` — abandoning a
-    thread with an in-flight TPU op is the wedging hazard itself.
+    documented tunnel-wedging hazard (CLAUDE.md). The workers check the
+    stop event at least every 0.1 s, so the joins are normally bounded
+    by one in-flight batch. The `timeout` applies ONLY to a thread
+    blocked inside next(dataset) on a stalled data source (the FEEDER
+    under the default overlapped placement, the single worker in the
+    `overlap_place=False` serial mode — the placer never touches the
+    source): close() then returns, logging loudly, rather than hang —
+    which matters on the preemption save-and-exit path where a timely
+    SystemExit beats a clean thread shutdown. While the placer is mid
+    device transfer ("transfer" phase), close() keeps waiting
+    regardless of `timeout` — abandoning a thread with an in-flight TPU
+    op is the wedging hazard itself.
     """
     self._done = True
     self._stop.set()
+    import time
+
     deadline = None
     while True:
       self._thread.join(timeout=1.0)
       if not self._thread.is_alive():
-        self._close_source()
-        return
+        break
       if self._phase[0] == "transfer":
         deadline = None  # device op in flight: wait it out, full stop
         continue
-      import time
-
       if deadline is None:
         deadline = time.monotonic() + timeout
       elif time.monotonic() >= deadline:
         break
+    stalled = self._thread if self._thread.is_alive() else None
+    if stalled is None and self._feeder is not None:
+      # Placer down; the feeder sees the stop event within 0.1 s unless
+      # it is blocked in next(dataset) on a stalled source.
+      self._feeder.join(timeout=timeout)
+      if self._feeder.is_alive():
+        stalled = self._feeder
+    if stalled is None:
+      self._close_source()
+      return
     # Stalled inside next(dataset): closing a closable source (e.g. an
     # OverlappedLoader — its get() watches the loader's own stop event)
-    # is exactly what unsticks the worker, so try that before giving up
-    # on the thread (only when this prefetcher actually owns a source).
+    # is exactly what unsticks the thread, so try that before giving up
+    # on it (only when this prefetcher actually owns a source).
     if self._close_source():
-      self._thread.join(timeout=5.0)
-      if not self._thread.is_alive():
+      stalled.join(timeout=5.0)
+      if not stalled.is_alive():
         return
     from absl import logging
 
     logging.error(
-        "DevicePrefetcher.close(): worker still alive after %.0fs in "
+        "DevicePrefetcher.close(): %s still alive after %.0fs in "
         "phase %r — blocked in next(dataset) on a stalled data source; "
-        "abandoning the daemon thread.", timeout, self._phase[0])
+        "abandoning the daemon thread.", stalled.name, timeout,
+        self._phase[0])
 
   def _close_source(self) -> bool:
     """Closes a `close_source=True` source exactly once (best-effort:

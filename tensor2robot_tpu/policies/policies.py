@@ -23,7 +23,8 @@ from tensor2robot_tpu.ops import cem as cem_lib
 from tensor2robot_tpu.utils import config
 
 __all__ = ["Policy", "CEMPolicy", "LSTMCEMPolicy", "RegressionPolicy",
-           "SequentialRegressionPolicy", "OUExploreRegressionPolicy",
+           "SequentialRegressionPolicy", "SessionRegressionPolicy",
+           "OUExploreRegressionPolicy",
            "ScheduledExplorationRegressionPolicy", "PerEpisodeSwitchPolicy",
            "OUNoiseProcess", "boundary_schedule_value"]
 
@@ -210,6 +211,79 @@ class SequentialRegressionPolicy(RegressionPolicy):
       action = action_all
     self._timestep += 1
     return action
+
+
+@config.configurable
+class SessionRegressionPolicy(Policy):
+  """Regression policy riding a graftserve SESSION (ISSUE 11): each
+  episode is one server-side session whose decode cache lives on device
+  between control ticks — every `select_action` costs one O(1) decode
+  tick instead of the `SequentialRegressionPolicy` full-prefix re-run.
+
+  `predictor` is anything with the session surface (`open` / `step` /
+  `close_session` — a `serving.SessionEngine` or `SessionBatcher`).
+  `reset()` closes the previous episode's session and opens the next, so
+  `envs.run_env` episodes ride sessions with no loop changes; `close()`
+  also closes a live session (tunnel-safe: the engine waits out an
+  in-flight dispatch before freeing the slot). An eviction under slot
+  pressure surfaces as `SessionEvictedError` from `select_action` — the
+  episode must restart; the policy drops its session id so a later
+  `reset()` starts clean."""
+
+  def __init__(self, predictor=None, action_key: str = "inference_output"):
+    super().__init__(predictor)
+    self._action_key = action_key
+    self._session_id: Optional[int] = None
+
+  @property
+  def session_id(self) -> Optional[int]:
+    return self._session_id
+
+  def reset(self) -> None:
+    self._close_session()
+    self._session_id = self._predictor.open()
+
+  def _close_session(self) -> None:
+    if self._session_id is None:
+      return
+    sid, self._session_id = self._session_id, None
+    try:
+      self._predictor.close_session(sid)
+    except Exception:  # noqa: BLE001 - already evicted/closed server-side
+      pass
+
+  def select_action(self, obs, explore_prob: float = 0.0) -> np.ndarray:
+    if self._session_id is None:
+      self.reset()
+    features = {k: np.asarray(v) for k, v in dict(obs).items()}
+    try:
+      outputs = self._predictor.step(self._session_id, features)
+    except Exception as e:
+      # Three failure classes, three dispositions. (1) The slot is
+      # GONE server-side (evicted / closed / unknown): drop the id —
+      # holding it would mis-route the NEXT episode's ticks. (2) The
+      # episode outran the decode horizon: the session is alive and
+      # still holds its slot, so CLOSE it (a leaked slot per finished
+      # episode is denial-of-service under admission='shed'). (3) Any
+      # transient error (queue-full shed, a concurrent-tick rejection,
+      # a backend hiccup): KEEP the id — the caller can retry this
+      # tick, whereas dropping it would silently reset() mid-episode
+      # onto an empty decode cache (plausible-looking, wrong actions)
+      # and leak the old slot.
+      from tensor2robot_tpu.serving import session as session_lib
+
+      if isinstance(e, session_lib.SessionHorizonError):
+        self._close_session()
+      elif isinstance(e, (session_lib.SessionEvictedError,
+                          session_lib.SessionClosedError,
+                          session_lib.UnknownSessionError)):
+        self._session_id = None
+      raise
+    return np.asarray(outputs[self._action_key])
+
+  def close(self) -> None:
+    self._close_session()
+    super().close()
 
 
 @config.configurable

@@ -45,7 +45,8 @@ from tensor2robot_tpu.parallel import train_step as ts
 from tensor2robot_tpu.utils import config
 
 __all__ = ["AbstractPredictor", "CheckpointPredictor",
-           "ExportedModelPredictor", "EnsemblePredictor", "ServingBundle"]
+           "ExportedModelPredictor", "EnsemblePredictor", "ServingBundle",
+           "DecodeBundle"]
 
 
 class ServingBundle(NamedTuple):
@@ -56,6 +57,20 @@ class ServingBundle(NamedTuple):
   get_state: Callable        # () -> current TrainState (restore-aware)
   preprocess: Callable       # wire features -> model-layout features
   feature_spec: Any          # wire-layout feature spec (warmup synthesis)
+
+
+class DecodeBundle(NamedTuple):
+  """What `serving.session.SessionEngine` needs from a predictor (see
+  `_JaxPredictorBase.decode_bundle`): the model's session-decode seam
+  plus the restore-aware state getter."""
+
+  decode_fn: Callable          # pure (state, session_state, features)
+                               #   -> (new_session_state, outputs)
+  init_session_state: Callable  # (batch_size) -> host numpy state rows
+  get_state: Callable          # () -> current TrainState (restore-aware)
+  observation_spec: Any        # per-TICK feature spec (warmup synthesis)
+  max_ticks: Optional[int] = None  # decode horizon (KV capacity); None
+                                   #   = unbounded (pure-carry models)
 
 
 class AbstractPredictor(abc.ABC):
@@ -177,6 +192,26 @@ class _JaxPredictorBase(AbstractPredictor):
         get_state=lambda: self._state,
         preprocess=preprocess,
         feature_spec=self.get_feature_specification())
+
+  def decode_bundle(self) -> "DecodeBundle":
+    """The session-serving seam (ISSUE 11): the model's pure decode-step
+    fn + session-state initializer, with the SAME restore-aware state
+    getter as `serving_bundle` — a checkpoint hot-swap lands on the next
+    decode tick without re-warming the session engine. Raises for models
+    without the seam (`supports_sessions` is the capability flag)."""
+    self.assert_is_loaded()
+    model = self._model
+    if not getattr(model, "supports_sessions", False):
+      raise ValueError(
+          f"{type(model).__name__} has no session-decode seam "
+          "(supports_sessions is False); serve it through the stateless "
+          "BucketedEngine instead.")
+    return DecodeBundle(
+        decode_fn=model.decode_step_fn(),
+        init_session_state=model.init_session_state,
+        get_state=lambda: self._state,
+        observation_spec=model.decode_observation_spec,
+        max_ticks=getattr(model, "decode_max_ticks", None))
 
   def get_feature_specification(self) -> specs_lib.SpecStruct:
     self.assert_is_loaded()

@@ -1,18 +1,36 @@
 """graftserve: the throughput-oriented inference runtime.
 
-Layer order, robot to chip:
+Layer order, robot to chip — stateless requests:
 
   clients -> MicroBatcher (coalesce + admission control, batcher.py)
           -> BucketedEngine (pad to bucket, cached executable, engine.py)
           -> predictor serving_bundle (jitted predict + state)
 
-plus `loadgen` (closed-loop concurrency sweeps) for measurement. See
+and stateful autoregressive episodes (ISSUE 11):
+
+  episodes -> SessionBatcher (continuous batching w/ session affinity)
+           -> SessionEngine (device-resident state arena, bucketed
+              decode-step executables, admission/eviction, session.py)
+           -> predictor decode_bundle (pure decode step + state)
+
+plus `loadgen` (closed-loop concurrency sweeps AND the open-loop
+session-shaped arrival process) for measurement. See
 docs/ARCHITECTURE.md "Serving runtime (graftserve)".
 """
 
 from tensor2robot_tpu.serving.batcher import (DeadlineError, MicroBatcher,
                                               ShedError, ShutdownError)
 from tensor2robot_tpu.serving.engine import BucketedEngine, bucket_ladder
+from tensor2robot_tpu.serving.session import (SessionBatcher,
+                                              SessionClosedError,
+                                              SessionEngine, SessionError,
+                                              SessionEvictedError,
+                                              SessionHorizonError,
+                                              SessionShedError,
+                                              UnknownSessionError)
 
 __all__ = ["MicroBatcher", "BucketedEngine", "bucket_ladder", "ShedError",
-           "DeadlineError", "ShutdownError"]
+           "DeadlineError", "ShutdownError", "SessionEngine",
+           "SessionBatcher", "SessionError", "SessionShedError",
+           "SessionEvictedError", "UnknownSessionError",
+           "SessionClosedError", "SessionHorizonError"]

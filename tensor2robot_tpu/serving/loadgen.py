@@ -1,18 +1,27 @@
-"""graftserve load generator: closed-loop concurrency sweeps.
+"""graftserve load generator: closed-loop sweeps + open-loop sessions.
 
 The reference has no serving load harness — its predictors are
 exercised one request at a time from robot control loops
 (/root/reference/predictors/exported_savedmodel_predictor.py:53-359);
 throughput under concurrency was never a measured quantity.
 
-The measurement half of the serving runtime: N client threads issue
-requests back-to-back against a predict callable (closed loop — each
-thread's next request waits for its previous answer, the robot-fleet
-traffic shape), and the result is QPS plus latency percentiles read
-from the `serve/request_ms` histogram the serving stack already feeds.
-Shared by `bench.py --serve` (the `qtopt_serve_qps_cpu_smoke` headline)
-and `bin/run_graftserve.py` (ad-hoc load against a real artifact), so
-the two can never measure different things.
+The measurement half of the serving runtime:
+
+* `run_load` — CLOSED loop: N client threads issue requests
+  back-to-back against a predict callable (each thread's next request
+  waits for its previous answer, the robot-fleet traffic shape); QPS
+  plus latency percentiles from the `serve/request_ms` histogram.
+  Shared by `bench.py --serve` and `bin/run_graftserve.py` so the two
+  can never measure different things.
+* `run_session_load` — OPEN loop, session-shaped (ISSUE 11 / ROADMAP
+  item 1's trace-driven shape): session STARTS arrive by a Poisson
+  process at a target rate whether or not earlier episodes finished
+  (the property closed-loop load lacks — a backed-up server still gets
+  new arrivals, which is what exercises session admission/EVICTION),
+  each session runs an episode of K decode ticks with think-time
+  between ticks, and sheds/evictions are counted as outcomes, never
+  raised. This is the only load shape that drives the
+  `SessionEngine`'s slot-pressure paths.
 
 Backend-free at import (numpy + threading + obs only): whether the
 predict callable touches a device is the caller's business.
@@ -24,9 +33,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional
 
+import numpy as np
+
 from tensor2robot_tpu.obs import metrics as obs_metrics
 
-__all__ = ["run_load", "latency_percentiles"]
+__all__ = ["run_load", "run_session_load", "latency_percentiles"]
 
 
 def run_load(predict: Callable[[Mapping[str, Any]], Any],
@@ -85,6 +96,108 @@ def run_load(predict: Callable[[Mapping[str, Any]], Any],
       "errors": errors,
       "wall_sec": wall,
       "qps": total_ok / wall if wall > 0 else 0.0,
+  }
+
+
+def run_session_load(session_target,
+                     make_obs: Callable[[int, int], Mapping[str, Any]],
+                     num_sessions: int,
+                     session_rate_hz: float,
+                     episode_ticks: int,
+                     think_time_ms: float = 0.0,
+                     seed: int = 0) -> Dict[str, Any]:
+  """Open-loop session-shaped load (module docstring).
+
+  `session_target` is anything with the session surface (`open()` /
+  `step(sid, obs)` / `close_session(sid)` — a `SessionEngine` or
+  `SessionBatcher`). `make_obs(session_index, tick)` builds one tick's
+  feature dict. `num_sessions` episode starts are scheduled by a
+  Poisson process of rate `session_rate_hz` (exponential inter-arrival
+  gaps, deterministic per `seed`) — arrivals do NOT wait for earlier
+  episodes, so a saturated engine sees mounting slot pressure; each
+  episode runs `episode_ticks` decode ticks with `think_time_ms`
+  between them (the robot's control-loop cadence).
+
+  Every outcome is counted, never raised: a shed `open()` abandons that
+  episode (`errors['SessionShedError']`), an evicted session stops
+  ticking (`errors['SessionEvictedError']`, `evicted_episodes`), any
+  other per-tick error abandons the episode under its type name.
+
+  Returns {sessions, completed_episodes, evicted_episodes, ok_ticks,
+  errors, wall_sec, ticks_per_sec, achieved_session_rate_hz,
+  target_session_rate_hz}.
+  """
+  if num_sessions < 1 or episode_ticks < 1:
+    raise ValueError("num_sessions and episode_ticks must be >= 1")
+  if session_rate_hz <= 0:
+    raise ValueError("session_rate_hz must be > 0")
+  rng = np.random.RandomState(seed)
+  gaps = rng.exponential(1.0 / session_rate_hz, size=num_sessions)
+  errors: Dict[str, int] = {}
+  lock = threading.Lock()
+  ok_ticks = [0]
+  completed = [0]
+  evicted = [0]
+
+  def count_error(e: BaseException) -> None:
+    with lock:
+      key = type(e).__name__
+      errors[key] = errors.get(key, 0) + 1
+
+  def episode(session_index: int) -> None:
+    try:
+      sid = session_target.open()
+    except Exception as e:  # noqa: BLE001 - shed at admission is an outcome
+      count_error(e)
+      return
+    try:
+      for tick in range(episode_ticks):
+        try:
+          session_target.step(sid, make_obs(session_index, tick))
+        except Exception as e:  # noqa: BLE001 - evict/shutdown are outcomes
+          count_error(e)
+          if type(e).__name__ == "SessionEvictedError":
+            with lock:
+              evicted[0] += 1
+            return  # the slot is gone; close_session would be a no-op
+          return
+        with lock:
+          ok_ticks[0] += 1
+        if think_time_ms > 0 and tick + 1 < episode_ticks:
+          time.sleep(think_time_ms / 1e3)
+      with lock:
+        completed[0] += 1
+    finally:
+      try:
+        session_target.close_session(sid)
+      except Exception:  # noqa: BLE001 - already evicted/closed
+        pass
+
+  threads: List[threading.Thread] = []
+  t0 = time.perf_counter()
+  for i in range(num_sessions):
+    # Open loop: sleep the Poisson gap, then launch — regardless of how
+    # many earlier episodes are still running.
+    time.sleep(float(gaps[i]))
+    thread = threading.Thread(target=episode, args=(i,), daemon=True,
+                              name=f"session-loadgen-{i}")
+    thread.start()
+    threads.append(thread)
+  arrival_wall = time.perf_counter() - t0
+  for thread in threads:
+    thread.join()
+  wall = time.perf_counter() - t0
+  return {
+      "sessions": num_sessions,
+      "completed_episodes": completed[0],
+      "evicted_episodes": evicted[0],
+      "ok_ticks": ok_ticks[0],
+      "errors": errors,
+      "wall_sec": wall,
+      "ticks_per_sec": ok_ticks[0] / wall if wall > 0 else 0.0,
+      "target_session_rate_hz": session_rate_hz,
+      "achieved_session_rate_hz": (num_sessions / arrival_wall
+                                   if arrival_wall > 0 else 0.0),
   }
 
 
