@@ -55,6 +55,13 @@ schedule against GPipe on the virtual 8-device mesh (paired A/B,
 `onefonb_vs_gpipe` + static `pp_bubble_fraction` diff-gated via
 `scripts/pp_bench.sh`; PERFORMANCE.md "Reading a pipeline bench").
 
+Fleet serving (PR 11 / ISSUE 12): `bench.py --fleet` prices the
+multi-replica `ServingFleet` — paired 1-vs-2-replica arms on disjoint
+device groups of the virtual 8-device mesh under identical open-loop
+load, plus a zero-downtime rollout window (`fleet_vs_single_replica`
++ `fleet_rollout_shed` diff-gated via `scripts/fleet_bench.sh`;
+PERFORMANCE.md "Reading a fleet bench").
+
 graftcache (PR 7): every probe routes trace->compile through the
 persistent executable cache at GRAFTCACHE_DIR (default `.graftcache`),
 so re-benching an unchanged config deserializes instead of recompiling;
@@ -1751,6 +1758,330 @@ def _append_serve_runlog(headline: dict, compile_records, device) -> None:
                 compile_records=compile_records)
 
 
+FLEET_REPLICAS = 2
+FLEET_MAX_BATCH = 8
+FLEET_PAIRS = 3
+# The emulated per-dispatch device/tunnel wall (see fleet_main's
+# docstring for why the CPU smoke must model it): fixed so both A/B arms
+# share it exactly and the paired ratio stays load-invariant.
+FLEET_DEVICE_WAIT_MS = 12.0
+FLEET_RATE_HZ = 1200.0
+FLEET_ARRIVALS = 1000
+FLEET_CLIENTS = 96
+FLEET_ROLLOUT_RATE_HZ = 250.0
+FLEET_ROLLOUT_ARRIVALS = 500
+# Recorded for this exact config on this host at first landing
+# (ISSUE 12). Like every absolute wall-clock on the 1-core VM it swings
+# with load — the load-invariant number is fleet_vs_single_replica
+# (paired back-to-back arms). vs_baseline ~= 1.0 reads as "no fleet
+# serving regression vs the recorded baseline", nothing more.
+FLEET_CPU_ANCHOR = 900.0
+
+
+class _HotSwapPredictor:
+  """Bench-local checkpoint-publish stand-in: `restore()` swaps in new
+  params (a deterministic bump) and advances the version, exactly the
+  observable contract of a real checkpoint poll — the bench has no
+  model_dir, and training one inside the bench window would swamp the
+  serving measurement. Everything below the swap (bundle re-bind,
+  cached-executable reuse, router steering) is the REAL rollout path;
+  tests/test_fleet.py pins the same rollout against real on-disk
+  checkpoints."""
+
+  def __init__(self, predictor):
+    self._predictor = predictor
+
+  def restore(self) -> bool:
+    import jax
+
+    state = self._predictor._state
+    bump = lambda t: None if t is None else jax.tree_util.tree_map(  # noqa: E731
+        lambda a: a + 0.125, t)
+    self._predictor._state = state.replace(
+        params=bump(state.params), ema_params=bump(state.ema_params))
+    self._predictor._global_step = self._predictor._global_step + 1
+    return True
+
+  def __getattr__(self, name):
+    return getattr(self._predictor, name)
+
+
+class _DeviceWaitEngine:
+  """Emulates the device/tunnel wall component of a replica dispatch on
+  the CPU smoke: real engine predict (real compiled executable, real
+  padding/fetch) followed by a fixed sleep standing in for the
+  non-host-CPU wall time a production dispatch spends in device
+  execution / tunnel transport (~1.5 s/eager op over axon; ms-scale on
+  a local chip). On this 1-core VM the pure-CPU arm measures ~1.0x for
+  2 replicas by construction (two threads of host work cannot exceed
+  one core — measured 0.99x, PERFORMANCE.md "Reading a fleet bench"),
+  so the CPU smoke prices what the fleet layer actually adds in
+  production: keeping N device pipelines full. Both A/B arms wear the
+  SAME wrapper, so the wait cancels out of everything except the
+  overlap the router achieves."""
+
+  def __init__(self, engine, wait_ms: float):
+    self._engine = engine
+    self._wait_ms = wait_ms
+
+  def predict(self, features):
+    outputs = self._engine.predict(features)
+    if self._wait_ms:
+      time.sleep(self._wait_ms / 1e3)
+    return outputs
+
+  def __getattr__(self, name):
+    return getattr(self._engine, name)
+
+
+def fleet_main() -> None:
+  """Fleet-serving bench: ONE JSON headline line (CPU smoke path).
+
+  THE ISSUE 12 acceptance numbers, measured as paired back-to-back A/B
+  arms over the QT-Opt flagship critic on the virtual 8-device mesh
+  (XLA_FLAGS host-platform device count, same topology tier-1 tests
+  use; `parallel.mesh.replica_device_groups` carves 4 devices per
+  replica and each replica's predictor state is committed to its
+  group's lead device):
+
+  * single arm — a 1-replica `ServingFleet` (router + one
+    MicroBatcher + one BucketedEngine): the pre-fleet serving shape
+    plus router overhead, so the ratio prices the fleet's scaling, not
+    the router's absence;
+  * fleet arm — the 2-replica `ServingFleet` over disjoint device
+    groups.
+
+  Both arms serve identical open-loop Poisson traffic
+  (`loadgen.run_trace_load` — arrivals admitted on schedule regardless
+  of completions, the only load shape that saturates honestly) with an
+  identical per-dispatch emulated device wall (`_DeviceWaitEngine`:
+  this host has ONE core, so replicating pure-CPU work measures 0.99x
+  flat by physics; the production win is overlapping the device/tunnel
+  wall across replicas, and the smoke models exactly that component,
+  with the real CPU dispatch cost measured and reported beside it).
+  `fleet_vs_single_replica` is the pair-median goodput ratio —
+  back-to-back pairs with alternating order make it load-invariant on
+  this +-4x host (>= 1.5x acceptance floor at 2 replicas).
+
+  Then a ZERO-DOWNTIME ROLLOUT window: continuous open-loop load at a
+  rate one replica can absorb while `fleet.rollout()` canaries and
+  rolls both replicas (`restore()` under cached executables). The
+  pinned contract — 0 failed requests, 0 fresh compiles in the window
+  — lands in the headline's `rollout` block and is diff-gated
+  (`fleet_rollout_shed` up-bad at 0 tolerance). Ladder economics ride
+  along: the traffic-derived bucket ladder vs the fixed one over the
+  window's observed request sizes (`ladder_ab`).
+  """
+  # The virtual 8-device mesh, BEFORE any backend touch (env must be
+  # set pre-initialization; tests/conftest.py uses the same flag).
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  backend_lib.pin_cpu()
+  backend_lib.assert_cpu_backend()
+  import threading
+
+  import jax
+
+  from tensor2robot_tpu import serving, specs as specs_lib
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.predictors import predictors as predictors_lib
+  from tensor2robot_tpu.research.qtopt import flagship
+  from tensor2robot_tpu.serving import engine as engine_lib
+  from tensor2robot_tpu.serving import loadgen
+
+  devices = jax.devices()
+  device = devices[0]
+  groups = mesh_lib.replica_device_groups(FLEET_REPLICAS, devices)
+
+  def make_replica(index: int, group) -> _DeviceWaitEngine:
+    model = flagship.make_flagship_model(device.platform)
+    predictor = predictors_lib.CheckpointPredictor(model=model,
+                                                   model_dir="/nonexistent")
+    predictor.init_randomly()  # same seed per replica: identical params
+    if group:
+      predictor.place_on_device(group[0])
+    engine = serving.BucketedEngine(predictor=_HotSwapPredictor(predictor),
+                                    max_batch_size=FLEET_MAX_BATCH,
+                                    name=f"serve/fleet/replica{index}")
+    return _DeviceWaitEngine(engine, FLEET_DEVICE_WAIT_MS)
+
+  print(f"bench-fleet: warming 1-replica + {FLEET_REPLICAS}-replica "
+        "fleets (shared bucket ladder)", file=sys.stderr)
+  single = serving.ServingFleet(
+      replica_factory=lambda i, d: make_replica(i, groups[0]),
+      num_replicas=1, max_batch_size=FLEET_MAX_BATCH, max_delay_ms=2.0,
+      max_queue=32, warmup=True)
+  duo = serving.ServingFleet(
+      replica_factory=lambda i, d: make_replica(i, groups[i]),
+      num_replicas=FLEET_REPLICAS, max_batch_size=FLEET_MAX_BATCH,
+      max_delay_ms=2.0, max_queue=32, warmup=True)
+  try:
+    request = dict(specs_lib.make_random_numpy(
+        single.replica(0).get_feature_specification(), batch_size=1,
+        seed=0).items())
+    make_request = lambda i: request  # noqa: E731 - read-only shared dict
+
+    # The honest decomposition: the real CPU cost of one batched
+    # dispatch on this host, measured on the UNWRAPPED engine, so the
+    # emulated device wall is always readable against it.
+    probe_batch = dict(specs_lib.make_random_numpy(
+        single.replica(0).get_feature_specification(),
+        batch_size=FLEET_MAX_BATCH, seed=1).items())
+    inner_engine = single.replica(0)._engine
+    inner_engine.predict(probe_batch)  # settle
+    t0 = time.perf_counter()
+    for _ in range(10):
+      inner_engine.predict(probe_batch)
+    dispatch_cpu_ms = (time.perf_counter() - t0) * 1e2
+
+    def run_arm(fleet, seed: int) -> dict:
+      with obs_metrics.isolated() as registry:
+        result = loadgen.run_trace_load(
+            predict=fleet.predict, make_request=make_request,
+            num_arrivals=FLEET_ARRIVALS, rate_hz=FLEET_RATE_HZ,
+            profile="poisson", seed=seed,
+            max_client_threads=FLEET_CLIENTS)
+        result["request_rows"] = engine_lib.observed_request_rows()
+        snap = registry.snapshot(prefix="serve/")
+      result["exec_fallbacks"] = snap.get(
+          "counter/serve/engine/exec_fallbacks", 0.0)
+      result["shed"] = sum(count for name, count in result["errors"].items()
+                           if "Shed" in name)
+      return result
+
+    compiles_after_warmup = [c for c in single.compile_counts()
+                             + duo.compile_counts() if c is not None]
+    pairs = []
+    observed_rows: list = []
+    exec_fallbacks = 0.0
+    for pair in range(FLEET_PAIRS):
+      # Alternate order inside each back-to-back pair so slow host
+      # phases hit both arms evenly (the data-bench pairing design).
+      if pair % 2 == 0:
+        s_res = run_arm(single, seed=pair)
+        d_res = run_arm(duo, seed=pair)
+      else:
+        d_res = run_arm(duo, seed=pair)
+        s_res = run_arm(single, seed=pair)
+      observed_rows.extend(d_res["request_rows"])
+      s_qps = s_res["ok_requests"] / s_res["wall_sec"]
+      d_qps = d_res["ok_requests"] / d_res["wall_sec"]
+      pairs.append({
+          "single_qps": round(s_qps, 1), "fleet_qps": round(d_qps, 1),
+          "ratio": round(d_qps / s_qps if s_qps else float("inf"), 3),
+          "single_shed": s_res["shed"], "fleet_shed": d_res["shed"],
+          "start_lag_ms_p95": round(d_res["start_lag_ms_p95"], 1),
+      })
+      print(f"bench-fleet: pair {pair}: single {s_qps:.0f} req/s, "
+            f"fleet {d_qps:.0f} req/s ({pairs[-1]['ratio']:.2f}x)",
+            file=sys.stderr)
+      exec_fallbacks += s_res["exec_fallbacks"] + d_res["exec_fallbacks"]
+    med = lambda vals: sorted(vals)[len(vals) // 2]  # noqa: E731
+    ratio = med([p["ratio"] for p in pairs])
+    fleet_qps = med([p["fleet_qps"] for p in pairs])
+    single_qps = med([p["single_qps"] for p in pairs])
+
+    # Zero-downtime rollout window: continuous open-loop load at a rate
+    # ONE replica can absorb (the pin is no failures while capacity is
+    # halved replica-by-replica), rollout mid-window.
+    window_results: list = []
+
+    def window_load() -> None:
+      window_results.append(loadgen.run_trace_load(
+          predict=duo.predict, make_request=make_request,
+          num_arrivals=FLEET_ROLLOUT_ARRIVALS,
+          rate_hz=FLEET_ROLLOUT_RATE_HZ, profile="poisson", seed=97,
+          max_client_threads=32))
+
+    loader = threading.Thread(target=window_load, name="fleet-rollout-load")
+    loader.start()
+    time.sleep(0.4)  # window established before the canary swap
+    report = duo.rollout(probe_request=request)
+    loader.join()
+    window = window_results[0]
+    window_failed = int(sum(window["errors"].values()))
+    rollout_block = {
+        "swapped": report["swapped"],
+        "canary_index": report.get("canary_index"),
+        "aborted": report["aborted"],
+        "parity_ok": report["parity_ok"],
+        "fresh_compiles": report["fresh_compiles"],
+        "probe_ms": [round(e["probe_ms"], 2) for e in report["replicas"]
+                     if e.get("probe_ms") is not None],
+        "window_requests": window["arrivals"],
+        # THE pinned contract, diff-gated via fleet_rollout_shed:
+        # every error in the window (sheds included) counts — a
+        # rollout must be invisible to traffic.
+        "window_shed": window_failed,
+        "window_qps": round(window["qps"], 1),
+    }
+    print(f"bench-fleet: rollout swapped {report['swapped']}/"
+          f"{FLEET_REPLICAS}, window {window['arrivals']} requests, "
+          f"{window_failed} failed/shed", file=sys.stderr)
+
+    # Traffic-derived ladder economics over the observed request sizes
+    # (fixed doubling ladder = fallback + A/B baseline).
+    derived = engine_lib.traffic_bucket_ladder(observed_rows,
+                                               FLEET_MAX_BATCH)
+    fixed = engine_lib.bucket_ladder(FLEET_MAX_BATCH)
+    ladder_ab = {
+        "fixed": fixed,
+        "derived": derived,
+        "fixed_stats": engine_lib.ladder_padding_stats(observed_rows,
+                                                       fixed),
+        "derived_stats": engine_lib.ladder_padding_stats(observed_rows,
+                                                         derived),
+    }
+
+    compiles_after_all = [c for c in single.compile_counts()
+                          + duo.compile_counts() if c is not None]
+    headline = {
+        "metric": "qtopt_fleet_qps_cpu_smoke",
+        "value": fleet_qps,
+        "unit": "requests/sec",
+        "vs_baseline": round(fleet_qps / FLEET_CPU_ANCHOR, 3),
+        # The acceptance ratio (load-invariant, diff-gated down-bad):
+        # 2-replica fleet vs 1-replica goodput under identical
+        # open-loop load, pair-median.
+        "fleet_vs_single_replica": ratio,
+        "replicas": FLEET_REPLICAS,
+        "single_replica_qps": single_qps,
+        "pairs": pairs,
+        "emulated_device_wait_ms": FLEET_DEVICE_WAIT_MS,
+        "replica_dispatch_cpu_ms": round(dispatch_cpu_ms, 2),
+        "open_loop": {"profile": "poisson", "rate_hz": FLEET_RATE_HZ,
+                      "arrivals_per_arm": FLEET_ARRIVALS},
+        "buckets": single.replica(0).buckets,
+        "device_groups": [len(g) for g in groups],
+        # Zero recompiles after warmup across both replicas AND the
+        # rollout (compile counters pinned; exec_fallbacks 0 means no
+        # dispatch bypassed the warmed cache either).
+        "engine_compiles": compiles_after_all,
+        "zero_recompiles_after_warmup":
+            compiles_after_all == compiles_after_warmup,
+        "exec_fallbacks": exec_fallbacks,
+        "rollout": rollout_block,
+        "ladder_ab": ladder_ab,
+        "device_kind": device.device_kind,
+        "platform": device.platform,
+        "host_load": _host_load_block(),
+        "graftscope": _graftscope_block(),
+    }
+    print(json.dumps(headline))
+    compile_records = []
+    for fleet in (single, duo):
+      for index in range(fleet.num_replicas):
+        compile_records.extend(fleet.replica(index).compile_records)
+    _write_runlog(headline, platform=device.platform,
+                  device_kind=device.device_kind,
+                  compile_records=compile_records)
+  finally:
+    single.close()
+    duo.close()
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
@@ -1767,6 +2098,9 @@ def main() -> None:
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--session":
     session_main()
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--fleet":
+    fleet_main()
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--data":
     data_main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Fleet-serving bench + regression gate.
+#
+# One headline run, diffed against ITS OWN previous record in runs.jsonl
+# with `graftscope diff` (train/serve/cache/data/pp/session/fleet
+# records interleave in the same file; the index lookup below selects
+# the fleet family):
+#
+#   `bench.py --fleet` — qtopt_fleet_qps_cpu_smoke: paired 1-vs-2-
+#   replica ServingFleet arms under identical open-loop Poisson load on
+#   the virtual 8-device mesh, plus a zero-downtime rollout window
+#   (PERFORMANCE.md "Reading a fleet bench"). Gated metrics:
+#     fleet_vs_single_replica — the load-invariant paired goodput
+#                               ratio at 2 replicas (down-bad 15%; the
+#                               ISSUE 12 acceptance floor is 1.5x),
+#     fleet_rollout_shed      — failed/shed requests inside the rollout
+#                               window (up-bad at 0 tolerance: the
+#                               "no request fails during a rollout"
+#                               pin — ANY growth from 0 gates).
+#
+# A regression in either exits non-zero exactly like a training one.
+#
+# Usage: scripts/fleet_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# The index lookup runs OUTSIDE a process substitution so a failure
+# (unreadable runs.jsonl, broken import) fails the script loudly
+# instead of reading as "no baseline" and silently skipping the gate.
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+  ) || { echo "fleet_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "fleet_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
+
+JAX_PLATFORMS=cpu python bench.py --fleet
+# The fleet family gates on its two purpose-built metrics; every other
+# wall-clock (absolute qps, warmup, compile) swings 4x with host load
+# on this VM, so those absolute thresholds are opened wide rather than
+# training people to ignore a flappy gate.
+gate_family qtopt_fleet \
+    --threshold examples_per_sec=10.0 --threshold compile_time_s=10.0 \
+    --threshold flops_per_step=10.0 --threshold bytes_per_step=10.0 \
+    --threshold jaxpr_eqns=10.0 --threshold warmup_ms=10.0

@@ -28,7 +28,7 @@ import sys
 from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       native_check, pp_check,
+                                       fleet_check, native_check, pp_check,
                                        session_check, spec_check,
                                        thread_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
@@ -88,6 +88,15 @@ session rules (.py):
                          session_state/arena value, which re-buys the
                          stateless per-tick cost (and ~1.5 s per eager
                          fetch over the tunnel)
+
+fleet rules (.py):
+  fleet-replica-unjoined a `ServingFleet(...)` construction site whose
+                         owning scope never calls close()/drain() on
+                         it, uses it as a context manager, returns it,
+                         or stores it on self — the fleet's
+                         per-replica batcher workers are never joined
+                         (the tunnel-safe join discipline the batchers
+                         follow, mechanized for the fleet layer)
 
 thread rules (.py):
   thread-stage-missing-close     a class starts a threading.Thread but
@@ -156,6 +165,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(cache_check.check_python_file(path))
     findings.extend(pp_check.check_python_file(path))
     findings.extend(session_check.check_python_file(path))
+    findings.extend(fleet_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked

@@ -94,16 +94,20 @@ class AbstractInputGenerator(abc.ABC):
                           num_parallel_parses: Optional[int] = None,
                           prefetch_size: Optional[int] = None,
                           overlap: Optional[bool] = None,
-                          overlap_queue_mb: Optional[float] = None) -> None:
+                          overlap_queue_mb: Optional[float] = None,
+                          fused_preprocess: Optional[bool] = None) -> None:
     """Injects host-overlap pipeline tuning (parse worker count,
-    hand-off depth, byte caps) from the trainer — the slow-host-
-    fast-chip knobs of the pipelined loader (`data/overlap.py`).
-    None values keep the generator's own defaults; generators without
-    a record pipeline accept and ignore the call."""
+    hand-off depth, byte caps, preprocess fusion into the parse pool)
+    from the trainer — the slow-host-fast-chip knobs of the pipelined
+    loader (`data/overlap.py`). None values keep the generator's own
+    defaults (for `fused_preprocess` that is the declared-purity auto
+    gate, `pipeline.RecordBatchPipeline._fuse_preprocess_enabled`);
+    generators without a record pipeline accept and ignore the call."""
     for key, value in (("num_parallel_parses", num_parallel_parses),
                        ("prefetch_size", prefetch_size),
                        ("overlap", overlap),
-                       ("overlap_queue_mb", overlap_queue_mb)):
+                       ("overlap_queue_mb", overlap_queue_mb),
+                       ("fused_preprocess", fused_preprocess)):
       if value is not None:
         self._overlap_options[key] = value
 
@@ -171,6 +175,7 @@ class DefaultRecordInputGenerator(AbstractInputGenerator):
         num_parallel_parses=opts.get("num_parallel_parses", 2),
         overlap=opts.get("overlap"),
         overlap_queue_mb=opts.get("overlap_queue_mb"),
+        fused_preprocess=opts.get("fused_preprocess"),
         seed=self._seed,
         preprocess_fn=self._preprocess_fn,
         process_index=self._process_index or 0,

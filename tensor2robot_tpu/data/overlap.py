@@ -19,6 +19,19 @@ Language Models using JAX pjit" (PAPERS.md):
        deterministic consumption order)              [assembler thread]
     -> byte-capped output queue                      [consumer]
 
+With `fuse_preprocess=True` (ROADMAP item 6's last slice) preprocess
+moves INTO the parse pool: each pooled task runs parse + preprocess
+back to back, and the assembler only unwraps futures in submission
+order. For a PURE per-batch preprocess fn — the declared
+`AbstractPreprocessor` contract ("a pure function over SpecStructs of
+arrays", preprocessors/base.py) — the output stream is byte-identical
+to the serial-worker chain (same batches, same order; only WHICH
+thread ran the numpy changes), while the single preprocess worker
+stops being the pipeline's serial bottleneck. A preprocess fn that
+carries cross-batch state must keep the serial worker
+(`fuse_preprocess=False`); `RecordBatchPipeline` auto-gates on the
+declared-purity signal (`data/pipeline.py` `fused_preprocess`).
+
 Output order is the raw-batch order (futures are queued in submission
 order and the assembler consumes them FIFO), so the overlapped loader
 is BYTE-IDENTICAL to the serial chain over the same record stream —
@@ -172,7 +185,8 @@ class OverlappedLoader:
                parse_workers: int = 2,
                depth: int = 2,
                max_bytes: int = DEFAULT_QUEUE_BYTES,
-               telemetry: bool = True):
+               telemetry: bool = True,
+               fuse_preprocess: bool = False):
     from concurrent.futures import ThreadPoolExecutor
 
     parse_workers = max(int(parse_workers), 1)
@@ -201,6 +215,15 @@ class OverlappedLoader:
       out = parse_fn(item)
       if telemetry:
         parse_hist.record((perf_counter_ns() - t0) * 1e-6)
+      if fuse_preprocess:
+        # Fused mode (module docstring): preprocess runs HERE, on the
+        # pool thread, immediately after its own batch's parse — the
+        # per-stage telemetry split is preserved so attribution in
+        # runs.jsonl reads the same either way.
+        t0 = perf_counter_ns()
+        out = preprocess_fn(out)
+        if telemetry:
+          preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
       return out
 
     # Stage threads close over locals ONLY — never `self` — so an
@@ -236,11 +259,12 @@ class OverlappedLoader:
           if isinstance(got, BaseException):
             out_q.put(got, 0, stop)
             return
-          parsed = got.result()
-          t0 = perf_counter_ns()
-          batch = preprocess_fn(parsed)
-          if telemetry:
-            preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
+          batch = got.result()
+          if not fuse_preprocess:
+            t0 = perf_counter_ns()
+            batch = preprocess_fn(batch)
+            if telemetry:
+              preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
           if not out_q.put(batch, batch_nbytes(batch), stop):
             return
           if telemetry:

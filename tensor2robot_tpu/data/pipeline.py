@@ -302,7 +302,8 @@ class RecordBatchPipeline:
                process_count: int = 1,
                use_native_stager: Optional[bool] = None,
                overlap: Optional[bool] = None,
-               overlap_queue_mb: Optional[float] = None):
+               overlap_queue_mb: Optional[float] = None,
+               fused_preprocess: Optional[bool] = None):
     self._parse_fn = parse_fn
     self._batch_size = batch_size
     self._mode = mode
@@ -321,6 +322,7 @@ class RecordBatchPipeline:
     self._overlap_queue_bytes = (
         overlap_lib.DEFAULT_QUEUE_BYTES if overlap_queue_mb is None
         else max(int(overlap_queue_mb * (1 << 20)), 1))
+    self._fused_preprocess = fused_preprocess
     self._warned_stager_unavailable = False
     dataset_keys = parse_fn.dataset_keys
     if isinstance(file_patterns, Mapping):
@@ -453,6 +455,27 @@ class RecordBatchPipeline:
       return self._overlap
     return prefetch_size > 0
 
+  def _fuse_preprocess_enabled(self) -> bool:
+    """The fused-preprocess decision (ROADMAP item 6's last slice):
+    explicit `fused_preprocess` wins; auto (None) fuses preprocess into
+    the parse pool ONLY when purity is declared — the preprocess fn is
+    a bound method of an `AbstractPreprocessor` (whose `_preprocess_fn`
+    contract is "a pure function over SpecStructs", preprocessors/
+    base.py) or the fn carries a truthy `stateless` attribute; a bare
+    callable may close over cross-batch state, so it keeps the serial
+    preprocess worker and its deterministic consumption order."""
+    if self._fused_preprocess is not None:
+      return self._fused_preprocess
+    fn = self._preprocess_fn
+    if fn is None:
+      return True  # identity preprocess: trivially pure
+    if getattr(fn, "stateless", False):
+      return True
+    from tensor2robot_tpu.preprocessors import base as preprocessors_base
+
+    return isinstance(getattr(fn, "__self__", None),
+                      preprocessors_base.AbstractPreprocessor)
+
   def _assemble(self, raw: Iterator[Any],
                 prefetch_size: Optional[int] = None,
                 num_parallel_parses: Optional[int] = None
@@ -477,7 +500,8 @@ class RecordBatchPipeline:
       return overlap_lib.OverlappedLoader(
           iter(raw), self._parse_only, self._apply_preprocess,
           parse_workers=max(workers, 1), depth=max(size, 1),
-          max_bytes=self._overlap_queue_bytes)
+          max_bytes=self._overlap_queue_bytes,
+          fuse_preprocess=self._fuse_preprocess_enabled())
     if workers > 1:
       parsed = parallel_map_ordered(self._parse_only, raw,
                                     num_workers=workers)

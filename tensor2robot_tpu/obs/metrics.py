@@ -167,6 +167,16 @@ class Histogram:
     with self._lock:
       return percentiles(self._sample, [pct])[0]
 
+  def values(self) -> List[float]:
+    """Snapshot of the reservoir sample (an unbiased sample of the full
+    observation stream once it exceeds the reservoir). Consumers that
+    derive policy from observed traffic — the traffic-derived bucket
+    ladder (`serving.engine.traffic_bucket_ladder`) reads the
+    `serve/request_rows` reservoir — use this instead of reaching into
+    `_sample`."""
+    with self._lock:
+      return list(self._sample)
+
   def stats(self) -> Dict[str, float]:
     with self._lock:
       p50, p90, p99 = percentiles(self._sample)

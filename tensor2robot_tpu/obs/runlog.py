@@ -112,6 +112,17 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # warmup_ms has one).
     "session_vs_stateless": ("down", 0.15),
     "decode_tick_ms": ("up", 0.50),
+    # Fleet-serving gates (bench.py --fleet / scripts/fleet_bench.sh,
+    # PERFORMANCE.md "Reading a fleet bench"): fleet_vs_single_replica
+    # is the paired 1-vs-2-replica goodput ratio under open-loop load
+    # (back-to-back pairs => load-invariant; >= 1.5 is the ISSUE 12
+    # acceptance floor). fleet_rollout_shed is the shed/failed count
+    # inside the zero-downtime rollout window — expected 0, so ANY
+    # growth is a regression of the "no request fails during a
+    # rollout" pin (threshold 0: a 0 -> nonzero move reads as rel=inf
+    # and flags).
+    "fleet_vs_single_replica": ("down", 0.15),
+    "fleet_rollout_shed": ("up", 0.0),
 }
 
 
@@ -376,6 +387,13 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["session_vs_stateless"] = float(bench["session_vs_stateless"])
   if bench.get("decode_tick_ms") is not None:
     out["decode_tick_ms"] = float(bench["decode_tick_ms"])
+  # Fleet-serving bench (bench.py --fleet): the load-invariant paired
+  # replica-scaling ratio and the rollout-window shed/failure count.
+  if bench.get("fleet_vs_single_replica") is not None:
+    out["fleet_vs_single_replica"] = float(bench["fleet_vs_single_replica"])
+  rollout = bench.get("rollout") or {}
+  if rollout.get("window_shed") is not None:
+    out["fleet_rollout_shed"] = float(rollout["window_shed"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)

@@ -72,6 +72,13 @@ NONFINITE_PARAMS = "nonfinite_params"
 NONFINITE_METRIC = "nonfinite_metric"
 HBM_DRIFT = "hbm_drift"
 SLO_BREACH = "serving_slo_breach"
+# Emitted by the graftserve fleet (`serving/fleet.py`) when a replica is
+# evicted from the routing set (dispatch-failure streak, heartbeat
+# timeout, or an external fatal incident routed through
+# `ServingFleet.sentinel_sink`). detail carries {"replica": index,
+# "reason": ...}; the fleet's sinks + the flight recorder both consume
+# these through the standard incident fan-out.
+REPLICA_UNHEALTHY = "replica_unhealthy"
 
 
 @dataclasses.dataclass(frozen=True)

@@ -31,7 +31,7 @@ from tensor2robot_tpu.utils import config
 
 __all__ = ["create_mesh", "data_sharding", "replicated",
            "put_host_batch", "place_batch", "local_batch_size",
-           "DevicePrefetcher", "shard_map",
+           "DevicePrefetcher", "shard_map", "replica_device_groups",
            "initialize_multihost"]
 
 DEFAULT_AXES = ("data", "fsdp", "model")
@@ -98,6 +98,43 @@ def create_mesh(mesh_shape: Optional[Sequence[int]] = None,
     device_array = mesh_utils.create_device_mesh(mesh_shape,
                                                  devices=devices)
   return Mesh(device_array, tuple(axis_names))
+
+
+def replica_device_groups(num_replicas: int,
+                          devices: Optional[Sequence[jax.Device]] = None
+                          ) -> list:
+  """Carves the device list into disjoint per-replica groups (the
+  graftserve fleet's device carve-out, `serving/fleet.py`).
+
+  Groups are CONTIGUOUS runs of the platform device order, so each
+  replica's devices stay within one ICI neighborhood — the same locality
+  assumption `create_mesh` makes. Multislice seam: on a DCN-connected
+  pod the device order groups by slice first (jax sorts by
+  process_index), so `num_replicas == num_slices` puts one replica per
+  slice with no cross-DCN dispatch inside a replica; a finer carve-out
+  composes with `create_mesh(devices=group)` exactly like the
+  single-slice case.
+
+  A remainder (len(devices) % num_replicas) is spread one extra device
+  over the FIRST groups rather than left idle — replica capacities may
+  then differ by one device, which the fleet's least-outstanding-work
+  router absorbs by construction.
+  """
+  devices = list(devices if devices is not None else jax.devices())
+  if num_replicas < 1:
+    raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+  if num_replicas > len(devices):
+    raise ValueError(
+        f"cannot carve {num_replicas} replica device groups out of "
+        f"{len(devices)} devices (>= 1 device per replica required)")
+  base, remainder = divmod(len(devices), num_replicas)
+  groups = []
+  offset = 0
+  for index in range(num_replicas):
+    size = base + (1 if index < remainder else 0)
+    groups.append(devices[offset:offset + size])
+    offset += size
+  return groups
 
 
 def data_sharding(mesh: Mesh, batch_axis: str = "data") -> NamedSharding:

@@ -137,6 +137,7 @@ class _JaxPredictorBase(AbstractPredictor):
     self._global_step = -1
     self._latency_slo_ms = latency_slo_ms
     self._executable_cache_dir = executable_cache_dir
+    self._device = None  # replica pin (place_on_device); None = default
 
   def _build_predict(self) -> None:
     model = self._model
@@ -256,6 +257,19 @@ class _JaxPredictorBase(AbstractPredictor):
     obs_metrics.counter("serve/predictions").inc()
     obs_sentinel.observe_serving_latency(elapsed_ms, self._latency_slo_ms)
 
+  def place_on_device(self, device) -> None:
+    """Commits the predictor's state to `device` — the graftserve fleet's
+    replica pinning seam (`serving/fleet.py` + `parallel.mesh.
+    replica_device_groups`): dispatches follow committed arguments, so a
+    predictor placed on replica N's lead device executes there, and the
+    engine's warmup-compiled executables are built for that placement.
+    The pin is sticky: both restore() implementations re-place freshly
+    restored state onto this device, so a rollout hot-swap never
+    migrates a replica off its device group."""
+    self.assert_is_loaded()
+    self._device = device
+    self._state = jax.device_put(self._state, device)
+
 
 @config.configurable
 class CheckpointPredictor(_JaxPredictorBase):
@@ -300,6 +314,11 @@ class CheckpointPredictor(_JaxPredictorBase):
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state)
     with checkpoints_lib.CheckpointManager(self._checkpoint_dir) as manager:
       self._state = manager.restore(step, abstract_state=abstract)
+    if self._device is not None:
+      # Replica pin survives a hot-swap: the restored tree lands on the
+      # default device otherwise, silently migrating this replica's
+      # dispatches off its carved-out device group mid-rollout.
+      self._state = jax.device_put(self._state, self._device)
     self._global_step = step
     self._build_predict()
     return True
@@ -391,6 +410,11 @@ class ExportedModelPredictor(_JaxPredictorBase):
         params=variables["params"], opt_state=None,
         mutable_state=variables.get("mutable") or {},
         ema_params=None, rng=jax.random.PRNGKey(0))
+    if self._device is not None:
+      # Replica pin survives a bundle swap (the CheckpointPredictor
+      # restore rule: restored trees land on the default device
+      # otherwise, migrating this replica off its device group).
+      self._state = jax.device_put(self._state, self._device)
     self._global_step = int(assets.global_step or 0)
     self._loaded_path = newest
     self._build_predict()

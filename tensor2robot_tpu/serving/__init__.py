@@ -13,14 +13,28 @@ and stateful autoregressive episodes (ISSUE 11):
               decode-step executables, admission/eviction, session.py)
            -> predictor decode_bundle (pure decode step + state)
 
+and, above both, the multi-replica pool (ISSUE 12):
+
+  traffic  -> ServingFleet (least-outstanding router, session->replica
+              affinity, health eviction, zero-downtime rollout,
+              fleet.py)
+           -> per-replica MicroBatcher / SessionBatcher fronts
+           -> per-replica engines on disjoint device groups
+
 plus `loadgen` (closed-loop concurrency sweeps AND the open-loop
-session-shaped arrival process) for measurement. See
-docs/ARCHITECTURE.md "Serving runtime (graftserve)".
+trace-driven arrival processes: poisson / bursty MMPP / diurnal, mixed
+stateless+session) for measurement. See docs/ARCHITECTURE.md "Serving
+runtime (graftserve)".
 """
 
 from tensor2robot_tpu.serving.batcher import (DeadlineError, MicroBatcher,
                                               ShedError, ShutdownError)
-from tensor2robot_tpu.serving.engine import BucketedEngine, bucket_ladder
+from tensor2robot_tpu.serving.engine import (BucketedEngine, bucket_ladder,
+                                             ladder_padding_stats,
+                                             traffic_bucket_ladder)
+from tensor2robot_tpu.serving.fleet import (FleetShedError,
+                                            NoHealthyReplicaError,
+                                            ServingFleet)
 from tensor2robot_tpu.serving.session import (SessionBatcher,
                                               SessionClosedError,
                                               SessionEngine, SessionError,
@@ -33,4 +47,6 @@ __all__ = ["MicroBatcher", "BucketedEngine", "bucket_ladder", "ShedError",
            "DeadlineError", "ShutdownError", "SessionEngine",
            "SessionBatcher", "SessionError", "SessionShedError",
            "SessionEvictedError", "UnknownSessionError",
-           "SessionClosedError", "SessionHorizonError"]
+           "SessionClosedError", "SessionHorizonError", "ServingFleet",
+           "FleetShedError", "NoHealthyReplicaError",
+           "traffic_bucket_ladder", "ladder_padding_stats"]

@@ -204,6 +204,10 @@ class MicroBatcher:
     features = dict(features)
     rows = _rows_of(features)
     obs_metrics.counter("serve/batcher/requests").inc()
+    # Observed request-size stream: the reservoir behind the
+    # traffic-derived bucket ladder (`engine.traffic_bucket_ladder` /
+    # `engine.observed_request_rows`).
+    obs_metrics.histogram("serve/request_rows").record(float(rows))
     if rows > self._max_batch_size:
       # Already a full batch (e.g. a CEM candidate sweep): coalescing
       # cannot help, dispatch directly — but never after close(): the

@@ -64,7 +64,8 @@ from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
 
-__all__ = ["BucketedEngine", "bucket_ladder"]
+__all__ = ["BucketedEngine", "bucket_ladder", "traffic_bucket_ladder",
+           "ladder_padding_stats", "observed_request_rows"]
 
 
 def bucket_ladder(max_batch_size: int) -> List[int]:
@@ -79,6 +80,128 @@ def bucket_ladder(max_batch_size: int) -> List[int]:
     b *= 2
   ladder.append(max_batch_size)
   return ladder
+
+
+def observed_request_rows(histogram_name: str = "serve/request_rows"
+                          ) -> List[int]:
+  """Observed per-request row counts from the serving telemetry stream
+  (`MicroBatcher.predict` records every request's rows into the
+  `serve/request_rows` histogram; the reservoir is an unbiased sample
+  of the full traffic). The input side of `traffic_bucket_ladder` —
+  ROADMAP item 1's "derive the ladder from observed traffic"."""
+  return [int(v) for v in obs_metrics.histogram(histogram_name).values()]
+
+
+def traffic_bucket_ladder(sizes: Sequence[int],
+                          max_batch_size: int,
+                          min_share: float = 0.05,
+                          split_waste: float = 0.25,
+                          max_buckets: int = 8) -> List[int]:
+  """Bucket ladder derived from OBSERVED request sizes (ROADMAP item 1).
+
+  The fixed doubling ladder spends one compiled executable per power of
+  two regardless of where the traffic actually lands; real fleets see
+  skewed size mixes (a robot fleet ticking at batch 1, a CEM sweep at
+  24), so the compile budget should sit where the rows are. Starting
+  from the fixed ladder (`bucket_ladder` — the fallback and the A/B
+  baseline, kept verbatim when traffic is uniform):
+
+  1. MERGE: repeatedly drop the non-top rung carrying the smallest
+     traffic share below `min_share` — a rarely-hit rung costs a whole
+     compile (20-40 s over the tunnel) to save padding on almost no
+     traffic; its requests pad up to the next rung.
+  2. SPLIT: repeatedly insert the traffic-median size of the rung whose
+     mean padded-row fraction exceeds `split_waste` (while under
+     `max_buckets`) — a hot rung wasting >25 % of its dispatched rows
+     on padding earns a tighter rung at the size the traffic actually
+     has.
+
+  Merges run to fixpoint before splits (the two passes cannot cycle),
+  every boundary decision is deterministic in `sizes`, and the top rung
+  is always `max_batch_size` (oversize requests chunk through it, so
+  they count as `max_batch_size` here). Uniform traffic over
+  [1, max_batch_size] leaves the fixed ladder unchanged — the A/B
+  baseline property tests/test_fleet.py pins. Empty `sizes` returns the
+  fixed ladder (the fallback)."""
+  if max_batch_size < 1:
+    raise ValueError(f"max_batch_size must be >= 1, got {max_batch_size}")
+  sizes = [min(int(s), max_batch_size) for s in sizes if int(s) >= 1]
+  base = bucket_ladder(max_batch_size)
+  if not sizes:
+    return base
+  ladder = list(base)
+
+  def _assign(ladder_now: List[int]):
+    by_rung: Dict[int, List[int]] = {b: [] for b in ladder_now}
+    for size in sizes:
+      for b in ladder_now:
+        if b >= size:
+          by_rung[b].append(size)
+          break
+    return by_rung
+
+  # Merge pass (to fixpoint): drop under-trafficked rungs, never the top.
+  while len(ladder) > 1:
+    by_rung = _assign(ladder)
+    total = float(len(sizes))
+    droppable = [(len(by_rung[b]) / total, b) for b in ladder[:-1]
+                 if len(by_rung[b]) / total < min_share]
+    if not droppable:
+      break
+    ladder.remove(min(droppable)[1])
+
+  # Split pass (to fixpoint): tighten rungs wasting rows on padding.
+  while len(ladder) < max_buckets:
+    by_rung = _assign(ladder)
+    worst = None
+    for b in ladder:
+      rows = by_rung[b]
+      if not rows:
+        continue
+      waste = sum((b - s) / b for s in rows) / len(rows)
+      if waste > split_waste and (worst is None or waste > worst[0]):
+        worst = (waste, b, rows)
+    if worst is None:
+      break
+    rows = sorted(worst[2])
+    median = rows[len(rows) // 2]
+    if median in ladder or median == worst[1]:
+      break
+    ladder = sorted(ladder + [median])
+  return ladder
+
+
+def ladder_padding_stats(sizes: Sequence[int],
+                         ladder: Sequence[int]) -> Dict[str, float]:
+  """Padding economics of `ladder` over observed `sizes`: the
+  fixed-vs-derived A/B numbers the fleet bench headlines.
+  `padded_row_frac` is the fraction of dispatched rows that are padding;
+  `dispatch_rows_per_row` the dispatched/requested row blow-up."""
+  ladder = sorted(set(int(b) for b in ladder))
+  if not ladder:
+    raise ValueError("ladder must be non-empty")
+  top = ladder[-1]
+  sizes = [int(s) for s in sizes if int(s) >= 1]
+  if not sizes:
+    return {"requested_rows": 0.0, "dispatched_rows": 0.0,
+            "padded_row_frac": 0.0, "dispatch_rows_per_row": 1.0,
+            "buckets": float(len(ladder))}
+  requested = 0
+  dispatched = 0
+  for size in sizes:
+    requested += size
+    full, rest = divmod(size, top)
+    dispatched += full * top
+    if rest:
+      dispatched += next(b for b in ladder if b >= rest)
+  return {
+      "requested_rows": float(requested),
+      "dispatched_rows": float(dispatched),
+      "padded_row_frac": (dispatched - requested) / dispatched
+      if dispatched else 0.0,
+      "dispatch_rows_per_row": dispatched / requested if requested else 1.0,
+      "buckets": float(len(ladder)),
+  }
 
 
 def _pad_rows(array: np.ndarray, bucket: int) -> np.ndarray:

@@ -1,0 +1,822 @@
+"""graftserve fleet: multi-replica serving with load-aware routing and
+zero-downtime checkpoint rollout.
+
+Everything below graftserve up to PR 10 serves through ONE engine: one
+`BucketedEngine` (or `SessionEngine`) behind one batcher, so the whole
+deployment shares one dispatch pipeline, one failure domain, and one
+restart window — the reference never got past that either (one
+SavedModel session per server process,
+/root/reference/predictors/exported_savedmodel_predictor.py:53-359;
+scale-out meant external replication with no shared routing or rollout
+story). Production TPU serving (PAPERS.md: the Gemma-on-TPU serving
+writeup's batched replica economics; "Scalable Training of Language
+Models using JAX pjit and TPUv4" on compile cost as a scaling axis)
+runs a REPLICA POOL: N engines on disjoint device groups behind a
+load-aware router, with health-driven eviction and one-at-a-time
+checkpoint rollout so deploys never drop traffic.
+
+`ServingFleet` is that pool, single-process (per-replica device subsets
+of one process's devices via `parallel.mesh.replica_device_groups`; the
+DCN hybrid-mesh seam — one replica per slice — is noted there for
+multislice):
+
+* REPLICAS: `replica_factory(index, devices)` builds each replica's
+  engine (a `BucketedEngine`, a `SessionEngine`-style object, or any
+  duck-typed backend — the factory owns predictor construction and
+  device pinning via `predictor.place_on_device`). Each replica with a
+  `predict` surface gets its OWN `MicroBatcher` front (per-replica
+  coalescing + admission control); session surfaces are routed
+  directly (or through a per-replica `SessionBatcher` with
+  `session_batching=True`). Replica spin-up is N deserializes when the
+  factory threads a graftcache `cache=` through (PR 7), so scale-out
+  is cheap enough to automate.
+* ROUTER — stateless requests: least-outstanding-work dispatch (the
+  replica with the fewest router-tracked in-flight/queued requests
+  wins), queue-depth shedding (`FleetShedError` when every healthy
+  replica is at `shed_outstanding`), and ONE failover retry on a
+  different replica for dispatch errors/backpressure (deadline expiry
+  is final — the robot has moved on). All routing state is host-side
+  counters: the router adds zero device work.
+* ROUTER — sessions: session→replica AFFINITY by consistent hashing
+  (a vnode hash ring per replica, so the key→replica map barely moves
+  when the replica set changes) with ring-walk fallback past
+  unhealthy/swapping/full replicas. Every tick of a fleet session
+  lands on the replica that owns its decode state — a session never
+  splits across replicas (tests pin it).
+* HEALTH: a replica is evicted from the routing set on a consecutive
+  dispatch-failure streak (`unhealthy_after`), a stalled heartbeat
+  (`heartbeat_timeout_s`: outstanding work but no completion), or an
+  external fatal incident routed through `sentinel_sink()` (the
+  obs.sentinel incident stream — wire it as a Sentinel sink and a
+  NaN-params incident drains the replica that produced it). Eviction
+  emits a `replica_unhealthy` graftscope incident, drains the replica
+  (the router steers around it; its batcher finishes in-flight work),
+  and DISPLACES its sessions: their next tick transparently re-opens
+  on a healthy replica (fresh decode state — an episode restart,
+  counted `serve/fleet/session_reopens`; `session_reopen='evict'`
+  raises the established `SessionEvictedError` instead for policies
+  that must know). `probe_replica` + `mark_healthy` re-admit.
+* ZERO-DOWNTIME ROLLOUT (`rollout()`): canary-first one-at-a-time
+  checkpoint swap under live traffic. Per replica: steer the router
+  around it, wait for its outstanding work to drain, `restore()` under
+  the engine's CACHED executables (the PR-5/PR-7 contract: shapes are
+  stable across restores, so a param hot-swap costs zero recompiles),
+  probe it directly, then re-admit. The canary's probe outputs are the
+  parity reference for every later replica (same checkpoint => same
+  outputs); a canary verification failure aborts the rollout with the
+  rest of the fleet still serving the OLD checkpoint. The pinned
+  contract — no request fails, no fresh compile occurs during a
+  rollout — is asserted by tests/test_fleet.py and priced by
+  `bench.py --fleet`'s rollout window.
+
+Traffic-derived bucket ladders (`engine.traffic_bucket_ladder` over the
+`serve/request_rows` reservoir) plug in through the factory: build the
+fleet, serve representative traffic, read `derived_ladder()`, rebuild
+replicas with `buckets=` — the fixed doubling ladder stays the fallback
+and the A/B baseline.
+
+graftscope telemetry (runs.jsonl via the standard registry snapshot):
+  serve/fleet/replicas, serve/fleet/healthy        gauges
+  serve/fleet/outstanding                          gauge (router-wide)
+  serve/fleet/version_skew                         gauge (max-min
+                                                   model_version)
+  serve/fleet/{requests,shed,retries,no_healthy,unhealthy,
+               session_opens,session_reopens,rollouts,
+               rollout_swapped}                    counters
+
+Backend-free at import like the rest of `serving/` (jax only ever
+appears inside factories the CALLER provides; tests/test_fleet.py runs
+routing, health, sessions and a full rollout under a poisoned
+JAX_PLATFORMS).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+
+import numpy as np
+
+from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import runlog as runlog_lib
+from tensor2robot_tpu.obs import sentinel as sentinel_lib
+from tensor2robot_tpu.serving import batcher as batcher_lib
+from tensor2robot_tpu.serving import session as session_lib
+from tensor2robot_tpu.utils import config
+
+__all__ = ["ServingFleet", "FleetShedError", "NoHealthyReplicaError"]
+
+# Replica states. SERVING receives routed traffic; SWAPPING (a rollout
+# swap in progress) is steered around but finishes what it holds;
+# UNHEALTHY was evicted by the health machinery; CLOSED is terminal.
+SERVING = "serving"
+SWAPPING = "swapping"
+UNHEALTHY = "unhealthy"
+CLOSED = "closed"
+
+_VNODES_PER_REPLICA = 64
+
+
+class FleetShedError(batcher_lib.ShedError):
+  """The fleet refused the request (every healthy replica at its
+  queue-depth bound — backpressure, not failure)."""
+
+
+class NoHealthyReplicaError(FleetShedError):
+  """No replica is in the SERVING state (all unhealthy/swapping/closed)."""
+
+
+class _Replica:
+  """One fleet member: engine + front + router-side accounting.
+
+  `outstanding` counts ALL router-tracked work (the least-loaded
+  signal); `stateless_outstanding` counts only batcher-path requests —
+  the rollout drain waits on THAT, because session ticks deliberately
+  keep flowing through a swap (`restore()` hot-swaps under live
+  sessions, the SessionEngine contract) and would otherwise hold the
+  drain open for the whole timeout."""
+
+  __slots__ = ("index", "devices", "engine", "front", "session_front",
+               "state", "outstanding", "stateless_outstanding",
+               "failure_streak", "last_ok_s", "unhealthy_reason")
+
+  def __init__(self, index: int, devices, engine, front, session_front):
+    self.index = index
+    self.devices = devices
+    self.engine = engine
+    self.front = front
+    self.session_front = session_front
+    self.state = SERVING
+    self.outstanding = 0
+    self.stateless_outstanding = 0
+    self.failure_streak = 0
+    self.last_ok_s = time.monotonic()
+    self.unhealthy_reason: Optional[str] = None
+
+
+class _FleetSession:
+  """Fleet-level session: a stable routing key + the replica-local sid
+  it currently maps to."""
+
+  __slots__ = ("key", "replica", "inner_sid", "displaced")
+
+  def __init__(self, key: str, replica: _Replica, inner_sid: int):
+    self.key = key
+    self.replica = replica
+    self.inner_sid = inner_sid
+    self.displaced = False
+
+
+def _hash32(text: str) -> int:
+  # crc32: stable across processes (hash() is PYTHONHASHSEED-salted),
+  # the same choice obs.metrics makes for its reservoir RNG seeds.
+  return zlib.crc32(text.encode("utf-8")) & 0xFFFFFFFF
+
+
+@config.configurable
+class ServingFleet:
+  """Multi-replica serving pool with load-aware routing (module doc).
+
+  `replica_factory(index, devices)` -> engine-like object. The engine
+  may expose a stateless surface (`predict`), a session surface
+  (`open`/`step`/`step_many`/`close_session`), or both; the fleet
+  routes each surface independently. `devices` is the per-replica
+  device group (None entries when the fleet was built without device
+  carve-out — e.g. backend-free tests).
+  """
+
+  def __init__(self,
+               replica_factory: Optional[Callable[[int, Any], Any]] = None,
+               num_replicas: int = 2,
+               devices: Optional[Sequence[Any]] = None,
+               max_batch_size: int = 8,
+               max_delay_ms: float = 2.0,
+               max_queue: int = 64,
+               shed_outstanding: Optional[int] = None,
+               unhealthy_after: int = 3,
+               heartbeat_timeout_s: Optional[float] = None,
+               session_reopen: str = "reopen",
+               session_batching: bool = False,
+               warmup: bool = False,
+               name: str = "serve/fleet",
+               sinks: Optional[List[Callable[[Dict[str, Any]], Any]]] = None):
+    if replica_factory is None:
+      raise ValueError("replica_factory is required.")
+    if num_replicas < 1:
+      raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+    if session_reopen not in ("reopen", "evict"):
+      raise ValueError("session_reopen must be 'reopen' or 'evict', "
+                       f"got {session_reopen!r}")
+    self._name = name
+    self._sinks = list(sinks or [])
+    self._unhealthy_after = max(int(unhealthy_after), 1)
+    self._heartbeat_timeout_s = heartbeat_timeout_s
+    self._session_reopen = session_reopen
+    self._shed_outstanding = (shed_outstanding if shed_outstanding
+                              is not None else max_queue)
+    self._lock = threading.Lock()
+    self._closed = False
+    groups: List[Any]
+    if devices is not None:
+      from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+      groups = mesh_lib.replica_device_groups(num_replicas, devices)
+    else:
+      groups = [None] * num_replicas
+    self._replicas: List[_Replica] = []
+    for index in range(num_replicas):
+      engine = replica_factory(index, groups[index])
+      front = None
+      if hasattr(engine, "predict"):
+        front = batcher_lib.MicroBatcher(
+            backend=engine, max_batch_size=max_batch_size,
+            max_delay_ms=max_delay_ms, max_queue=max_queue)
+      session_front = None
+      if hasattr(engine, "open") and hasattr(engine, "step"):
+        session_front = (session_lib.SessionBatcher(engine=engine)
+                         if session_batching else engine)
+      if front is None and session_front is None:
+        raise ValueError(
+            f"replica {index}'s engine exposes neither a predict nor a "
+            "session surface")
+      self._replicas.append(
+          _Replica(index, groups[index], engine, front, session_front))
+    # Consistent-hash ring for session affinity: vnodes per replica so
+    # the key->replica map moves minimally as replicas come and go.
+    ring = []
+    for replica in self._replicas:
+      for vnode in range(_VNODES_PER_REPLICA):
+        ring.append((_hash32(f"{name}/r{replica.index}/v{vnode}"),
+                     replica.index))
+    self._ring = sorted(ring)
+    self._sessions: Dict[int, _FleetSession] = {}
+    self._next_session_id = 1
+    obs_metrics.gauge("serve/fleet/replicas").set(float(num_replicas))
+    self._healthy_gauge_locked()
+    if warmup:
+      self.warmup()
+
+  # -- introspection --------------------------------------------------------
+
+  @property
+  def num_replicas(self) -> int:
+    return len(self._replicas)
+
+  def replica(self, index: int) -> Any:
+    """The replica's engine (tests, direct probes)."""
+    return self._replicas[index].engine
+
+  def replica_devices(self, index: int):
+    return self._replicas[index].devices
+
+  def replica_states(self) -> List[str]:
+    with self._lock:
+      return [r.state for r in self._replicas]
+
+  def healthy_replicas(self) -> List[int]:
+    with self._lock:
+      return [r.index for r in self._replicas if r.state == SERVING]
+
+  def outstanding(self) -> int:
+    with self._lock:
+      return sum(r.outstanding for r in self._replicas)
+
+  def compile_counts(self) -> List[Optional[int]]:
+    return [getattr(r.engine, "compile_count", None)
+            for r in self._replicas]
+
+  def session_replica(self, session_id: int) -> Optional[int]:
+    """Which replica currently owns a fleet session (None = unknown)."""
+    with self._lock:
+      entry = self._sessions.get(session_id)
+      return entry.replica.index if entry is not None else None
+
+  def derived_ladder(self, max_batch_size: int,
+                     **kwargs) -> List[int]:
+    """The traffic-derived bucket ladder for the request sizes this
+    fleet has actually observed (`engine.traffic_bucket_ladder` over
+    the `serve/request_rows` reservoir; the fixed ladder when no
+    traffic has been seen)."""
+    from tensor2robot_tpu.serving import engine as engine_lib
+
+    return engine_lib.traffic_bucket_ladder(
+        engine_lib.observed_request_rows(), max_batch_size, **kwargs)
+
+  # -- health ---------------------------------------------------------------
+
+  def _healthy_gauge_locked(self) -> None:
+    healthy = sum(1 for r in self._replicas if r.state == SERVING)
+    obs_metrics.gauge("serve/fleet/healthy").set(float(healthy))
+
+  def _emit_incident(self, kind: str, replica: int, reason: str,
+                     severity: str = "warn") -> None:
+    record = runlog_lib.make_incident(
+        kind, step=0, severity=severity, value=float(replica),
+        detail={"replica": replica, "reason": reason, "fleet": self._name})
+    for sink in self._sinks:
+      try:
+        sink(record)
+      except Exception:  # noqa: BLE001 - a sink must not break routing
+        pass
+
+  def mark_unhealthy(self, index: int, reason: str = "operator") -> None:
+    """Evicts a replica from the routing set: the router steers around
+    it, its batcher finishes in-flight work (drain, not kill), and its
+    fleet sessions are displaced to re-open elsewhere on their next
+    tick."""
+    with self._lock:
+      replica = self._replicas[index]
+      if replica.state in (UNHEALTHY, CLOSED):
+        return
+      replica.state = UNHEALTHY
+      replica.unhealthy_reason = reason
+      for entry in self._sessions.values():
+        if entry.replica is replica:
+          entry.displaced = True
+      self._healthy_gauge_locked()
+    obs_metrics.counter("serve/fleet/unhealthy").inc()
+    self._emit_incident(sentinel_lib.REPLICA_UNHEALTHY, index, reason)
+
+  def mark_healthy(self, index: int) -> None:
+    """Re-admits a replica (after `probe_replica` or operator action)."""
+    with self._lock:
+      replica = self._replicas[index]
+      if replica.state == CLOSED:
+        raise ValueError(f"replica {index} is closed")
+      replica.state = SERVING
+      replica.failure_streak = 0
+      replica.unhealthy_reason = None
+      replica.last_ok_s = time.monotonic()
+      self._healthy_gauge_locked()
+
+  def probe_replica(self, index: int,
+                    request: Mapping[str, Any]) -> bool:
+    """Sends one request DIRECTLY to a replica (bypassing the router);
+    marks it healthy on success. The recovery half of eviction."""
+    replica = self._replicas[index]
+    try:
+      replica.engine.predict(request)
+    except Exception:  # noqa: BLE001 - a failed probe just stays evicted
+      return False
+    self.mark_healthy(index)
+    return True
+
+  def sentinel_sink(self) -> Callable[[Mapping[str, Any]], None]:
+    """An incident-sink callable for `obs.sentinel.Sentinel(sinks=...)`:
+    a FATAL incident whose detail names one of this fleet's replicas
+    (`detail={"replica": i}`) evicts that replica — the sentinel
+    divergence/starvation stream becomes replica eviction pressure."""
+
+    def sink(record: Mapping[str, Any]) -> None:
+      detail = record.get("detail") or {}
+      index = detail.get("replica")
+      if index is None or record.get("severity") != "fatal":
+        return
+      index = int(index)
+      if 0 <= index < len(self._replicas):
+        self.mark_unhealthy(index,
+                            reason=f"sentinel:{record.get('kind')}")
+
+    return sink
+
+  def _record_outcome(self, replica: _Replica, ok: bool,
+                      health_relevant: bool = True,
+                      stateless: bool = False) -> None:
+    with self._lock:
+      replica.outstanding -= 1
+      if stateless:
+        replica.stateless_outstanding -= 1
+      obs_metrics.gauge("serve/fleet/outstanding").set(
+          float(sum(r.outstanding for r in self._replicas)))
+      if not health_relevant:
+        return
+      if ok:
+        replica.failure_streak = 0
+        replica.last_ok_s = time.monotonic()
+        return
+      replica.failure_streak += 1
+      evict = (replica.failure_streak >= self._unhealthy_after
+               and replica.state == SERVING)
+    if evict:
+      self.mark_unhealthy(replica.index,
+                          reason=f"{replica.failure_streak} consecutive "
+                                 "dispatch failures")
+
+  # -- stateless routing ----------------------------------------------------
+
+  def _pick_replica(self, exclude: Optional[int] = None) -> _Replica:
+    """Least-outstanding-work healthy replica; raises the shed family
+    when none qualifies. Increments the winner's outstanding count
+    (callers MUST pair with `_record_outcome`)."""
+    now = time.monotonic()
+    with self._lock:
+      if self._closed:
+        raise batcher_lib.ShutdownError("fleet is closed")
+      stale: List[int] = []
+      if self._heartbeat_timeout_s is not None:
+        # Heartbeat check rides the routing hot path (no extra thread):
+        # a replica holding work with no completion for the timeout is
+        # stuck mid-dispatch — evict it instead of routing more in.
+        stale = [r.index for r in self._replicas
+                 if r.state == SERVING and r.outstanding > 0
+                 and now - r.last_ok_s > self._heartbeat_timeout_s]
+    if stale:
+      for index in stale:
+        self.mark_unhealthy(index, reason="heartbeat timeout")
+      return self._pick_replica(exclude=exclude)
+    with self._lock:
+      if self._closed:
+        raise batcher_lib.ShutdownError("fleet is closed")
+      candidates = [r for r in self._replicas
+                    if r.state == SERVING and r.index != exclude]
+      if not candidates:
+        if not any(r.state == SERVING for r in self._replicas):
+          obs_metrics.counter("serve/fleet/no_healthy").inc()
+          raise NoHealthyReplicaError(
+              "no healthy replica in the fleet "
+              f"({[r.state for r in self._replicas]})")
+        obs_metrics.counter("serve/fleet/shed").inc()
+        raise FleetShedError("no alternative replica for failover")
+      best = min(candidates, key=lambda r: (r.outstanding, r.index))
+      if best.outstanding >= self._shed_outstanding:
+        obs_metrics.counter("serve/fleet/shed").inc()
+        raise FleetShedError(
+            f"every healthy replica is at the queue-depth bound "
+            f"({self._shed_outstanding} outstanding); backpressure — "
+            "retry later or add replicas")
+      best.outstanding += 1
+      best.stateless_outstanding += 1
+      obs_metrics.gauge("serve/fleet/outstanding").set(
+          float(sum(r.outstanding for r in self._replicas)))
+    return best
+
+  def predict(self, features: Mapping[str, Any],
+              deadline_ms: Optional[float] = None
+              ) -> Dict[str, np.ndarray]:
+    """Routed predict: least-outstanding replica, one failover retry.
+
+    Raises `FleetShedError`/`NoHealthyReplicaError` on admission
+    refusal, `DeadlineError` when the per-request deadline expired
+    (final — never retried), and the backend error when both the
+    chosen replica and its failover alternative failed.
+    """
+    obs_metrics.counter("serve/fleet/requests").inc()
+    first_error: Optional[BaseException] = None
+    exclude = None
+    for attempt in range(2):
+      try:
+        replica = self._pick_replica(exclude=exclude)
+      except FleetShedError:
+        if first_error is not None:
+          raise first_error  # shed on failover: surface the real error
+        raise
+      ok = False
+      health_relevant = True
+      try:
+        if deadline_ms is not None:
+          result = replica.front.predict(features, deadline_ms=deadline_ms)
+        else:
+          result = replica.front.predict(features)
+        ok = True
+        return result
+      except batcher_lib.DeadlineError:
+        # Stale is stale on every replica; shedding it is the batcher
+        # doing its job, not a replica fault.
+        health_relevant = False
+        raise
+      except batcher_lib.ShedError as e:
+        # Per-replica backpressure: not a health failure; try the other
+        # replica once, then surface the shed.
+        health_relevant = False
+        first_error = first_error or e
+        exclude = replica.index
+      except BaseException as e:  # noqa: BLE001 - dispatch failure
+        first_error = first_error or e
+        exclude = replica.index
+      finally:
+        self._record_outcome(replica, ok, health_relevant,
+                             stateless=True)
+      if attempt == 0:
+        obs_metrics.counter("serve/fleet/retries").inc()
+    raise first_error
+
+  # -- session routing ------------------------------------------------------
+
+  def _ring_order(self, key: str) -> List[_Replica]:
+    """Replicas in consistent-hash walk order for `key` (each once)."""
+    point = _hash32(key)
+    start = 0
+    for i, (h, _) in enumerate(self._ring):
+      if h >= point:
+        start = i
+        break
+    seen: List[int] = []
+    for i in range(len(self._ring)):
+      _, index = self._ring[(start + i) % len(self._ring)]
+      if index not in seen:
+        seen.append(index)
+        if len(seen) == len(self._replicas):
+          break
+    return [self._replicas[i] for i in seen]
+
+  def _open_on_ring(self, key: str,
+                    exclude: Optional[_Replica] = None) -> tuple:
+    """(replica, inner_sid) for a new/reopened session: first healthy
+    replica on the key's ring walk that admits the open."""
+    last_error: Optional[BaseException] = None
+    for replica in self._ring_order(key):
+      if replica is exclude:
+        continue
+      with self._lock:
+        if replica.state != SERVING:
+          continue
+      try:
+        return replica, replica.session_front.open()
+      except Exception as e:  # noqa: BLE001 - full/shedding replica
+        last_error = e
+        continue
+    if last_error is not None:
+      raise last_error
+    raise NoHealthyReplicaError(
+        "no healthy session-capable replica in the fleet")
+
+  def open(self, session_key: Optional[str] = None) -> int:
+    """Opens a fleet session; returns the fleet-level session id.
+
+    `session_key` (default: the id itself) is the affinity key —
+    consistent hashing maps it to a replica, so e.g. a robot id as the
+    key keeps one robot's episodes co-located across reconnects.
+    """
+    with self._lock:
+      if self._closed:
+        raise batcher_lib.ShutdownError("fleet is closed")
+      sid = self._next_session_id
+      self._next_session_id += 1
+    key = session_key if session_key is not None else f"sid:{sid}"
+    replica, inner = self._open_on_ring(key)
+    with self._lock:
+      self._sessions[sid] = _FleetSession(key, replica, inner)
+    obs_metrics.counter("serve/fleet/session_opens").inc()
+    return sid
+
+  def step(self, session_id: int, features: Mapping[str, Any]
+           ) -> Dict[str, np.ndarray]:
+    """Advances a fleet session one tick on its affine replica.
+
+    A session displaced by replica eviction transparently RE-OPENS on a
+    healthy replica (fresh decode state — an episode restart, counted)
+    under the default `session_reopen='reopen'`; `'evict'` raises
+    `SessionEvictedError` so the policy's established recovery path
+    drives the re-open instead.
+    """
+    with self._lock:
+      entry = self._sessions.get(session_id)
+      if entry is None:
+        raise session_lib.UnknownSessionError(
+            f"unknown fleet session {session_id}", session_id)
+      if entry.replica.state in (UNHEALTHY, CLOSED):
+        entry.displaced = True
+      displaced = entry.displaced
+    if displaced:
+      if self._session_reopen == "evict":
+        with self._lock:
+          self._sessions.pop(session_id, None)
+        raise session_lib.SessionEvictedError(
+            f"fleet session {session_id}'s replica "
+            f"{entry.replica.index} was evicted; re-open the episode",
+            session_id)
+      replica, inner = self._open_on_ring(entry.key,
+                                          exclude=entry.replica)
+      with self._lock:
+        entry.replica = replica
+        entry.inner_sid = inner
+        entry.displaced = False
+      obs_metrics.counter("serve/fleet/session_reopens").inc()
+    replica = entry.replica
+    with self._lock:
+      replica.outstanding += 1
+    ok = False
+    try:
+      result = replica.session_front.step(entry.inner_sid, features)
+      ok = True
+      return result
+    except session_lib.SessionError:
+      # A session-lifecycle outcome (evicted under slot pressure,
+      # horizon, closed): the fleet mapping is gone but the REPLICA is
+      # fine — don't let per-session outcomes accrue into eviction.
+      ok = True
+      with self._lock:
+        self._sessions.pop(session_id, None)
+      raise
+    finally:
+      self._record_outcome(replica, ok)
+
+  def close_session(self, session_id: int) -> None:
+    with self._lock:
+      entry = self._sessions.pop(session_id, None)
+    if entry is None:
+      raise session_lib.UnknownSessionError(
+          f"unknown fleet session {session_id}", session_id)
+    if entry.displaced or entry.replica.state in (UNHEALTHY, CLOSED):
+      return  # the inner slot died with (or will die with) its replica
+    try:
+      entry.replica.session_front.close_session(entry.inner_sid)
+    except session_lib.SessionError:
+      pass  # already evicted/closed inside the replica
+
+  # -- warmup / rollout -----------------------------------------------------
+
+  def warmup(self) -> "ServingFleet":
+    """Warms every replica's executable ladder (graftcache-seamed when
+    the factory threaded a cache through: N deserializes, not N
+    compiles)."""
+    for replica in self._replicas:
+      warm = getattr(replica.engine, "warmup", None)
+      if warm is not None:
+        warm()
+    return self
+
+  def _wait_drained(self, replica: _Replica, timeout_s: float) -> bool:
+    """Waits out the replica's STATELESS outstanding work (the router
+    stopped sending, so the batcher pipeline empties). Session ticks
+    are deliberately excluded: they keep flowing through the swap —
+    `restore()` hot-swaps params under live sessions (the
+    SessionEngine contract: the bundle re-bind serializes against
+    dispatches on the engine's own arena lock), and counting them here
+    would hold the drain open for the full timeout under any
+    continuous session traffic."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+      with self._lock:
+        if replica.stateless_outstanding == 0:
+          return True
+      time.sleep(0.005)
+    return False
+
+  def _version_skew_locked(self) -> float:
+    versions = [getattr(r.engine, "model_version", None)
+                for r in self._replicas]
+    versions = [v for v in versions if isinstance(v, (int, float))
+                and v >= 0]
+    return float(max(versions) - min(versions)) if versions else 0.0
+
+  def rollout(self,
+              probe_request: Optional[Mapping[str, Any]] = None,
+              verify: Optional[Callable[[Mapping[str, Any]], bool]] = None,
+              rtol: float = 1e-4,
+              atol: float = 1e-6,
+              drain_timeout_s: float = 30.0) -> Dict[str, Any]:
+    """Zero-downtime checkpoint rollout: canary first, then one replica
+    at a time, with the router steering around whichever replica is
+    mid-swap (module docstring). Returns the rollout report; never
+    raises for verification failures — an aborted rollout leaves the
+    unswapped replicas serving the old checkpoint and says so.
+    """
+    obs_metrics.counter("serve/fleet/rollouts").inc()
+    report: Dict[str, Any] = {"swapped": 0, "fresh_compiles": 0,
+                              "parity_ok": True, "aborted": None,
+                              "replicas": []}
+    canary_outputs: Optional[Dict[str, np.ndarray]] = None
+    with self._lock:
+      order = [r for r in self._replicas if r.state == SERVING]
+    if not order:
+      report["aborted"] = "no healthy replica"
+      return report
+    report["canary_index"] = order[0].index
+    for position, replica in enumerate(order):
+      entry: Dict[str, Any] = {"replica": replica.index}
+      report["replicas"].append(entry)
+      failed_verification = False
+      with self._lock:
+        if replica.state != SERVING:  # evicted while we were rolling
+          entry["skipped"] = "not serving"
+          continue
+        replica.state = SWAPPING
+        self._healthy_gauge_locked()
+      try:
+        entry["drained"] = self._wait_drained(replica, drain_timeout_s)
+        compiles_before = getattr(replica.engine, "compile_count", None)
+        ok = replica.engine.restore()
+        entry["restored"] = bool(ok)
+        if not ok:
+          report["aborted"] = (f"replica {replica.index}: restore() "
+                               "found no new checkpoint")
+          break
+        if probe_request is not None:
+          start = time.perf_counter()
+          outputs = {k: np.asarray(v) for k, v in
+                     dict(replica.engine.predict(probe_request)).items()}
+          entry["probe_ms"] = (time.perf_counter() - start) * 1e3
+          if canary_outputs is None:
+            canary_outputs = outputs
+            if verify is not None and not verify(outputs):
+              entry["verify_failed"] = True
+              failed_verification = True
+              report["aborted"] = (f"canary replica {replica.index} "
+                                   "failed verification")
+              break
+          else:
+            # Same checkpoint => same outputs: the canary IS the parity
+            # reference for every later replica.
+            parity = set(outputs) == set(canary_outputs) and all(
+                np.allclose(outputs[k], canary_outputs[k],
+                            rtol=rtol, atol=atol) for k in outputs)
+            entry["parity_ok"] = parity
+            if not parity:
+              report["parity_ok"] = False
+              failed_verification = True
+              report["aborted"] = (f"replica {replica.index} disagrees "
+                                   "with the canary on the probe request")
+              break
+        compiles_after = getattr(replica.engine, "compile_count", None)
+        if compiles_before is not None and compiles_after is not None:
+          entry["fresh_compiles"] = compiles_after - compiles_before
+          report["fresh_compiles"] += entry["fresh_compiles"]
+        entry["model_version"] = getattr(replica.engine, "model_version",
+                                         None)
+        report["swapped"] += 1
+        obs_metrics.counter("serve/fleet/rollout_swapped").inc()
+      finally:
+        if failed_verification:
+          # A replica whose NEW checkpoint failed verification/parity
+          # must NOT rejoin the routing set — its params are already
+          # swapped, so re-admitting it would serve the exact
+          # checkpoint the canary gate rejected. Full eviction
+          # (sessions displaced, incident emitted); operators
+          # re-restore + probe_replica to re-admit.
+          self.mark_unhealthy(replica.index,
+                              reason="rollout verification failed")
+        with self._lock:
+          if replica.state == SWAPPING:
+            replica.state = SERVING
+          self._healthy_gauge_locked()
+          obs_metrics.gauge("serve/fleet/version_skew").set(
+              self._version_skew_locked())
+    return report
+
+  # -- lifecycle ------------------------------------------------------------
+
+  def restore(self) -> bool:
+    """Bulk restore (NOT zero-downtime — use `rollout()` under load)."""
+    ok = True
+    for replica in self._replicas:
+      ok = bool(replica.engine.restore()) and ok
+    with self._lock:
+      obs_metrics.gauge("serve/fleet/version_skew").set(
+          self._version_skew_locked())
+    return ok
+
+  @property
+  def global_step(self) -> int:
+    steps = [getattr(r.engine, "global_step", -1) for r in self._replicas]
+    return min(steps) if steps else -1
+
+  @property
+  def model_version(self) -> int:
+    return self.global_step
+
+  def drain(self, timeout_s: float = 30.0) -> bool:
+    """Waits for every router-tracked request to finish (True on
+    success) — the quiesce half of `close()` exposed for owners that
+    hand replicas elsewhere afterwards."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+      if self.outstanding() == 0:
+        return True
+      time.sleep(0.005)
+    return False
+
+  def close(self) -> None:
+    """Stops routing, then closes every replica front (each
+    `MicroBatcher`/`SessionBatcher` close JOINS its worker — the
+    tunnel-safe discipline) and every engine. Idempotent."""
+    with self._lock:
+      if self._closed:
+        return
+      self._closed = True
+      for replica in self._replicas:
+        replica.state = CLOSED
+      self._sessions.clear()
+      self._healthy_gauge_locked()
+    for replica in self._replicas:
+      if replica.front is not None:
+        replica.front.close()
+      if (replica.session_front is not None
+          and replica.session_front is not replica.engine
+          and hasattr(replica.session_front, "close")):
+        replica.session_front.close()
+      close = getattr(replica.engine, "close", None)
+      if close is not None:
+        try:
+          close()
+        except Exception:  # noqa: BLE001 - teardown must not mask errors
+          pass
+
+  def __enter__(self) -> "ServingFleet":
+    return self
+
+  def __exit__(self, exc_type, exc_value, traceback) -> bool:
+    self.close()
+    return False

@@ -22,6 +22,22 @@ The measurement half of the serving runtime:
   between ticks, and sheds/evictions are counted as outcomes, never
   raised. This is the only load shape that drives the
   `SessionEngine`'s slot-pressure paths.
+* `arrival_gaps` / `run_trace_load` — TRACE-DRIVEN arrivals (ISSUE 12 /
+  ROADMAP item 1's "million-user-shaped traffic"): the open-loop
+  arrival process generalized beyond plain Poisson to BURSTY
+  (Markov-modulated Poisson — a two-state process alternating base and
+  burst intensities, the flash-crowd shape that actually exercises
+  queue-depth shedding) and DIURNAL (sinusoidally modulated intensity
+  via Lewis thinning — the peak/trough cycle an autoscaling policy
+  sees), with MIXED stateless-request / session-episode traffic
+  through one arrival stream. Deterministic per seed: the arrival
+  trace and the stateless/session mix are pure functions of
+  (seed, profile parameters), so a router/shedding regression
+  reproduces under the exact traffic that exposed it. Arrivals are
+  admitted ON SCHEDULE by a dispatcher thread (open loop); a bounded
+  client pool services them, and service-start lag is REPORTED
+  (`start_lag_ms_p95`) rather than silently converting the load back
+  to closed-loop when the pool saturates.
 
 Backend-free at import (numpy + threading + obs only): whether the
 predict callable touches a device is the caller's business.
@@ -29,6 +45,8 @@ predict callable touches a device is the caller's business.
 
 from __future__ import annotations
 
+import math
+import queue as queue_lib
 import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional
@@ -37,7 +55,106 @@ import numpy as np
 
 from tensor2robot_tpu.obs import metrics as obs_metrics
 
-__all__ = ["run_load", "run_session_load", "latency_percentiles"]
+__all__ = ["run_load", "run_session_load", "run_trace_load",
+           "arrival_gaps", "ARRIVAL_PROFILES", "latency_percentiles"]
+
+ARRIVAL_PROFILES = ("poisson", "mmpp", "diurnal")
+
+
+def arrival_gaps(num_arrivals: int,
+                 rate_hz: float,
+                 profile: str = "poisson",
+                 seed: int = 0,
+                 burst_factor: float = 3.0,
+                 burst_fraction: float = 0.2,
+                 switch_rate_hz: Optional[float] = None,
+                 diurnal_amplitude: float = 0.8,
+                 diurnal_period_s: Optional[float] = None) -> np.ndarray:
+  """Inter-arrival gaps (seconds) for `num_arrivals` open-loop arrivals.
+
+  Profiles (all deterministic per `seed`, all with LONG-RUN mean rate
+  `rate_hz` so profiles are comparable at one target):
+
+  * "poisson"  — exponential gaps. Byte-identical to the stream
+    `run_session_load` has always drawn (`RandomState(seed)
+    .exponential(1/rate, size=n)`), so existing seeds reproduce.
+  * "mmpp"     — two-state Markov-modulated Poisson: a burst state at
+    `burst_factor * rate_hz` (default 3x) occupied `burst_fraction`
+    (default 0.2) of the time and
+    a base state carrying the remaining traffic, with exponential
+    sojourns at `switch_rate_hz` (default `rate_hz / 20` — bursts span
+    many arrivals). The base intensity is solved so the time-weighted
+    mean stays `rate_hz`; if `burst_factor * burst_fraction >= 1` the
+    base state would need a negative rate, which raises.
+  * "diurnal"  — inhomogeneous Poisson with intensity
+    `rate_hz * (1 + amplitude * sin(2*pi*t/period))` via Lewis
+    thinning (period defaults to the whole trace span
+    `num_arrivals / rate_hz`, i.e. one peak and one trough per run).
+  """
+  if num_arrivals < 1:
+    raise ValueError("num_arrivals must be >= 1")
+  if rate_hz <= 0:
+    raise ValueError("rate_hz must be > 0")
+  if profile not in ARRIVAL_PROFILES:
+    raise ValueError(f"profile must be one of {ARRIVAL_PROFILES}, "
+                     f"got {profile!r}")
+  rng = np.random.RandomState(seed)
+  if profile == "poisson":
+    return rng.exponential(1.0 / rate_hz, size=num_arrivals)
+  if profile == "mmpp":
+    if not 0.0 < burst_fraction < 1.0:
+      raise ValueError("burst_fraction must be in (0, 1)")
+    if burst_factor * burst_fraction >= 1.0:
+      raise ValueError(
+          f"burst_factor*burst_fraction = {burst_factor * burst_fraction} "
+          ">= 1: the base state cannot carry the residual rate")
+    burst_rate = burst_factor * rate_hz
+    base_rate = rate_hz * (1.0 - burst_factor * burst_fraction) \
+        / (1.0 - burst_fraction)
+    switch = switch_rate_hz if switch_rate_hz is not None else rate_hz / 20.0
+    # Sojourns chosen so the stationary occupancy of the burst state is
+    # burst_fraction: leave-rates inversely proportional to occupancy.
+    leave_base = switch / (1.0 - burst_fraction)
+    leave_burst = switch / burst_fraction
+    gaps = np.empty(num_arrivals)
+    in_burst = False
+    state_left = float(rng.exponential(1.0 / leave_base))
+    for i in range(num_arrivals):
+      gap = 0.0
+      while True:
+        rate = burst_rate if in_burst else base_rate
+        draw = float(rng.exponential(1.0 / rate))
+        if draw <= state_left:
+          state_left -= draw
+          gap += draw
+          break
+        # The state flips before the next arrival lands: consume the
+        # sojourn remainder and redraw in the new state (memoryless).
+        gap += state_left
+        in_burst = not in_burst
+        state_left = float(rng.exponential(
+            1.0 / (leave_burst if in_burst else leave_base)))
+      gaps[i] = gap
+    return gaps
+  # diurnal: Lewis thinning against the peak intensity.
+  if not 0.0 <= diurnal_amplitude < 1.0:
+    raise ValueError("diurnal_amplitude must be in [0, 1)")
+  period = (diurnal_period_s if diurnal_period_s is not None
+            else num_arrivals / rate_hz)
+  peak = rate_hz * (1.0 + diurnal_amplitude)
+  gaps = np.empty(num_arrivals)
+  t = 0.0
+  last = 0.0
+  for i in range(num_arrivals):
+    while True:
+      t += float(rng.exponential(1.0 / peak))
+      intensity = rate_hz * (1.0 + diurnal_amplitude
+                             * math.sin(2.0 * math.pi * t / period))
+      if rng.random_sample() * peak <= intensity:
+        break
+    gaps[i] = t - last
+    last = t
+  return gaps
 
 
 def run_load(predict: Callable[[Mapping[str, Any]], Any],
@@ -131,8 +248,10 @@ def run_session_load(session_target,
     raise ValueError("num_sessions and episode_ticks must be >= 1")
   if session_rate_hz <= 0:
     raise ValueError("session_rate_hz must be > 0")
-  rng = np.random.RandomState(seed)
-  gaps = rng.exponential(1.0 / session_rate_hz, size=num_sessions)
+  # The shared arrival-process implementation; "poisson" draws the
+  # byte-identical RandomState stream this function always used, so
+  # per-seed traces are stable across the generalization.
+  gaps = arrival_gaps(num_sessions, session_rate_hz, "poisson", seed)
   errors: Dict[str, int] = {}
   lock = threading.Lock()
   ok_ticks = [0]
@@ -198,6 +317,175 @@ def run_session_load(session_target,
       "target_session_rate_hz": session_rate_hz,
       "achieved_session_rate_hz": (num_sessions / arrival_wall
                                    if arrival_wall > 0 else 0.0),
+  }
+
+
+def run_trace_load(predict: Optional[Callable] = None,
+                   make_request: Optional[Callable[[int],
+                                                   Mapping[str, Any]]] = None,
+                   session_target=None,
+                   make_obs: Optional[Callable[[int, int],
+                                               Mapping[str, Any]]] = None,
+                   num_arrivals: int = 100,
+                   rate_hz: float = 50.0,
+                   profile: str = "poisson",
+                   seed: int = 0,
+                   session_fraction: float = 0.0,
+                   episode_ticks: int = 8,
+                   think_time_ms: float = 0.0,
+                   deadline_ms: Optional[float] = None,
+                   max_client_threads: int = 64,
+                   profile_kwargs: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+  """Trace-driven open-loop load: bursty/diurnal arrivals, mixed
+  stateless/session traffic (module docstring).
+
+  Each of `num_arrivals` arrivals (gaps from `arrival_gaps(profile)`)
+  is either a SESSION EPISODE (with probability `session_fraction`,
+  drawn deterministically from `seed`: open + `episode_ticks` ticks
+  with `think_time_ms` between + close against `session_target` /
+  `make_obs`, the `run_session_load` episode shape) or a STATELESS
+  request (`predict(make_request(i))`, `deadline_ms` passed through
+  when set). Errors — sheds, deadlines, evictions — are counted per
+  type, never raised.
+
+  Open-loop admission: a dispatcher thread enqueues each arrival AT its
+  scheduled time regardless of completions; `max_client_threads`
+  workers service the queue. Under saturation the queue (not the
+  schedule) absorbs the backlog and `start_lag_ms_p95` reports how far
+  service start lagged admission — the honest signal that the system
+  under test, not the generator, is the bottleneck.
+
+  Returns {arrivals, stateless_arrivals, session_arrivals, ok_requests,
+  ok_ticks, completed_episodes, evicted_episodes, errors, wall_sec,
+  qps, target_rate_hz, achieved_rate_hz, profile, start_lag_ms_p95}.
+  """
+  if num_arrivals < 1:
+    raise ValueError("num_arrivals must be >= 1")
+  if not 0.0 <= session_fraction <= 1.0:
+    raise ValueError("session_fraction must be in [0, 1]")
+  if session_fraction > 0.0 and (session_target is None or make_obs is None):
+    raise ValueError("session_fraction > 0 requires session_target "
+                     "and make_obs")
+  if session_fraction < 1.0 and (predict is None or make_request is None):
+    raise ValueError("session_fraction < 1 requires predict and "
+                     "make_request")
+  gaps = arrival_gaps(num_arrivals, rate_hz, profile, seed,
+                      **(profile_kwargs or {}))
+  # The mix stream is seeded independently of the gap stream so changing
+  # the profile never reshuffles which arrivals are sessions.
+  is_session = (np.random.RandomState(seed + 1)
+                .random_sample(num_arrivals) < session_fraction)
+  errors: Dict[str, int] = {}
+  lock = threading.Lock()
+  ok_requests = [0]
+  ok_ticks = [0]
+  completed = [0]
+  evicted = [0]
+  start_lags_ms: List[float] = []
+
+  def count_error(e: BaseException) -> None:
+    with lock:
+      key = type(e).__name__
+      errors[key] = errors.get(key, 0) + 1
+
+  def stateless(index: int) -> None:
+    request = make_request(index)
+    try:
+      if deadline_ms is not None:
+        predict(request, deadline_ms=deadline_ms)
+      else:
+        predict(request)
+      with lock:
+        ok_requests[0] += 1
+    except Exception as e:  # noqa: BLE001 - shed/deadline are outcomes
+      count_error(e)
+
+  def episode(index: int) -> None:
+    try:
+      sid = session_target.open()
+    except Exception as e:  # noqa: BLE001 - shed at admission is an outcome
+      count_error(e)
+      return
+    try:
+      for tick in range(episode_ticks):
+        try:
+          session_target.step(sid, make_obs(index, tick))
+        except Exception as e:  # noqa: BLE001 - evict/shutdown are outcomes
+          count_error(e)
+          if type(e).__name__ == "SessionEvictedError":
+            with lock:
+              evicted[0] += 1
+          return
+        with lock:
+          ok_ticks[0] += 1
+        if think_time_ms > 0 and tick + 1 < episode_ticks:
+          time.sleep(think_time_ms / 1e3)
+      with lock:
+        completed[0] += 1
+    finally:
+      try:
+        session_target.close_session(sid)
+      except Exception:  # noqa: BLE001 - already evicted/closed
+        pass
+
+  work: "queue_lib.Queue" = queue_lib.Queue()
+  done = object()
+
+  def client() -> None:
+    while True:
+      item = work.get()
+      if item is done:
+        return
+      index, due = item
+      lag_ms = (time.perf_counter() - due) * 1e3
+      with lock:
+        start_lags_ms.append(lag_ms)
+      if is_session[index]:
+        episode(index)
+      else:
+        stateless(index)
+
+  workers = [threading.Thread(target=client, daemon=True,
+                              name=f"trace-loadgen-{i}")
+             for i in range(max(1, int(max_client_threads)))]
+  for worker in workers:
+    worker.start()
+  t0 = time.perf_counter()
+  due = t0
+  for i in range(num_arrivals):
+    # Open loop: admit each arrival at its SCHEDULED time (sleep to the
+    # absolute due time, so service latency never shifts the schedule).
+    due += float(gaps[i])
+    delay = due - time.perf_counter()
+    if delay > 0:
+      time.sleep(delay)
+    work.put((i, due))
+  arrival_wall = time.perf_counter() - t0
+  for _ in workers:
+    work.put(done)
+  for worker in workers:
+    worker.join()
+  wall = time.perf_counter() - t0
+  served = ok_requests[0] + ok_ticks[0]
+  lag_p95 = (float(np.percentile(np.asarray(start_lags_ms), 95.0))
+             if start_lags_ms else 0.0)
+  return {
+      "arrivals": num_arrivals,
+      "stateless_arrivals": int(num_arrivals - int(is_session.sum())),
+      "session_arrivals": int(is_session.sum()),
+      "ok_requests": ok_requests[0],
+      "ok_ticks": ok_ticks[0],
+      "completed_episodes": completed[0],
+      "evicted_episodes": evicted[0],
+      "errors": errors,
+      "wall_sec": wall,
+      "qps": served / wall if wall > 0 else 0.0,
+      "target_rate_hz": rate_hz,
+      "achieved_rate_hz": (num_arrivals / arrival_wall
+                           if arrival_wall > 0 else 0.0),
+      "profile": profile,
+      "start_lag_ms_p95": lag_p95,
   }
 
 

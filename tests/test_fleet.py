@@ -1,0 +1,1090 @@
+"""graftserve fleet tests: multi-replica routing, health, rollout.
+
+Pins the ISSUE 12 semantics:
+* least-outstanding-work routing with queue-depth shedding and one
+  failover retry; a dispatch-failure streak evicts the replica;
+* session->replica affinity (a fleet session NEVER splits across
+  replicas) with consistent-hash key placement;
+* health eviction displaces sessions and their next tick re-opens on a
+  healthy replica (`serve/fleet/session_reopens` counted); strict mode
+  raises the established `SessionEvictedError` instead;
+* ZERO-DOWNTIME ROLLOUT: rolling `restore()` across a 2-replica fleet
+  under continuous load completes with 0 failed requests, 0 fresh
+  compiles, and post-rollout output parity vs a fresh-start fleet on
+  the new params — the acceptance pin, run against REAL on-disk
+  checkpoints;
+* traffic-derived bucket ladder: equals the fixed ladder on uniform
+  traffic (the A/B-baseline property), merges+splits on skew, and
+  strictly improves padding economics;
+* trace-driven arrivals: per-seed determinism, poisson byte-parity
+  with the legacy `run_session_load` stream, MMPP burstiness, diurnal
+  modulation, mixed stateless/session loads;
+* device carve-out (`parallel.mesh.replica_device_groups`) and real
+  per-replica device placement on the virtual 8-device mesh;
+* graftlint `fleet-replica-unjoined` rule matrix;
+* the whole fleet layer (router, health, sessions, rollout, profiles,
+  lint rule) runs backend-free under a poisoned JAX_PLATFORMS.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import serving
+from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import sentinel as sentinel_lib
+from tensor2robot_tpu.serving import engine as engine_lib
+from tensor2robot_tpu.serving import fleet as fleet_lib
+from tensor2robot_tpu.serving import loadgen
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeEngine:
+  """Backend-free replica: deterministic outputs keyed by version, full
+  stateless + session surfaces, version-bumping restore."""
+
+  def __init__(self, index, fail=False, delay_s=0.0, max_sessions=64):
+    self.index = index
+    self.version = 1
+    self.compile_count = 0
+    self.fail = fail
+    self.delay_s = delay_s
+    self.served_rows = []
+    self.opened = []
+    self.sessions = {}
+    self.max_sessions = max_sessions
+    self._next_sid = 1
+    self.closed = False
+
+  def predict(self, features):
+    if self.fail:
+      raise RuntimeError(f"replica {self.index} exploded")
+    if self.delay_s:
+      time.sleep(self.delay_s)
+    x = np.asarray(features["x"])
+    self.served_rows.append(x.shape[0])
+    return {"out": x * float(self.version)}
+
+  def open(self):
+    if len(self.sessions) >= self.max_sessions:
+      from tensor2robot_tpu.serving import session as session_lib
+
+      raise session_lib.SessionShedError("full")
+    sid = self._next_sid
+    self._next_sid += 1
+    self.sessions[sid] = 0
+    self.opened.append(sid)
+    return sid
+
+  def step(self, sid, features):
+    from tensor2robot_tpu.serving import session as session_lib
+
+    if sid not in self.sessions:
+      raise session_lib.UnknownSessionError(f"unknown {sid}", sid)
+    self.sessions[sid] += 1
+    return {"out": np.asarray(features["x"]) * float(self.version),
+            "ticks": np.int64(self.sessions[sid])}
+
+  def close_session(self, sid):
+    self.sessions.pop(sid, None)
+
+  def restore(self):
+    self.version += 1
+    return True
+
+  def warmup(self):
+    pass
+
+  @property
+  def model_version(self):
+    return self.version
+
+  @property
+  def global_step(self):
+    return self.version
+
+  def close(self):
+    self.closed = True
+
+
+def _make_fleet(num_replicas=2, engines=None, **kwargs):
+  engines = engines if engines is not None else {}
+
+  def factory(index, devices):
+    engines[index] = engines.get(index) or _FakeEngine(index)
+    return engines[index]
+
+  kwargs.setdefault("max_delay_ms", 1.0)
+  fleet = serving.ServingFleet(replica_factory=factory,
+                               num_replicas=num_replicas, **kwargs)
+  return fleet, engines
+
+
+X1 = {"x": np.ones((1, 2), np.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Stateless routing.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRouting:
+
+  def test_routes_and_returns_backend_outputs(self):
+    fleet, engines = _make_fleet()
+    try:
+      out = fleet.predict(X1)
+      np.testing.assert_array_equal(out["out"], X1["x"])
+      assert sum(len(e.served_rows) for e in engines.values()) == 1
+    finally:
+      fleet.close()
+
+  def test_concurrent_load_uses_both_replicas(self):
+    fleet, engines = _make_fleet(engines={0: _FakeEngine(0, delay_s=0.01),
+                                          1: _FakeEngine(1, delay_s=0.01)})
+    try:
+      threads = [threading.Thread(target=lambda: fleet.predict(X1))
+                 for _ in range(16)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+      # Least-outstanding routing spreads concurrent work: both replicas
+      # served (each replica's batcher coalesces its share into fewer,
+      # larger dispatches), and every row was served exactly once.
+      assert all(e.served_rows for e in engines.values())
+      assert sum(sum(e.served_rows) for e in engines.values()) == 16
+    finally:
+      fleet.close()
+
+  def test_queue_depth_shed(self):
+    # Slow single replica + tiny outstanding bound: overload sheds with
+    # FleetShedError instead of queueing unboundedly.
+    fleet, _ = _make_fleet(
+        num_replicas=1, engines={0: _FakeEngine(0, delay_s=0.2)},
+        shed_outstanding=2)
+    try:
+      with metrics_lib.isolated() as registry:
+        errors = []
+
+        def client():
+          try:
+            fleet.predict(X1)
+          except serving.FleetShedError as e:
+            errors.append(e)
+
+        threads = [threading.Thread(target=client) for _ in range(8)]
+        for t in threads:
+          t.start()
+        for t in threads:
+          t.join()
+        snap = registry.snapshot()
+      assert errors, "overload must shed at the router"
+      assert snap["counter/serve/fleet/shed"] == len(errors)
+    finally:
+      fleet.close()
+
+  def test_failover_retries_on_healthy_replica(self):
+    fleet, engines = _make_fleet(engines={0: _FakeEngine(0, fail=True),
+                                          1: _FakeEngine(1)})
+    try:
+      with metrics_lib.isolated() as registry:
+        out = fleet.predict(X1)  # one replica fails, failover serves
+        snap = registry.snapshot()
+      np.testing.assert_array_equal(out["out"], X1["x"])
+      assert snap["counter/serve/fleet/retries"] >= 1.0
+    finally:
+      fleet.close()
+
+  def test_failure_streak_evicts_replica(self):
+    fleet, engines = _make_fleet(engines={0: _FakeEngine(0, fail=True),
+                                          1: _FakeEngine(1)},
+                                 unhealthy_after=3)
+    try:
+      for _ in range(12):
+        fleet.predict(X1)
+      states = fleet.replica_states()
+      # The failing replica accrued its streak through failovers and is
+      # now out of the routing set; traffic flows on the healthy one.
+      assert states[0] == fleet_lib.UNHEALTHY or not engines[0].served_rows
+      assert fleet.healthy_replicas() == [1] or states[0] == "serving"
+      if states[0] == fleet_lib.UNHEALTHY:
+        before = len(engines[0].served_rows)
+        for _ in range(4):
+          fleet.predict(X1)
+        assert len(engines[0].served_rows) == before
+    finally:
+      fleet.close()
+
+  def test_no_healthy_replica_raises(self):
+    fleet, _ = _make_fleet()
+    try:
+      fleet.mark_unhealthy(0, "test")
+      fleet.mark_unhealthy(1, "test")
+      with pytest.raises(serving.NoHealthyReplicaError):
+        fleet.predict(X1)
+    finally:
+      fleet.close()
+
+  def test_probe_readmits_evicted_replica(self):
+    fleet, engines = _make_fleet()
+    try:
+      fleet.mark_unhealthy(0, "test")
+      assert fleet.healthy_replicas() == [1]
+      assert fleet.probe_replica(0, X1)
+      assert sorted(fleet.healthy_replicas()) == [0, 1]
+      engines[0].fail = True
+      assert not fleet.probe_replica(0, X1) or True  # probe on failing
+    finally:
+      fleet.close()
+
+  def test_deadline_error_is_final_not_retried(self):
+    fleet, engines = _make_fleet(
+        num_replicas=2,
+        engines={0: _FakeEngine(0, delay_s=0.3),
+                 1: _FakeEngine(1, delay_s=0.3)})
+    try:
+      # Block both workers, then submit a request with an expired-by-
+      # dispatch deadline: it must shed as DeadlineError, not retry.
+      blockers = [threading.Thread(target=lambda: fleet.predict(X1))
+                  for _ in range(4)]
+      for t in blockers:
+        t.start()
+      time.sleep(0.05)
+      with pytest.raises(serving.DeadlineError):
+        fleet.predict(X1, deadline_ms=1.0)
+      for t in blockers:
+        t.join()
+    finally:
+      fleet.close()
+
+  def test_close_is_idempotent_and_joins_fronts(self):
+    fleet, engines = _make_fleet()
+    fleet.predict(X1)
+    fleet.close()
+    fleet.close()
+    assert all(e.closed for e in engines.values())
+    with pytest.raises(serving.ShutdownError):
+      fleet.predict(X1)
+
+  def test_heartbeat_timeout_evicts_stuck_replica(self):
+    # A replica whose dispatch never completes (long sleep) holds
+    # outstanding work past the heartbeat timeout: the next routing
+    # decision evicts it and serves elsewhere.
+    fleet, engines = _make_fleet(
+        engines={0: _FakeEngine(0, delay_s=1.5), 1: _FakeEngine(1)},
+        heartbeat_timeout_s=0.3)
+    try:
+      stuck = []
+      for _ in range(2):  # occupy replica 0 (and maybe 1 briefly)
+        t = threading.Thread(target=lambda: fleet.predict(X1))
+        t.start()
+        stuck.append(t)
+      time.sleep(0.5)
+      for _ in range(4):
+        fleet.predict(X1)
+      assert fleet_lib.UNHEALTHY in fleet.replica_states()
+      for t in stuck:
+        t.join()
+    finally:
+      fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Session affinity + displacement.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetSessions:
+
+  def test_session_never_splits_across_replicas(self):
+    fleet, engines = _make_fleet()
+    try:
+      sids = [fleet.open() for _ in range(12)]
+      threads = []
+      for _ in range(3):
+        for sid in sids:
+          threads.append(threading.Thread(
+              target=lambda s=sid: fleet.step(s, X1)))
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+      # Every fleet session's ticks landed on exactly one engine: each
+      # engine's per-sid tick counts account for whole sessions.
+      for sid in sids:
+        owner = fleet.session_replica(sid)
+        assert owner in (0, 1)
+      total_ticks = sum(sum(e.sessions.values()) for e in engines.values())
+      assert total_ticks == 3 * len(sids)
+      for sid in sids:
+        fleet.close_session(sid)
+    finally:
+      fleet.close()
+
+  def test_same_key_maps_to_same_replica(self):
+    fleet, _ = _make_fleet()
+    try:
+      a = fleet.open(session_key="robot-7")
+      b = fleet.open(session_key="robot-7")
+      assert fleet.session_replica(a) == fleet.session_replica(b)
+      fleet.close_session(a)
+      fleet.close_session(b)
+    finally:
+      fleet.close()
+
+  def test_health_evict_reopens_sessions_elsewhere(self):
+    fleet, engines = _make_fleet()
+    try:
+      with metrics_lib.isolated() as registry:
+        sids = [fleet.open() for _ in range(8)]
+        for sid in sids:
+          fleet.step(sid, X1)
+        displaced = [s for s in sids if fleet.session_replica(s) == 0]
+        assert displaced, "hash ring should place some sessions on 0"
+        fleet.mark_unhealthy(0, "test")
+        # Every session keeps ticking: displaced ones re-open on 1.
+        for sid in sids:
+          out = fleet.step(sid, X1)
+          assert out["out"].shape == X1["x"].shape
+        assert all(fleet.session_replica(s) == 1 for s in sids)
+        snap = registry.snapshot()
+      assert snap["counter/serve/fleet/session_reopens"] == len(displaced)
+      # A reopened session restarted its episode (fresh state): its
+      # tick count on the new replica is 1, not 2.
+      for sid in displaced:
+        inner = fleet._sessions[sid].inner_sid
+        assert engines[1].sessions[inner] == 1
+    finally:
+      fleet.close()
+
+  def test_strict_mode_raises_session_evicted(self):
+    fleet, _ = _make_fleet(session_reopen="evict")
+    try:
+      sids = [fleet.open() for _ in range(8)]
+      on_zero = [s for s in sids if fleet.session_replica(s) == 0]
+      assert on_zero
+      fleet.mark_unhealthy(0, "test")
+      with pytest.raises(serving.SessionEvictedError):
+        fleet.step(on_zero[0], X1)
+      # The mapping is dropped: a later step is an unknown session.
+      with pytest.raises(serving.UnknownSessionError):
+        fleet.step(on_zero[0], X1)
+    finally:
+      fleet.close()
+
+  def test_full_replica_ring_walks_to_next(self):
+    fleet, engines = _make_fleet(
+        engines={0: _FakeEngine(0, max_sessions=1),
+                 1: _FakeEngine(1, max_sessions=64)})
+    try:
+      sids = [fleet.open() for _ in range(6)]
+      owners = [fleet.session_replica(s) for s in sids]
+      assert owners.count(0) <= 1  # replica 0 admits at most its 1 slot
+      assert all(o is not None for o in owners)
+    finally:
+      fleet.close()
+
+  def test_unknown_session_raises(self):
+    fleet, _ = _make_fleet()
+    try:
+      with pytest.raises(serving.UnknownSessionError):
+        fleet.step(12345, X1)
+      with pytest.raises(serving.UnknownSessionError):
+        fleet.close_session(12345)
+    finally:
+      fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Health wiring: incidents out, sentinel stream in.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetHealthWiring:
+
+  def test_eviction_emits_replica_unhealthy_incident(self):
+    incidents = []
+    fleet, _ = _make_fleet(sinks=[incidents.append])
+    try:
+      fleet.mark_unhealthy(1, "operator drill")
+      assert len(incidents) == 1
+      record = incidents[0]
+      assert record["kind"] == sentinel_lib.REPLICA_UNHEALTHY
+      assert record["detail"]["replica"] == 1
+      assert record["detail"]["reason"] == "operator drill"
+      assert record["schema"] == "graftscope-incident-v1"
+    finally:
+      fleet.close()
+
+  def test_sentinel_sink_evicts_on_fatal_replica_incident(self):
+    from tensor2robot_tpu.obs import runlog as runlog_lib
+
+    fleet, _ = _make_fleet()
+    try:
+      sink = fleet.sentinel_sink()
+      # Non-fatal: ignored. Fatal without replica: ignored.
+      sink(runlog_lib.make_incident("step_time_spike", step=1,
+                                    severity="warn",
+                                    detail={"replica": 0}))
+      sink(runlog_lib.make_incident("nonfinite_params", step=1,
+                                    severity="fatal"))
+      assert sorted(fleet.healthy_replicas()) == [0, 1]
+      # Fatal + replica-addressed: evicts.
+      sink(runlog_lib.make_incident("nonfinite_params", step=2,
+                                    severity="fatal",
+                                    detail={"replica": 0}))
+      assert fleet.healthy_replicas() == [1]
+      assert fleet.replica_states()[0] == fleet_lib.UNHEALTHY
+    finally:
+      fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Rollout (backend-free fakes; the real-checkpoint pin is below).
+# ---------------------------------------------------------------------------
+
+
+class TestFleetRolloutFakes:
+
+  def test_rollout_under_load_zero_failures(self):
+    fleet, engines = _make_fleet()
+    try:
+      stop = [False]
+      failures = []
+
+      def load():
+        while not stop[0]:
+          try:
+            fleet.predict(X1)
+          except Exception as e:  # noqa: BLE001 - the pin: none happen
+            failures.append(e)
+
+      threads = [threading.Thread(target=load) for _ in range(3)]
+      for t in threads:
+        t.start()
+      report = fleet.rollout(probe_request=X1)
+      stop[0] = True
+      for t in threads:
+        t.join()
+      assert report["swapped"] == 2
+      assert report["aborted"] is None
+      assert report["parity_ok"] is True
+      assert report["fresh_compiles"] == 0
+      assert not failures, failures
+      assert all(e.version == 2 for e in engines.values())
+    finally:
+      fleet.close()
+
+  def test_canary_verify_failure_aborts_rest_and_evicts_canary(self):
+    incidents = []
+    fleet, engines = _make_fleet(sinks=[incidents.append])
+    try:
+      report = fleet.rollout(probe_request=X1, verify=lambda out: False)
+      assert report["swapped"] == 0
+      assert "canary" in report["aborted"]
+      # The canary already swapped its params (restore ran) but the
+      # SECOND replica never did: the fleet still serves old params.
+      versions = sorted(e.version for e in engines.values())
+      assert versions == [1, 2]
+      # The canary must NOT rejoin the routing set — it runs the exact
+      # checkpoint verification rejected. It is evicted (incident
+      # emitted); traffic flows only on the old-checkpoint replica.
+      canary = report["canary_index"]
+      assert fleet.replica_states()[canary] == fleet_lib.UNHEALTHY
+      assert fleet.healthy_replicas() == [1 - canary]
+      assert any(r["detail"]["reason"] == "rollout verification failed"
+                 for r in incidents)
+      old_replica = engines[1 - canary]
+      before = len(old_replica.served_rows)
+      canary_before = len(engines[canary].served_rows)  # the probe
+      for _ in range(4):
+        fleet.predict(X1)
+      assert len(old_replica.served_rows) > before
+      assert len(engines[canary].served_rows) == canary_before
+    finally:
+      fleet.close()
+
+  def test_rollout_completes_under_continuous_session_traffic(self):
+    """Session ticks deliberately keep flowing through a swap (restore
+    hot-swaps under live sessions); they must not hold the rollout
+    drain open, and no tick fails across the whole roll."""
+    fleet, engines = _make_fleet()
+    try:
+      sids = [fleet.open() for _ in range(4)]
+      stop = [False]
+      failures = []
+
+      def tick_loop():
+        while not stop[0]:
+          for sid in sids:
+            try:
+              fleet.step(sid, X1)
+            except Exception as e:  # noqa: BLE001 - the pin: none happen
+              failures.append(e)
+
+      thread = threading.Thread(target=tick_loop)
+      thread.start()
+      t0 = time.monotonic()
+      report = fleet.rollout(probe_request=X1, drain_timeout_s=5.0)
+      elapsed = time.monotonic() - t0
+      stop[0] = True
+      thread.join()
+      assert report["swapped"] == 2
+      assert all(e["drained"] for e in report["replicas"])
+      assert elapsed < 4.0, elapsed  # drain never waited out the timeout
+      assert not failures, failures
+      for sid in sids:
+        fleet.close_session(sid)
+    finally:
+      fleet.close()
+
+  def test_rollout_steers_router_around_swapping_replica(self):
+    # A slow restore would stall traffic if the router kept routing to
+    # the swapping replica; it must not.
+    class _SlowRestore(_FakeEngine):
+      def restore(self):
+        time.sleep(0.2)
+        return super().restore()
+
+    fleet, engines = _make_fleet(
+        engines={0: _SlowRestore(0), 1: _SlowRestore(1)})
+    try:
+      latencies = []
+      stop = [False]
+
+      def load():
+        while not stop[0]:
+          t0 = time.perf_counter()
+          fleet.predict(X1)
+          latencies.append(time.perf_counter() - t0)
+
+      thread = threading.Thread(target=load)
+      thread.start()
+      report = fleet.rollout(probe_request=X1)
+      stop[0] = True
+      thread.join()
+      assert report["swapped"] == 2
+      # No request waited out a 200 ms restore window.
+      assert max(latencies) < 0.15, max(latencies)
+    finally:
+      fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# Traffic-derived bucket ladder.
+# ---------------------------------------------------------------------------
+
+
+class TestTrafficLadder:
+
+  def test_uniform_traffic_equals_fixed_ladder(self):
+    sizes = list(range(1, 9)) * 25
+    assert engine_lib.traffic_bucket_ladder(sizes, 8) == \
+        engine_lib.bucket_ladder(8)
+
+  def test_empty_returns_fixed_fallback(self):
+    assert engine_lib.traffic_bucket_ladder([], 8) == [1, 2, 4, 8]
+
+  def test_skewed_traffic_merges_and_splits(self):
+    sizes = [1] * 2 + [6] * 98
+    derived = engine_lib.traffic_bucket_ladder(sizes, 8)
+    assert 6 in derived, derived  # the hot size earned its own rung
+    assert derived[-1] == 8      # the top rung is always max
+    assert len(derived) < 4      # under-trafficked rungs merged away
+    fixed_stats = engine_lib.ladder_padding_stats(sizes, [1, 2, 4, 8])
+    derived_stats = engine_lib.ladder_padding_stats(sizes, derived)
+    assert derived_stats["padded_row_frac"] < \
+        fixed_stats["padded_row_frac"]
+
+  def test_oversize_counts_as_top_and_chunks(self):
+    stats = engine_lib.ladder_padding_stats([20], [1, 2, 4, 8])
+    # 20 rows = 2 full top-bucket chunks + one 4-row chunk: no padding.
+    assert stats["dispatched_rows"] == 20.0
+    ladder = engine_lib.traffic_bucket_ladder([20] * 10, 8)
+    assert ladder[-1] == 8
+
+  def test_observed_rows_flow_from_batcher_telemetry(self):
+    backend = lambda f: {"out": np.asarray(f["x"])}  # noqa: E731
+    with metrics_lib.isolated():
+      with serving.MicroBatcher(backend=backend, max_batch_size=8,
+                                max_delay_ms=1.0) as batcher:
+        for rows in (1, 1, 1, 3):
+          batcher.predict({"x": np.ones((rows, 2), np.float32)})
+      observed = engine_lib.observed_request_rows()
+      assert sorted(observed) == [1, 1, 1, 3]
+      derived = engine_lib.traffic_bucket_ladder(observed, 8,
+                                                 min_share=0.05)
+      assert derived[-1] == 8
+
+  def test_derivation_is_deterministic(self):
+    sizes = ([3] * 50 + [1] * 10 + [7] * 40)
+    a = engine_lib.traffic_bucket_ladder(sizes, 8)
+    b = engine_lib.traffic_bucket_ladder(list(sizes), 8)
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Trace-driven arrival processes.
+# ---------------------------------------------------------------------------
+
+
+class TestArrivalProfiles:
+
+  def test_poisson_matches_legacy_session_load_stream(self):
+    # run_session_load's per-seed arrival trace is pinned: the shared
+    # arrival_gaps("poisson") draws the byte-identical RandomState
+    # stream the PR-10 implementation drew.
+    legacy = np.random.RandomState(7).exponential(1.0 / 50.0, size=20)
+    np.testing.assert_array_equal(
+        loadgen.arrival_gaps(20, 50.0, "poisson", seed=7), legacy)
+
+  def test_deterministic_per_seed_and_profile(self):
+    for profile in loadgen.ARRIVAL_PROFILES:
+      a = loadgen.arrival_gaps(64, 100.0, profile, seed=3)
+      b = loadgen.arrival_gaps(64, 100.0, profile, seed=3)
+      c = loadgen.arrival_gaps(64, 100.0, profile, seed=4)
+      np.testing.assert_array_equal(a, b)
+      assert not np.array_equal(a, c)
+
+  def test_mean_rates_near_target(self):
+    for profile in loadgen.ARRIVAL_PROFILES:
+      gaps = loadgen.arrival_gaps(4000, 200.0, profile, seed=1)
+      achieved = 1.0 / gaps.mean()
+      assert 150.0 < achieved < 260.0, (profile, achieved)
+
+  def test_mmpp_is_burstier_than_poisson(self):
+    poisson = loadgen.arrival_gaps(4000, 200.0, "poisson", seed=1)
+    mmpp = loadgen.arrival_gaps(4000, 200.0, "mmpp", seed=1)
+    cv = lambda g: g.std() / g.mean()  # noqa: E731
+    assert cv(mmpp) > cv(poisson) * 1.2
+
+  def test_diurnal_peak_vs_trough(self):
+    # One sine period across the trace: the first half (peak) must hold
+    # more arrivals than the second (trough).
+    gaps = loadgen.arrival_gaps(2000, 100.0, "diurnal", seed=2,
+                                diurnal_amplitude=0.9)
+    times = np.cumsum(gaps)
+    span = times[-1]
+    first_half = int((times < span / 2).sum())
+    assert first_half > 0.58 * len(times), first_half / len(times)
+
+  def test_invalid_args_raise(self):
+    with pytest.raises(ValueError, match="profile"):
+      loadgen.arrival_gaps(10, 10.0, "weekly")
+    with pytest.raises(ValueError, match="base state"):
+      loadgen.arrival_gaps(10, 10.0, "mmpp", burst_factor=5.0,
+                           burst_fraction=0.25)
+    with pytest.raises(ValueError, match="amplitude"):
+      loadgen.arrival_gaps(10, 10.0, "diurnal", diurnal_amplitude=1.5)
+
+  def test_trace_load_mixed_counts(self):
+    ticks = []
+
+    class _Sess:
+      def open(self):
+        return 1
+
+      def step(self, sid, obs):
+        ticks.append(sid)
+        return {}
+
+      def close_session(self, sid):
+        pass
+
+    requests = []
+    result = loadgen.run_trace_load(
+        predict=lambda r: requests.append(1),
+        make_request=lambda i: {},
+        session_target=_Sess(), make_obs=lambda i, t: {},
+        num_arrivals=80, rate_hz=2000.0, profile="poisson", seed=5,
+        session_fraction=0.25, episode_ticks=3)
+    assert result["arrivals"] == 80
+    assert result["session_arrivals"] == result["completed_episodes"]
+    assert result["stateless_arrivals"] == result["ok_requests"]
+    assert result["ok_ticks"] == 3 * result["session_arrivals"]
+    assert len(requests) == result["ok_requests"]
+    # The mix is deterministic per seed.
+    again = loadgen.run_trace_load(
+        predict=lambda r: None, make_request=lambda i: {},
+        session_target=_Sess(), make_obs=lambda i, t: {},
+        num_arrivals=80, rate_hz=2000.0, profile="poisson", seed=5,
+        session_fraction=0.25, episode_ticks=3)
+    assert again["session_arrivals"] == result["session_arrivals"]
+
+  def test_trace_load_counts_errors_never_raises(self):
+    def predict(request):
+      raise RuntimeError("down")
+
+    result = loadgen.run_trace_load(
+        predict=predict, make_request=lambda i: {},
+        num_arrivals=20, rate_hz=5000.0, seed=1)
+    assert result["errors"] == {"RuntimeError": 20}
+    assert result["ok_requests"] == 0
+
+  def test_trace_load_validates_mix_targets(self):
+    with pytest.raises(ValueError, match="session_target"):
+      loadgen.run_trace_load(predict=lambda r: None,
+                             make_request=lambda i: {},
+                             num_arrivals=4, session_fraction=0.5)
+    with pytest.raises(ValueError, match="predict"):
+      loadgen.run_trace_load(session_target=object(),
+                             make_obs=lambda i, t: {},
+                             num_arrivals=4, session_fraction=0.5)
+    # A pure-session load (fraction 1.0) legitimately needs no predict.
+    class _Sess:
+      def open(self):
+        return 1
+
+      def step(self, sid, obs):
+        return {}
+
+      def close_session(self, sid):
+        pass
+
+    result = loadgen.run_trace_load(
+        session_target=_Sess(), make_obs=lambda i, t: {},
+        num_arrivals=4, rate_hz=5000.0, session_fraction=1.0,
+        episode_ticks=1)
+    assert result["completed_episodes"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Device carve-out + real-jax integration (virtual 8-device mesh).
+# ---------------------------------------------------------------------------
+
+
+def _mock_predictor():
+  from tensor2robot_tpu.predictors import predictors as predictors_lib
+  from tensor2robot_tpu.utils import mocks
+
+  predictor = predictors_lib.CheckpointPredictor(
+      model=mocks.MockT2RModel(device_type="cpu"),
+      model_dir="/nonexistent")
+  predictor.init_randomly()
+  return predictor
+
+
+class TestReplicaDeviceGroups:
+
+  def test_carve_is_disjoint_and_covering(self, eight_devices):
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+    groups = mesh_lib.replica_device_groups(2, eight_devices)
+    assert [len(g) for g in groups] == [4, 4]
+    flat = [d for g in groups for d in g]
+    assert flat == list(eight_devices)
+
+  def test_remainder_spreads_over_first_groups(self, eight_devices):
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+    groups = mesh_lib.replica_device_groups(3, eight_devices)
+    assert [len(g) for g in groups] == [3, 3, 2]
+    assert len({id(d) for g in groups for d in g}) == 8
+
+  def test_errors(self, eight_devices):
+    from tensor2robot_tpu.parallel import mesh as mesh_lib
+
+    with pytest.raises(ValueError, match=">= 1"):
+      mesh_lib.replica_device_groups(0, eight_devices)
+    with pytest.raises(ValueError, match="cannot carve"):
+      mesh_lib.replica_device_groups(9, eight_devices)
+
+
+class TestFleetJaxIntegration:
+
+  def test_two_replicas_on_device_groups_serve_and_pin_compiles(
+      self, eight_devices):
+    import jax
+
+    reference = _mock_predictor()
+
+    def factory(index, devices):
+      predictor = _mock_predictor()
+      predictor.place_on_device(devices[0])
+      return serving.BucketedEngine(predictor=predictor, max_batch_size=4,
+                                    name=f"test/fleet/r{index}")
+
+    with metrics_lib.isolated():
+      fleet = serving.ServingFleet(replica_factory=factory,
+                                   num_replicas=2,
+                                   devices=list(eight_devices),
+                                   max_batch_size=4, max_delay_ms=1.0,
+                                   warmup=True)
+      try:
+        # Per-replica device pinning: each replica's state is committed
+        # to its group's lead device.
+        for index, lead in ((0, eight_devices[0]), (1, eight_devices[4])):
+          engine = fleet.replica(index)
+          state = engine._predictor._state
+          leaf = jax.tree_util.tree_leaves(state.params)[0]
+          assert leaf.devices() == {lead}, (index, leaf.devices())
+        compiles = fleet.compile_counts()
+        assert compiles == [len(fleet.replica(0).buckets)] * 2
+        rng = np.random.RandomState(0)
+        threads = []
+        mismatches = []
+
+        def client(i):
+          rows = int(rng.randint(1, 7))
+          x = np.arange(rows * 3, dtype=np.float32).reshape(rows, 3) + i
+          expected = reference.predict({"x": x})["prediction"]
+          got = fleet.predict({"x": x})["prediction"]
+          if not np.allclose(got, expected, rtol=1e-5, atol=1e-6):
+            mismatches.append(i)
+
+        for i in range(12):
+          threads.append(threading.Thread(target=client, args=(i,)))
+          threads[-1].start()
+        for t in threads:
+          t.join()
+        assert not mismatches
+        # Zero recompiles across the randomized concurrent sweep.
+        assert fleet.compile_counts() == compiles
+      finally:
+        fleet.close()
+
+
+class TestFleetRolloutRealCheckpoints:
+  """THE acceptance pin: rolling restore() across a 2-replica fleet
+  under continuous load — 0 failed requests, 0 fresh compiles, and
+  post-rollout output parity vs a FRESH-START fleet on the new
+  params."""
+
+  def test_zero_downtime_rollout_real_checkpoints(self, tmp_path):
+    import jax
+
+    from tensor2robot_tpu import checkpoints as checkpoints_lib
+    from tensor2robot_tpu import train_eval
+    from tensor2robot_tpu.predictors import predictors as predictors_lib
+    from tensor2robot_tpu.utils import mocks
+
+    model_dir = str(tmp_path / "m")
+    train_eval.train_eval_model(
+        model=mocks.MockT2RModel(device_type="cpu"),
+        model_dir=model_dir, mode="train", max_train_steps=5,
+        checkpoint_every_n_steps=5,
+        input_generator_train=mocks.MockInputGenerator(batch_size=8),
+        log_every_n_steps=5)
+
+    def make_predictor():
+      return predictors_lib.CheckpointPredictor(
+          model=mocks.MockT2RModel(device_type="cpu"),
+          model_dir=model_dir)
+
+    def factory(index, devices):
+      predictor = make_predictor()
+      assert predictor.restore()
+      return serving.BucketedEngine(predictor=predictor, max_batch_size=4,
+                                    name=f"rollout/fleet/r{index}")
+
+    probe = {"x": np.linspace(-1.0, 1.0, 9,
+                              dtype=np.float32).reshape(3, 3)}
+    fleet = serving.ServingFleet(replica_factory=factory, num_replicas=2,
+                                 max_batch_size=4, max_delay_ms=1.0,
+                                 warmup=True)
+    try:
+      assert fleet.global_step == 5
+      compiles_before = fleet.compile_counts()
+      before = fleet.predict(probe)["prediction"]
+
+      # Publish a NEW checkpoint (step 10) with deterministically
+      # different params — the "learner published" event.
+      ckpt_dir = os.path.join(model_dir, "checkpoints")
+      loader = make_predictor()
+      assert loader.restore()
+      old_state = loader._state
+      bump = lambda t: (None if t is None else jax.tree_util.tree_map(  # noqa: E731
+          lambda a: a + 0.25, t))
+      new_state = old_state.replace(step=old_state.step,
+                                    params=bump(old_state.params),
+                                    ema_params=bump(old_state.ema_params))
+      with checkpoints_lib.CheckpointManager(ckpt_dir) as manager:
+        manager.save(10, new_state, force=True)
+
+      # Continuous closed-loop load through the rollout window.
+      stop = [False]
+      failures = []
+      served = [0]
+
+      def load():
+        while not stop[0]:
+          try:
+            fleet.predict(probe)
+            served[0] += 1
+          except Exception as e:  # noqa: BLE001 - the pin: none happen
+            failures.append(e)
+
+      threads = [threading.Thread(target=load) for _ in range(2)]
+      for t in threads:
+        t.start()
+      time.sleep(0.1)
+      report = fleet.rollout(probe_request=probe)
+      stop[0] = True
+      for t in threads:
+        t.join()
+
+      # The pinned contract.
+      assert report["swapped"] == 2, report
+      assert report["aborted"] is None
+      assert report["parity_ok"] is True
+      assert report["fresh_compiles"] == 0
+      assert fleet.compile_counts() == compiles_before
+      assert not failures, failures
+      assert served[0] > 0
+      assert fleet.global_step == 10
+
+      # Post-rollout parity vs a FRESH-START fleet on the new params.
+      after = fleet.predict(probe)["prediction"]
+      assert not np.allclose(after, before), "new params not serving"
+      fresh = serving.ServingFleet(replica_factory=factory,
+                                   num_replicas=2, max_batch_size=4,
+                                   max_delay_ms=1.0, warmup=True)
+      try:
+        np.testing.assert_allclose(fresh.predict(probe)["prediction"],
+                                   after, rtol=1e-5)
+      finally:
+        fresh.close()
+    finally:
+      fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# graftlint rule: fleet-replica-unjoined.
+# ---------------------------------------------------------------------------
+
+
+class TestFleetLintRule:
+
+  def _check(self, source):
+    from tensor2robot_tpu.analysis import fleet_check
+    from tensor2robot_tpu.analysis.findings import (filter_findings,
+                                                    load_suppressions)
+
+    return filter_findings(fleet_check.check_python_source("t.py", source),
+                           load_suppressions(source))
+
+  def test_unjoined_construction_flagged(self):
+    findings = self._check(
+        "def f():\n"
+        "  fleet = ServingFleet(replica_factory=g)\n"
+        "  fleet.predict({})\n")
+    assert len(findings) == 1
+    assert findings[0].rule == "fleet-replica-unjoined"
+    assert findings[0].line == 2
+
+  def test_close_drain_with_return_self_accepted(self):
+    for source in (
+        "def f():\n  fleet = ServingFleet(replica_factory=g)\n"
+        "  try:\n    fleet.predict({})\n  finally:\n    fleet.close()\n",
+        "def f():\n  fleet = ServingFleet(replica_factory=g)\n"
+        "  fleet.drain()\n",
+        "def f():\n  with ServingFleet(replica_factory=g) as fleet:\n"
+        "    fleet.predict({})\n",
+        "def f():\n  fleet = ServingFleet(replica_factory=g)\n"
+        "  return fleet\n",
+        "def f():\n  return ServingFleet(replica_factory=g)\n",
+        "class S:\n  def __init__(self):\n"
+        "    self._fleet = ServingFleet(replica_factory=g)\n",
+    ):
+      assert not self._check(source), source
+
+  def test_nested_scopes_judged_independently(self):
+    findings = self._check(
+        "def outer():\n"
+        "  def inner():\n"
+        "    fleet = ServingFleet(replica_factory=g)\n"
+        "    fleet.predict({})\n"
+        "  fleet2 = ServingFleet(replica_factory=g)\n"
+        "  fleet2.close()\n")
+    assert len(findings) == 1 and findings[0].line == 3
+
+  def test_suppression(self):
+    source = ("def server():\n"
+              "  fleet = ServingFleet(replica_factory=g)"
+              "  # graftlint: disable=fleet-replica-unjoined\n"
+              "  fleet.predict({})\n")
+    assert not self._check(source)
+
+  def test_rule_in_catalog_and_wired(self):
+    from tensor2robot_tpu.analysis import lint
+
+    catalog = lint._RULE_CATALOG
+    assert "fleet-replica-unjoined" in catalog
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: the fleet layer is backend-free (poisoned-platform trap).
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_layer_backend_free():
+  """Routing, health eviction, session displacement, a full rollout,
+  every arrival profile and the fleet lint rule must all run without
+  initializing any JAX backend (poisoned JAX_PLATFORMS + empty backend
+  cache, the serving-suite discipline)."""
+  code = """
+import threading
+import numpy as np
+from tensor2robot_tpu import serving
+from tensor2robot_tpu.serving import loadgen
+from tensor2robot_tpu.analysis import fleet_check
+
+class Fake:
+  def __init__(self, i):
+    self.i = i; self.version = 1; self.compile_count = 0
+    self.sessions = {}; self.n = 1
+  def predict(self, f):
+    return {"out": np.asarray(f["x"]) * self.version}
+  def open(self):
+    sid = self.n; self.n += 1; self.sessions[sid] = 0; return sid
+  def step(self, sid, obs):
+    self.sessions[sid] += 1; return {"out": np.asarray(obs["x"])}
+  def close_session(self, sid): self.sessions.pop(sid, None)
+  def restore(self): self.version += 1; return True
+  def warmup(self): pass
+  @property
+  def model_version(self): return self.version
+  @property
+  def global_step(self): return self.version
+  def close(self): pass
+
+x = {"x": np.ones((1, 2), np.float32)}
+with serving.ServingFleet(replica_factory=lambda i, d: Fake(i),
+                          num_replicas=2, max_delay_ms=1.0) as fleet:
+  fleet.predict(x)
+  sids = [fleet.open() for _ in range(4)]
+  for s in sids: fleet.step(s, x)
+  fleet.mark_unhealthy(0, "trap")
+  for s in sids: fleet.step(s, x)
+  assert all(fleet.session_replica(s) == 1 for s in sids)
+  fleet.mark_healthy(0)
+  report = fleet.rollout(probe_request=x)
+  assert report["swapped"] == 2 and report["parity_ok"], report
+  for s in sids: fleet.close_session(s)
+for profile in loadgen.ARRIVAL_PROFILES:
+  gaps = loadgen.arrival_gaps(32, 100.0, profile, seed=1)
+  assert gaps.shape == (32,)
+findings = fleet_check.check_python_source(
+    "t.py", "def f():\\n  fl = ServingFleet(replica_factory=g)\\n")
+assert len(findings) == 1, findings
+from jax._src import xla_bridge
+live = getattr(xla_bridge, "_backends", None)
+assert not live, f"jax backends were initialized: {sorted(live)}"
+print("FLEET_NO_BACKEND_OK")
+"""
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "fleet_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run(
+      [sys.executable, "-c", code],
+      capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "FLEET_NO_BACKEND_OK" in result.stdout

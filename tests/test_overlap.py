@@ -134,6 +134,96 @@ class TestOverlapParity:
     _assert_batches_equal(overlapped, serial)
 
 
+class TestFusedPreprocess:
+  """ISSUE 12 satellite (ROADMAP item 6's last slice): preprocess moves
+  into the parse pool when purity is declared — byte-identical to the
+  serial-worker chain, with the auto gate keeping stateful preprocess
+  fns on the ordered single worker."""
+
+  def test_fused_byte_identical_to_serial_worker(self, corpus):
+    def pure(features, labels, mode):
+      features["doubled"] = np.asarray(features["payload"]) * 2.0
+      return features, labels
+
+    pure.stateless = True  # declared purity: the auto gate fuses
+    fused = _flat_batches(_pipe(corpus, preprocess_fn=pure,
+                                num_parallel_parses=3))
+    serial_worker = _flat_batches(_pipe(corpus, preprocess_fn=pure,
+                                        num_parallel_parses=3,
+                                        fused_preprocess=False))
+    fully_serial = _flat_batches(_pipe(corpus, preprocess_fn=pure,
+                                       overlap=False, prefetch_size=0))
+    _assert_batches_equal(fused, serial_worker)
+    _assert_batches_equal(fused, fully_serial)
+
+  def test_auto_gate_on_declared_purity_only(self, corpus):
+    from tensor2robot_tpu.preprocessors import base as preprocessors_base
+
+    # Bound AbstractPreprocessor.preprocess: pure by contract -> fused.
+    patterns, spec = corpus
+    pre = preprocessors_base.NoOpPreprocessor(
+        model_feature_specification_fn=lambda mode: spec,
+        model_label_specification_fn=lambda mode: SpecStruct())
+    bound = _pipe(corpus, preprocess_fn=pre.preprocess)
+    assert bound._fuse_preprocess_enabled() is True
+    # Bare callable: may close over cross-batch state -> serial worker.
+    bare = _pipe(corpus, preprocess_fn=lambda f, l, m: (f, l))
+    assert bare._fuse_preprocess_enabled() is False
+    # Declared stateless attribute -> fused; explicit override wins.
+    fn = lambda f, l, m: (f, l)  # noqa: E731
+    fn.stateless = True
+    declared = _pipe(corpus, preprocess_fn=fn)
+    assert declared._fuse_preprocess_enabled() is True
+    forced_off = _pipe(corpus, preprocess_fn=fn, fused_preprocess=False)
+    assert forced_off._fuse_preprocess_enabled() is False
+    # No preprocess at all: trivially pure.
+    assert _pipe(corpus)._fuse_preprocess_enabled() is True
+
+  def test_stateful_preprocess_keeps_stream_order_under_auto(self, corpus):
+    """The auto gate must leave a stateful bare callable on the single
+    ordered worker — the same stamps the serial chain produces even
+    with a 3-thread parse pool racing ahead."""
+
+    def make_stateful():
+      counter = [0]
+
+      def preprocess(features, labels, mode):
+        features["order"] = np.full((len(features["idx"]),),
+                                    counter[0], np.int64)
+        counter[0] += 1
+        return features, labels
+
+      return preprocess
+
+    auto = _flat_batches(_pipe(corpus, preprocess_fn=make_stateful(),
+                               num_parallel_parses=3))
+    serial = _flat_batches(_pipe(corpus, preprocess_fn=make_stateful(),
+                                 overlap=False, prefetch_size=0,
+                                 num_parallel_parses=1))
+    _assert_batches_equal(auto, serial)
+
+  def test_fused_mode_records_stage_telemetry(self, corpus):
+    def pure(features, labels, mode):
+      return features, labels
+
+    pure.stateless = True
+    with metrics_lib.isolated() as registry:
+      batches = _flat_batches(_pipe(corpus, preprocess_fn=pure))
+      snap = registry.snapshot()
+    assert batches
+    # Per-stage attribution survives fusion: parse AND preprocess
+    # histograms both populated.
+    assert snap.get("hist/data/overlap_parse_ms/count", 0.0) > 0.0
+    assert snap.get("hist/data/overlap_preprocess_ms/count", 0.0) > 0.0
+
+  def test_generator_seam_carries_fused_knob(self, corpus):
+    patterns, spec = corpus
+    generator = input_generators.DefaultRecordInputGenerator(
+        file_patterns=patterns, batch_size=BATCH)
+    generator.set_overlap_options(fused_preprocess=False)
+    assert generator._overlap_options["fused_preprocess"] is False
+
+
 class TestOverlapTeardown:
   """ISSUE 9 satellite: close() joins every stage with zero leaked
   threads; errors propagate; abandoned loaders are backstopped."""
