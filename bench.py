@@ -62,6 +62,18 @@ load, plus a zero-downtime rollout window (`fleet_vs_single_replica`
 + `fleet_rollout_shed` diff-gated via `scripts/fleet_bench.sh`;
 PERFORMANCE.md "Reading a fleet bench").
 
+graftguard chaos (ISSUE 13): `bench.py --chaos` runs a SEEDED fault
+storm (`obs.faultlab`) across the data, train, and serving planes over
+a live fleet + trainer — corrupt records skipped under quota, NaN
+divergence rewound from the newest VERIFIED checkpoint (numerical
+parity with a clean resume pinned), bit-flipped checkpoints
+quarantined, injected dispatch failures evicted + probation-readmitted
+with zero client-visible failures — headlining `chaos_goodput_ratio`
+(paired faulted/clean serving goodput) and `chaos_recovery_ms` (worst
+per-fault-class MTTR), diff-gated via `scripts/chaos_bench.sh`
+(PERFORMANCE.md "Reading a chaos bench"); an unrecovered fault class
+exits 3.
+
 graftcache (PR 7): every probe routes trace->compile through the
 persistent executable cache at GRAFTCACHE_DIR (default `.graftcache`),
 so re-benching an unchanged config deserializes instead of recompiling;
@@ -156,6 +168,15 @@ def _acquire_bench_lock() -> bool:
     return not _bench_lock_contended
   except Exception:  # noqa: BLE001 - a guard, never a blocker
     return True
+
+
+def _median(vals):
+  """Upper median (sorted[n // 2]) — the one median every paired A/B
+  family reports. For even counts this is the LARGER middle value,
+  which flatters a down-bad ratio gate — prefer odd pair counts where
+  that matters."""
+  vals = sorted(vals)
+  return vals[len(vals) // 2]
 
 
 def _host_load_block() -> dict:
@@ -1545,11 +1566,10 @@ def session_main() -> None:
       print(f"bench-session: T={seq_len} pair {pair}: stateless "
             f"{s_ms:.2f} ms/tick, cached {c_ms:.2f} ms/tick "
             f"({ratios[-1]:.2f}x)", file=sys.stderr)
-    med = lambda vals: sorted(vals)[len(vals) // 2]  # noqa: E731
     per_t[seq_len] = {
-        "stateless_tick_ms": round(med(stateless_ms), 3),
-        "decode_tick_ms": round(med(cached_ms), 3),
-        "session_vs_stateless": round(med(ratios), 3),
+        "stateless_tick_ms": round(_median(stateless_ms), 3),
+        "decode_tick_ms": round(_median(cached_ms), 3),
+        "session_vs_stateless": round(_median(ratios), 3),
         "pairs": SESSION_PAIRS,
     }
 
@@ -1834,6 +1854,35 @@ class _DeviceWaitEngine:
     return getattr(self._engine, name)
 
 
+def _make_fleet_bench_replica(index: int, group, name_prefix: str,
+                              hot_swap: bool = False) -> _DeviceWaitEngine:
+  """The ONE replica factory both fleet arms (`--fleet`) and the chaos
+  storm's serving plane (`--chaos`) build on — the storm must measure
+  the SAME serving shape the fleet bench prices, so the setup lives in
+  one place: flagship critic + randomly-initialized CheckpointPredictor
+  committed to the group's lead device behind a BucketedEngine, wearing
+  the emulated device wall. `hot_swap` adds the `_HotSwapPredictor`
+  wrapper the fleet bench's rollout() leg swaps through."""
+  import jax
+
+  from tensor2robot_tpu import serving
+  from tensor2robot_tpu.predictors import predictors as predictors_lib
+  from tensor2robot_tpu.research.qtopt import flagship
+
+  model = flagship.make_flagship_model(jax.devices()[0].platform)
+  predictor = predictors_lib.CheckpointPredictor(model=model,
+                                                 model_dir="/nonexistent")
+  predictor.init_randomly()  # same seed per replica: identical params
+  if group:
+    predictor.place_on_device(group[0])
+  if hot_swap:
+    predictor = _HotSwapPredictor(predictor)
+  engine = serving.BucketedEngine(predictor=predictor,
+                                  max_batch_size=FLEET_MAX_BATCH,
+                                  name=f"{name_prefix}/replica{index}")
+  return _DeviceWaitEngine(engine, FLEET_DEVICE_WAIT_MS)
+
+
 def fleet_main() -> None:
   """Fleet-serving bench: ONE JSON headline line (CPU smoke path).
 
@@ -1886,26 +1935,16 @@ def fleet_main() -> None:
 
   from tensor2robot_tpu import serving, specs as specs_lib
   from tensor2robot_tpu.parallel import mesh as mesh_lib
-  from tensor2robot_tpu.predictors import predictors as predictors_lib
-  from tensor2robot_tpu.research.qtopt import flagship
   from tensor2robot_tpu.serving import engine as engine_lib
   from tensor2robot_tpu.serving import loadgen
 
   devices = jax.devices()
-  device = devices[0]
+  device = devices[0]  # headline record's device_kind/platform
   groups = mesh_lib.replica_device_groups(FLEET_REPLICAS, devices)
 
   def make_replica(index: int, group) -> _DeviceWaitEngine:
-    model = flagship.make_flagship_model(device.platform)
-    predictor = predictors_lib.CheckpointPredictor(model=model,
-                                                   model_dir="/nonexistent")
-    predictor.init_randomly()  # same seed per replica: identical params
-    if group:
-      predictor.place_on_device(group[0])
-    engine = serving.BucketedEngine(predictor=_HotSwapPredictor(predictor),
-                                    max_batch_size=FLEET_MAX_BATCH,
-                                    name=f"serve/fleet/replica{index}")
-    return _DeviceWaitEngine(engine, FLEET_DEVICE_WAIT_MS)
+    return _make_fleet_bench_replica(index, group, "serve/fleet",
+                                     hot_swap=True)
 
   print(f"bench-fleet: warming 1-replica + {FLEET_REPLICAS}-replica "
         "fleets (shared bucket ladder)", file=sys.stderr)
@@ -1978,10 +2017,9 @@ def fleet_main() -> None:
             f"fleet {d_qps:.0f} req/s ({pairs[-1]['ratio']:.2f}x)",
             file=sys.stderr)
       exec_fallbacks += s_res["exec_fallbacks"] + d_res["exec_fallbacks"]
-    med = lambda vals: sorted(vals)[len(vals) // 2]  # noqa: E731
-    ratio = med([p["ratio"] for p in pairs])
-    fleet_qps = med([p["fleet_qps"] for p in pairs])
-    single_qps = med([p["single_qps"] for p in pairs])
+    ratio = _median([p["ratio"] for p in pairs])
+    fleet_qps = _median([p["fleet_qps"] for p in pairs])
+    single_qps = _median([p["single_qps"] for p in pairs])
 
     # Zero-downtime rollout window: continuous open-loop load at a rate
     # ONE replica can absorb (the pin is no failures while capacity is
@@ -2082,6 +2120,386 @@ def fleet_main() -> None:
     duo.close()
 
 
+# Chaos bench config (bench.py --chaos): one seed drives every fault
+# decision, so a chaos run is reproducible fault-for-fault.
+CHAOS_SEED = 13
+CHAOS_TRAIN_STEPS = 40
+CHAOS_CKPT_EVERY = 10
+# Log-fetch arrival index of the injected NaN (log every step): fires
+# at step 25 — AFTER the step-20 save (which ckpt.bitflip corrupts), so
+# the rewind must detect the corruption and fall back to step 10.
+CHAOS_NONFINITE_AT = 24
+CHAOS_DATA_BATCHES = 40
+CHAOS_DATA_BATCH = 32
+CHAOS_ARRIVALS = 400
+CHAOS_RATE_HZ = 600.0
+CHAOS_CLIENTS = 64
+# Odd on purpose: `_median` is the upper median, and an even pair
+# count would let the gated down-bad goodput ratio report the BETTER
+# of two pairs (hiding a one-pair recovery regression).
+CHAOS_PAIRS = 3
+
+
+def chaos_main() -> None:
+  """graftguard chaos bench: ONE JSON headline line (CPU smoke path).
+
+  A SEEDED fault storm over all three planes, measuring that every
+  injected fault class RECOVERS (the ISSUE 13 acceptance) and what the
+  recovery costs:
+
+  * **data plane** — a record pipeline under injected corrupt-record
+    bytes, a preprocess exception and a mid-epoch source I/O error,
+    with the graftguard skip quota armed: the pass must complete with
+    the faults counted-and-skipped, zero raises.
+  * **train plane** — a mock-model trainer with a NaN loss injected at
+    step 25 and the step-20 checkpoint bit-flipped at save: sentinel
+    fatal incident -> flight-recorder bundle -> divergence REWIND,
+    which must detect the corrupt step-20 checkpoint (manifest
+    checksum), quarantine it, and restore step 10. The run must finish
+    all steps, and a CLEAN run resumed from the same verified
+    checkpoint must reach NUMERICAL PARITY with the rewound run's
+    final params (the rewind restores training, not just liveness —
+    both consume the deterministic mock stream from the top).
+  * **serving plane** — paired clean/faulted open-loop arms over a
+    live 2-replica fleet (real engines, emulated device wall, the
+    --fleet design): the faulted arm injects a 6-arrival dispatch
+    failure burst on replica 1 (6, not unhealthy_after=3: a success
+    completing between two failure recordings legitimately resets the
+    streak) plus latency spikes; the
+    fleet must FAIL OVER every faulted request (zero client-visible
+    failures), evict, and the probation loop must AUTO-READMIT.
+
+  Headline gates (`scripts/chaos_bench.sh`, diff-gated like every
+  bench family): `chaos_goodput_ratio` — pair-median faulted/clean
+  serving goodput (down-bad; load-invariant by pairing) — and
+  `chaos_recovery_ms` — the worst per-fault-class recovery wall time
+  (probation readmit, divergence rewind; up-bad, loose wall-clock
+  band). `all_recovered` false exits 3: an unrecovered fault class is
+  an acceptance failure, not a diff question.
+  """
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  backend_lib.pin_cpu()
+  backend_lib.assert_cpu_backend()
+  import shutil
+  import threading
+
+  import numpy as np
+
+  from tensor2robot_tpu import checkpoints as checkpoints_lib
+  from tensor2robot_tpu import train_eval
+  from tensor2robot_tpu.data import pipeline as pipeline_lib
+  from tensor2robot_tpu.obs import faultlab
+  from tensor2robot_tpu.utils import mocks
+
+  recovered: dict = {}
+  mttr_ms: dict = {}
+
+  # ---- data plane -------------------------------------------------------
+  print("bench-chaos: data plane (corrupt records under quota)",
+        file=sys.stderr)
+  data_root = tempfile.mkdtemp(prefix="chaos-data-")
+  try:
+    patterns, parse_fn = _make_data_bench_dataset(data_root)
+    data_plan = faultlab.FaultPlan([
+        faultlab.FaultSpec(point=faultlab.DATA_CORRUPT_RECORD, every=10,
+                           count=3),
+        faultlab.FaultSpec(point=faultlab.DATA_PREPROCESS, at=(15,),
+                           count=1),
+        faultlab.FaultSpec(point=faultlab.DATA_RECORD_IO, at=(30,),
+                           count=1),
+    ], seed=CHAOS_SEED)
+    pipe = pipeline_lib.RecordBatchPipeline(
+        patterns, parse_fn, batch_size=CHAOS_DATA_BATCH, mode="train",
+        shuffle_buffer_size=128, seed=CHAOS_SEED, prefetch_size=2,
+        num_parallel_parses=2,
+        max_corrupt_records=16 * CHAOS_DATA_BATCH)
+    with data_plan.activated(), obs_metrics.isolated() as registry:
+      stream = iter(pipe)
+      consumed = 0
+      t0 = time.perf_counter()
+      for _ in range(CHAOS_DATA_BATCHES):
+        next(stream)
+        consumed += 1
+      data_wall_s = time.perf_counter() - t0
+      if hasattr(stream, "close"):
+        stream.close()
+      snap = registry.snapshot(prefix="data/")
+    data_block = {
+        "batches_consumed": consumed,
+        "wall_sec": round(data_wall_s, 3),
+        "records_skipped": snap.get("counter/data/corrupt_records_skipped",
+                                    0.0),
+        "batches_skipped": snap.get("counter/data/corrupt_batches_skipped",
+                                    0.0),
+        "source_io_errors": snap.get("counter/data/source_io_errors", 0.0),
+        "injected": data_plan.summary(),
+    }
+    recovered["data"] = (consumed == CHAOS_DATA_BATCHES
+                         and data_block["batches_skipped"] > 0
+                         and data_block["source_io_errors"] > 0)
+  finally:
+    shutil.rmtree(data_root, ignore_errors=True)
+  print(f"bench-chaos: data plane consumed {data_block['batches_consumed']}"
+        f" batches, skipped {data_block['records_skipped']:.0f} records, "
+        f"{data_block['source_io_errors']:.0f} source I/O error(s)",
+        file=sys.stderr)
+
+  # ---- train plane ------------------------------------------------------
+  print("bench-chaos: train plane (NaN divergence + bit-flipped "
+        "checkpoint -> rewind)", file=sys.stderr)
+  train_root = tempfile.mkdtemp(prefix="chaos-train-")
+  try:
+    dir_chaos = os.path.join(train_root, "chaos")
+    dir_clean = os.path.join(train_root, "clean")
+    trainer_kwargs = dict(
+        mode="train", max_train_steps=CHAOS_TRAIN_STEPS,
+        checkpoint_every_n_steps=CHAOS_CKPT_EVERY,
+        log_every_n_steps=1, executable_cache_dir=None)
+    train_plan = faultlab.FaultPlan([
+        faultlab.FaultSpec(point=faultlab.TRAIN_NONFINITE,
+                           at=(CHAOS_NONFINITE_AT,), count=1),
+        faultlab.FaultSpec(point=faultlab.CKPT_BITFLIP, at=(1,), count=1),
+    ], seed=CHAOS_SEED)
+    with train_plan.activated():
+      train_eval.train_eval_model(
+          model=mocks.MockT2RModel(device_type="cpu"),
+          model_dir=dir_chaos,
+          input_generator_train=mocks.MockInputGenerator(batch_size=8),
+          **trainer_kwargs)
+    from tensor2robot_tpu.obs import runlog as runlog_lib
+
+    chaos_rec = [r for r in runlog_lib.load_records(
+        os.path.join(dir_chaos, "runs.jsonl"))
+        if r.get("kind") == "train"][-1]
+    guard = (chaos_rec.get("extra") or {}).get("graftguard") or {}
+    rewinds = int(guard.get("rewinds", 0))
+    rewind_steps = guard.get("rewind_steps") or []
+    train_snapshot = obs_metrics.snapshot(prefix="train/")
+    rewind_ms = train_snapshot.get("hist/train/rewind_ms/max")
+    quarantine_dir = os.path.join(dir_chaos, "checkpoints",
+                                  checkpoints_lib.QUARANTINE_DIRNAME)
+    quarantined = (sorted(os.listdir(quarantine_dir))
+                   if os.path.isdir(quarantine_dir) else [])
+
+    # Numerical-parity pin: a clean run resumed from the SAME verified
+    # checkpoint the rewind restored must reach the same final params.
+    parity_ok = None
+    param_max_abs_diff = None
+    if rewinds and rewind_steps:
+      target = int(rewind_steps[0])
+      os.makedirs(os.path.join(dir_clean, "checkpoints"), exist_ok=True)
+      shutil.copytree(
+          os.path.join(dir_chaos, "checkpoints", str(target)),
+          os.path.join(dir_clean, "checkpoints", str(target)))
+      train_eval.train_eval_model(
+          model=mocks.MockT2RModel(device_type="cpu"),
+          model_dir=dir_clean,
+          input_generator_train=mocks.MockInputGenerator(batch_size=8),
+          **trainer_kwargs)
+
+      def _final_params(model_dir):
+        with checkpoints_lib.CheckpointManager(
+            os.path.join(model_dir, "checkpoints")) as manager:
+          restored = manager.restore()
+          assert manager.last_restored_step == CHAOS_TRAIN_STEPS, (
+              manager.last_restored_step)
+          return restored["params"] if "params" in restored else restored
+
+      import jax
+
+      params_chaos = _final_params(dir_chaos)
+      params_clean = _final_params(dir_clean)
+      diffs = jax.tree_util.tree_map(
+          lambda a, b: float(np.max(np.abs(np.asarray(a, np.float64)
+                                           - np.asarray(b, np.float64)))),
+          params_chaos, params_clean)
+      param_max_abs_diff = max(jax.tree_util.tree_leaves(diffs))
+      parity_ok = param_max_abs_diff <= 1e-6
+    train_block = {
+        "steps": CHAOS_TRAIN_STEPS,
+        "rewinds": rewinds,
+        "rewind_steps": rewind_steps,
+        "rewind_ms": rewind_ms,
+        "quarantined_steps": quarantined,
+        "parity_ok": parity_ok,
+        "param_max_abs_diff": param_max_abs_diff,
+        "injected": train_plan.summary(),
+        "final_step": (chaos_rec.get("extra") or {}).get("final_step"),
+    }
+    recovered["train"] = bool(
+        rewinds == 1 and quarantined and parity_ok
+        and train_block["final_step"] == CHAOS_TRAIN_STEPS)
+    if rewind_ms is not None:
+      mttr_ms["divergence_rewind"] = round(float(rewind_ms), 1)
+  finally:
+    shutil.rmtree(train_root, ignore_errors=True)
+  print(f"bench-chaos: train plane rewinds={train_block['rewinds']} "
+        f"(targets {train_block['rewind_steps']}), quarantined "
+        f"{train_block['quarantined_steps']}, parity_ok="
+        f"{train_block['parity_ok']}", file=sys.stderr)
+
+  # ---- serving plane ----------------------------------------------------
+  print("bench-chaos: serving plane (dispatch-failure burst -> eviction "
+        "-> probation readmit)", file=sys.stderr)
+  import jax
+
+  from tensor2robot_tpu import serving, specs as specs_lib
+  from tensor2robot_tpu.parallel import mesh as mesh_lib
+  from tensor2robot_tpu.serving import loadgen
+
+  devices = jax.devices()
+  device = devices[0]  # headline record's device_kind/platform
+  groups = mesh_lib.replica_device_groups(FLEET_REPLICAS, devices)
+
+  request_holder: list = []
+  fleet = serving.ServingFleet(
+      replica_factory=lambda i, d: _make_fleet_bench_replica(
+          i, groups[i], "serve/chaos"),
+      num_replicas=FLEET_REPLICAS, max_batch_size=FLEET_MAX_BATCH,
+      max_delay_ms=2.0, max_queue=32, warmup=True,
+      probation_probe=lambda: request_holder[0])
+  try:
+    request = dict(specs_lib.make_random_numpy(
+        fleet.replica(0).get_feature_specification(), batch_size=1,
+        seed=0).items())
+    request_holder.append(request)
+    make_request = lambda i: request  # noqa: E731 - read-only shared dict
+
+    def run_arm(faulted: bool, seed: int) -> dict:
+      plan = None
+      if faulted:
+        plan = faultlab.activate(faultlab.FaultPlan([
+            # A burst of consecutive dispatch failures on replica 1
+            # (>= the default unhealthy_after=3; 6 because a success
+            # COMPLETING between two failure recordings under
+            # concurrent load legitimately resets the streak) =>
+            # eviction mid-window; failover must absorb every one.
+            # Latency spikes ride along.
+            faultlab.FaultSpec(point=faultlab.SERVE_DISPATCH, key=1,
+                               at=tuple(range(40, 46)), count=6),
+            faultlab.FaultSpec(point=faultlab.SERVE_LATENCY, every=50,
+                               arg=30.0),
+        ], seed=CHAOS_SEED + seed))
+      try:
+        result = loadgen.run_trace_load(
+            predict=fleet.predict, make_request=make_request,
+            num_arrivals=CHAOS_ARRIVALS, rate_hz=CHAOS_RATE_HZ,
+            profile="poisson", seed=seed,
+            max_client_threads=CHAOS_CLIENTS)
+      finally:
+        if plan is not None:
+          faultlab.deactivate()
+      # Sheds are ADMISSION refusals (bounded queues doing their job
+      # under injected latency spikes — backpressure, not a recovery
+      # failure); everything else is a client-visible failure the
+      # failover machinery should have absorbed.
+      result["shed"] = int(sum(count for name, count
+                               in result["errors"].items()
+                               if "Shed" in name))
+      result["failed"] = int(sum(result["errors"].values())
+                             ) - result["shed"]
+      result["injected"] = plan.summary() if plan is not None else None
+      # Self-heal barrier between arms: the probation loop must have
+      # readmitted every evicted replica before the next arm measures.
+      deadline = time.monotonic() + 10.0
+      while (len(fleet.healthy_replicas()) < FLEET_REPLICAS
+             and time.monotonic() < deadline):
+        time.sleep(0.01)
+      result["healthy_after"] = len(fleet.healthy_replicas())
+      return result
+
+    pairs = []
+    serve_injected: list = []
+    for pair in range(CHAOS_PAIRS):
+      if pair % 2 == 0:
+        clean = run_arm(False, seed=pair)
+        faulted = run_arm(True, seed=pair)
+      else:
+        faulted = run_arm(True, seed=pair)
+        clean = run_arm(False, seed=pair)
+      serve_injected.append(faulted["injected"])
+      clean_qps = clean["ok_requests"] / clean["wall_sec"]
+      faulted_qps = faulted["ok_requests"] / faulted["wall_sec"]
+      pairs.append({
+          "clean_qps": round(clean_qps, 1),
+          "faulted_qps": round(faulted_qps, 1),
+          "ratio": round(faulted_qps / clean_qps if clean_qps
+                         else float("inf"), 3),
+          "faulted_failed": faulted["failed"],
+          "faulted_shed": faulted["shed"],
+          "clean_failed": clean["failed"],
+          "healthy_after": faulted["healthy_after"],
+      })
+      print(f"bench-chaos: pair {pair}: clean {clean_qps:.0f} req/s, "
+            f"faulted {faulted_qps:.0f} req/s "
+            f"({pairs[-1]['ratio']:.2f}x), faulted_failed="
+            f"{faulted['failed']}, healthy_after="
+            f"{faulted['healthy_after']}", file=sys.stderr)
+    goodput_ratio = _median([p["ratio"] for p in pairs])
+    serve_snap = obs_metrics.snapshot(prefix="serve/fleet/")
+    readmit_max = serve_snap.get("hist/serve/fleet/readmit_ms/max")
+    if readmit_max is not None:
+      mttr_ms["replica_unhealthy"] = round(float(readmit_max), 1)
+    evictions = serve_snap.get("counter/serve/fleet/unhealthy", 0.0)
+    readmits = serve_snap.get("counter/serve/fleet/probation_readmits",
+                              0.0)
+    serve_block = {
+        "pairs": pairs,
+        "evictions": evictions,
+        "probation_readmits": readmits,
+        "probation_probes": serve_snap.get(
+            "counter/serve/fleet/probation_probes", 0.0),
+        "faulted_failed_total": sum(p["faulted_failed"] for p in pairs),
+        "faulted_shed_total": sum(p["faulted_shed"] for p in pairs),
+        "injected": serve_injected,
+        "open_loop": {"profile": "poisson", "rate_hz": CHAOS_RATE_HZ,
+                      "arrivals_per_arm": CHAOS_ARRIVALS},
+        "emulated_device_wait_ms": FLEET_DEVICE_WAIT_MS,
+    }
+    # Recovered: the burst evicted at least one replica, every eviction
+    # was probation-readmitted, both replicas were healthy at the end
+    # of every faulted arm, and no client saw a non-backpressure
+    # failure (failover absorbed every injected dispatch fault).
+    recovered["serve"] = bool(
+        evictions >= 1 and readmits >= evictions
+        and all(p["healthy_after"] == FLEET_REPLICAS for p in pairs)
+        and serve_block["faulted_failed_total"] == 0)
+  finally:
+    fleet.close()
+
+  # ---- headline ---------------------------------------------------------
+  all_recovered = bool(recovered and all(recovered.values()))
+  chaos_recovery_ms = max(mttr_ms.values()) if mttr_ms else None
+  headline = {
+      "metric": "qtopt_chaos_cpu_smoke",
+      "value": goodput_ratio,
+      "unit": "faulted/clean goodput ratio",
+      "chaos_goodput_ratio": goodput_ratio,
+      "chaos_recovery_ms": chaos_recovery_ms,
+      "all_recovered": all_recovered,
+      "recovered_by_plane": recovered,
+      "mttr_ms": mttr_ms,
+      "seed": CHAOS_SEED,
+      "data": data_block,
+      "train": train_block,
+      "serve": serve_block,
+      "device_kind": device.device_kind,
+      "platform": device.platform,
+      "host_load": _host_load_block(),
+      "graftscope": _graftscope_block(),
+  }
+  print(json.dumps(headline))
+  _write_runlog(headline, platform=device.platform,
+                device_kind=device.device_kind)
+  if not all_recovered:
+    print("bench-chaos: ACCEPTANCE FAILURE — not every fault class "
+          f"recovered: {recovered}", file=sys.stderr)
+    sys.exit(3)
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
@@ -2101,6 +2519,9 @@ def main() -> None:
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--fleet":
     fleet_main()
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
+    chaos_main()
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--data":
     data_main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# graftguard chaos bench + regression gate (ISSUE 13).
+#
+# `bench.py --chaos` runs the seeded fault storm over the data, train,
+# and serving planes (qtopt_chaos_cpu_smoke, PERFORMANCE.md "Reading a
+# chaos bench") and EXITS 3 ITSELF when any injected fault class fails
+# to recover — the acceptance gate is the bench's own exit code, the
+# diff below prices round-over-round drift on top of it:
+#
+#   chaos_goodput_ratio — pair-median faulted/clean serving goodput
+#                         under the storm (down-bad 15%; back-to-back
+#                         pairs make it load-invariant),
+#   chaos_recovery_ms   — worst per-fault-class recovery wall time
+#                         (probation readmit / divergence rewind;
+#                         up-bad 50% — wall-clock on the 1-core host,
+#                         same loose band as warmup_ms).
+#
+# A regression in either exits non-zero exactly like a training one.
+#
+# Usage: scripts/chaos_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# The index lookup runs OUTSIDE a process substitution so a failure
+# (unreadable runs.jsonl, broken import) fails the script loudly
+# instead of reading as "no baseline" and silently skipping the gate.
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+  ) || { echo "chaos_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "chaos_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
+
+# The bench itself exit-code-gates recovery (3 = a fault class did not
+# recover); set -e propagates it before any diff runs.
+JAX_PLATFORMS=cpu python bench.py --chaos
+
+# The chaos family gates on its two purpose-built metrics; every other
+# wall-clock in the record swings with host load on this VM, so those
+# absolute thresholds are opened wide rather than training people to
+# ignore a flappy gate.
+gate_family qtopt_chaos \
+    --threshold examples_per_sec=10.0 --threshold compile_time_s=10.0 \
+    --threshold flops_per_step=10.0 --threshold bytes_per_step=10.0 \
+    --threshold jaxpr_eqns=10.0 --threshold warmup_ms=10.0

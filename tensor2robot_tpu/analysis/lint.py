@@ -29,8 +29,9 @@ from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
                                        fleet_check, native_check, pp_check,
-                                       session_check, spec_check,
-                                       thread_check, tracer_check)
+                                       retry_check, session_check,
+                                       spec_check, thread_check,
+                                       tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -88,6 +89,16 @@ session rules (.py):
                          session_state/arena value, which re-buys the
                          stateless per-tick cost (and ~1.5 s per eager
                          fetch over the tunnel)
+
+retry rules (.py, serving//data/ hot paths only):
+  bare-retry-rule        a for/while loop containing BOTH a constant
+                         `time.sleep(<literal>)` AND a broad
+                         except-swallow (bare `except:` or
+                         `except (Base)Exception:` with a pass/continue
+                         body) — a hand-rolled retry with no jitter,
+                         deadline budget, or telemetry; migrate to
+                         `utils.retry.RetryPolicy` or suppress with
+                         justification
 
 fleet rules (.py):
   fleet-replica-unjoined a `ServingFleet(...)` construction site whose
@@ -166,6 +177,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(pp_check.check_python_file(path))
     findings.extend(session_check.check_python_file(path))
     findings.extend(fleet_check.check_python_file(path))
+    findings.extend(retry_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked

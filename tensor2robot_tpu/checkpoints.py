@@ -13,22 +13,212 @@ continuous eval and the retrying backup-copy logic
 * `checkpoints_iterator` polls a model_dir for new steps (continuous
   eval); `backup_checkpoint` hardlink-copies a checkpoint so a concurrent
   GC cannot delete it mid-eval.
+
+graftguard checkpoint integrity (the recovery floor under divergence
+rewind and fleet rollout): every completed save gets a checksummed
+MANIFEST sidecar (`manifests/<step>.json`: per-file size + crc32,
+written from the bytes on disk once the async save commits), restores
+VERIFY against it, and a corrupt step — torn/truncated (restore
+raises) or silently bit-flipped (checksum mismatch) — is QUARANTINED
+(moved to `quarantine/<step>`, counted `ckpt/quarantined`) with
+automatic fallback to the newest verified step instead of raising out
+of `restore(step=None)`. Polling and backup-copy retries run under the
+shared `utils.retry.RetryPolicy` (jittered backoff + telemetry)
+instead of the previous bespoke constant-sleep loops. The
+`obs.faultlab` points `ckpt.torn` / `ckpt.bitflip` corrupt a
+just-saved step AFTER its manifest is written from the good bytes, so
+chaos runs exercise exactly the detection the manifest exists for.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import shutil
 import time
-from typing import Any, Iterator, Optional, Sequence
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import jax
 import orbax.checkpoint as ocp
 
+from tensor2robot_tpu.obs import faultlab as faultlab_lib
+from tensor2robot_tpu.obs import metrics as metrics_lib
 from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils import retry as retry_lib
 
-__all__ = ["CheckpointManager", "checkpoints_iterator", "backup_checkpoint",
-           "latest_step"]
+__all__ = ["CheckpointManager", "CheckpointCorruptionError",
+           "checkpoints_iterator", "backup_checkpoint", "latest_step",
+           "write_manifest", "verify_step_files", "quarantine_step",
+           "MANIFEST_DIRNAME", "QUARANTINE_DIRNAME"]
+
+MANIFEST_DIRNAME = "manifests"
+QUARANTINE_DIRNAME = "quarantine"
+MANIFEST_SCHEMA = "graftguard-manifest-v1"
+
+
+class CheckpointCorruptionError(RuntimeError):
+  """A checkpoint failed integrity verification (or restore) and no
+  intact fallback step exists."""
+
+
+def _manifest_path(directory: str, step: int) -> str:
+  return os.path.join(directory, MANIFEST_DIRNAME, f"{int(step)}.json")
+
+
+def _step_files(step_dir: str) -> List[str]:
+  """Relative paths of every file under a step dir, sorted."""
+  out: List[str] = []
+  for dirpath, dirnames, filenames in os.walk(step_dir):
+    dirnames.sort()
+    for name in sorted(filenames):
+      out.append(os.path.relpath(os.path.join(dirpath, name), step_dir))
+  return out
+
+
+def _file_crc32(path: str) -> int:
+  crc = 0
+  with open(path, "rb") as f:
+    for chunk in iter(lambda: f.read(1 << 20), b""):
+      crc = zlib.crc32(chunk, crc)
+  return crc & 0xFFFFFFFF
+
+
+def write_manifest(directory: str, step: int) -> str:
+  """Writes the checksummed manifest sidecar for one COMPLETE step dir
+  (atomic tmp+rename; the sidecar lives OUTSIDE the step dir so orbax
+  never sees an item it does not own). Returns the manifest path."""
+  step_dir = os.path.join(directory, str(int(step)))
+  files: Dict[str, Dict[str, int]] = {}
+  for rel in _step_files(step_dir):
+    path = os.path.join(step_dir, rel)
+    files[rel] = {"size": os.path.getsize(path), "crc32": _file_crc32(path)}
+  manifest = {"schema": MANIFEST_SCHEMA, "schema_version": 1,
+              "step": int(step), "files": files}
+  path = _manifest_path(directory, step)
+  os.makedirs(os.path.dirname(path), exist_ok=True)
+  tmp = path + ".tmp"
+  with open(tmp, "w") as f:
+    json.dump(manifest, f, sort_keys=True)
+    f.flush()
+    os.fsync(f.fileno())
+  os.replace(tmp, path)
+  metrics_lib.counter("ckpt/manifests_written").inc()
+  return path
+
+
+def verify_step_files(directory: str, step: int) -> Optional[bool]:
+  """Verifies a step dir against its manifest: True (every listed file
+  present with matching size+crc32), False (mismatch/missing — counted
+  `ckpt/verify_failures`), or None (no readable manifest: pre-manifest
+  checkpoints stay restorable, integrity enforced by the restore
+  try/except instead)."""
+  path = _manifest_path(directory, step)
+  try:
+    with open(path) as f:
+      manifest = json.load(f)
+    listed = manifest["files"]
+  except (OSError, ValueError, KeyError, TypeError):
+    return None
+  step_dir = os.path.join(directory, str(int(step)))
+  for rel, meta in listed.items():
+    full = os.path.join(step_dir, rel)
+    try:
+      if os.path.getsize(full) != int(meta["size"]):
+        metrics_lib.counter("ckpt/verify_failures").inc()
+        return False
+      if _file_crc32(full) != int(meta["crc32"]):
+        metrics_lib.counter("ckpt/verify_failures").inc()
+        return False
+    except OSError:
+      metrics_lib.counter("ckpt/verify_failures").inc()
+      return False
+  return True
+
+
+def quarantine_step(directory: str, step: int, reason: str) -> Optional[str]:
+  """Moves a corrupt step (and its manifest) to `quarantine/<step>` so
+  no later `latest_step`/restore ever considers it again; counted
+  `ckpt/quarantined`. Returns the quarantine path (None on failure —
+  quarantining is best-effort, the fallback walk skips the step either
+  way)."""
+  from absl import logging
+
+  step_dir = os.path.join(directory, str(int(step)))
+  qdir = os.path.join(directory, QUARANTINE_DIRNAME)
+  dst = os.path.join(qdir, str(int(step)))
+  try:
+    os.makedirs(qdir, exist_ok=True)
+    if os.path.isdir(dst):  # a previous quarantine of the same step
+      dst = f"{dst}.{int(time.time())}"
+    shutil.move(step_dir, dst)
+  except OSError:
+    logging.exception("graftguard: quarantining checkpoint step %d failed",
+                      step)
+    return None
+  manifest = _manifest_path(directory, step)
+  if os.path.isfile(manifest):
+    try:
+      shutil.move(manifest, os.path.join(dst, "graftguard.manifest.json"))
+    except OSError:
+      pass
+  metrics_lib.counter("ckpt/quarantined").inc()
+  logging.warning("graftguard: checkpoint step %d QUARANTINED (%s) -> %s",
+                  step, reason, dst)
+  return dst
+
+
+def _corrupt_step_for_faultlab(directory: str, step: int, mode: str) -> None:
+  """Enacts a ckpt.torn / ckpt.bitflip fault on the LARGEST file of a
+  completed step dir (deterministic target; called only by `save` after
+  the manifest captured the good bytes)."""
+  step_dir = os.path.join(directory, str(int(step)))
+  candidates = [(os.path.getsize(os.path.join(step_dir, rel)), rel)
+                for rel in _step_files(step_dir)]
+  candidates = [(size, rel) for size, rel in candidates if size > 1]
+  if not candidates:
+    return
+  _, rel = max(candidates, key=lambda sr: (sr[0], sr[1]))
+  path = os.path.join(step_dir, rel)
+  size = os.path.getsize(path)
+  with open(path, "r+b") as f:
+    if mode == "torn":
+      f.truncate(size // 2)
+    else:  # bitflip: one byte mid-file, the silent-corruption case
+      f.seek(size // 2)
+      byte = f.read(1)
+      f.seek(size // 2)
+      f.write(bytes([byte[0] ^ 0xFF]))
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _step_looks_torn(directory: str, step: int) -> bool:
+  """Structural verdict for a MANIFEST-LESS step dir whose restore just
+  failed: torn bytes (quarantine + fall back) or a caller error on
+  intact bytes (re-raise)? A step orbax committed is complete by
+  construction (tmp-dir rename), so "intact" is checkable without a
+  manifest: the dir exists, its `_CHECKPOINT_METADATA` parses, and no
+  file in the tree is empty — a crashed foreign writer's partial dir
+  fails one of these. Restore failures on a structurally intact dir
+  (topology mismatch, wrong abstract_state, OOM) must NOT quarantine:
+  that would displace every good pre-manifest checkpoint."""
+  step_dir = os.path.join(directory, str(int(step)))
+  if not os.path.isdir(step_dir):
+    return True
+  try:
+    with open(os.path.join(step_dir, "_CHECKPOINT_METADATA")) as f:
+      json.load(f)
+  except (OSError, ValueError):
+    return True
+  for root, _, files in os.walk(step_dir):
+    for name in files:
+      try:
+        if os.path.getsize(os.path.join(root, name)) == 0:
+          return True
+      except OSError:
+        return True
+  return False
 
 
 @config.configurable
@@ -43,43 +233,200 @@ class CheckpointManager:
                keep_period: Optional[int] = None):
     self._directory = os.path.abspath(directory)
     os.makedirs(self._directory, exist_ok=True)
-    options = ocp.CheckpointManagerOptions(
+    self._options = ocp.CheckpointManagerOptions(
         max_to_keep=max_to_keep,
         save_interval_steps=save_interval_steps,
         keep_period=keep_period,
         enable_async_checkpointing=async_checkpointing)
-    self._manager = ocp.CheckpointManager(self._directory, options=options)
+    self._manager = ocp.CheckpointManager(self._directory,
+                                          options=self._options)
+    # The step actually restored by the most recent restore() on this
+    # manager (the fallback walk may land below the requested/latest
+    # step; serving hot-swap reads its new model_version from this).
+    self.last_restored_step: Optional[int] = None
+    # Manifests are written ONLY for steps THIS manager saved: the
+    # saver is the one party that knows the bytes on disk are good. A
+    # manager writing a manifest for a step dir it merely found —
+    # e.g. at restore time — would bless whatever is there, including
+    # a torn dir, defeating the verification entirely. (Steps from
+    # earlier processes without a manifest stay restorable; the
+    # restore try/except + quarantine walk guards them instead.)
+    self._pending_manifest_steps: set = set()
 
   @property
   def directory(self) -> str:
     return self._directory
 
   def save(self, step: int, state: Any, force: bool = False) -> bool:
-    return self._manager.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+    saved = self._manager.save(step, args=ocp.args.StandardSave(state),
+                               force=force)
+    if saved:
+      self._pending_manifest_steps.add(int(step))
+      fault = (faultlab_lib.maybe_fire(faultlab_lib.CKPT_TORN)
+               or faultlab_lib.maybe_fire(faultlab_lib.CKPT_BITFLIP))
+      if fault is not None:
+        # Chaos path: commit the async save, write the manifest from
+        # the GOOD bytes, then corrupt — the injected fault must be
+        # exactly the one the manifest checksums exist to catch.
+        self._manager.wait_until_finished()
+        write_manifest(self._directory, step)
+        self._pending_manifest_steps.discard(int(step))
+        _corrupt_step_for_faultlab(
+            self._directory, step,
+            "torn" if fault.point == faultlab_lib.CKPT_TORN else "bitflip")
+      else:
+        self._write_pending_manifests()
+    return saved
+
+  def _fs_steps(self) -> List[int]:
+    """Steps present ON DISK (digit-named dirs; quarantined steps are
+    gone from here by construction). The filesystem is the truth the
+    integrity walk needs — orbax's cached step list survives a
+    quarantine move and would happily restore a step that no longer
+    exists."""
+    steps = []
+    if os.path.isdir(self._directory):
+      for name in os.listdir(self._directory):
+        if name.isdigit() and os.path.isdir(
+            os.path.join(self._directory, name)):
+          steps.append(int(name))
+    return sorted(steps)
+
+  def _write_pending_manifests(self) -> None:
+    """Writes manifests for COMMITTED steps this manager saved (save
+    tracks them; orbax commits an async step by dir rename, so a
+    digit-named dir existing means its bytes are complete — an
+    in-flight step still lives under a tmp name and is skipped until
+    the next call). Never raises — integrity bookkeeping must not
+    kill a save."""
+    try:
+      on_disk = self._fs_steps()
+      newest = on_disk[-1] if on_disk else None
+      for step in sorted(self._pending_manifest_steps):
+        step_dir = os.path.join(self._directory, str(step))
+        if not os.path.isdir(step_dir):
+          if newest is not None and step < newest:
+            self._pending_manifest_steps.discard(step)  # GC'd (max_to_keep)
+          continue  # else: still in flight
+        if not os.path.isfile(_manifest_path(self._directory, step)):
+          write_manifest(self._directory, step)
+        self._pending_manifest_steps.discard(step)
+    except Exception:  # noqa: BLE001 - see docstring
+      from absl import logging
+
+      logging.exception("graftguard: manifest write failed")
+
+  def verify_step(self, step: int) -> Optional[bool]:
+    """Manifest verification for one step (see `verify_step_files`)."""
+    return verify_step_files(self._directory, step)
+
+  def latest_verified_step(self) -> Optional[int]:
+    """Newest step that does not FAIL manifest verification (steps
+    without a manifest pass — restore still guards them). The rewind
+    target lookup."""
+    for step in reversed(self._fs_steps()):
+      if self.verify_step(step) is not False:
+        return step
+    return None
 
   def restore(self, step: Optional[int] = None,
               abstract_state: Optional[Any] = None) -> Any:
-    """Restores `step` (or latest). With `abstract_state` (a
-    jax.eval_shape tree, optionally with shardings attached) the restore
-    is sharded/in-layout."""
-    if step is None:
-      step = self.latest_step()
-    if step is None:
+    """Restores `step` (or the newest VERIFIED step). With
+    `abstract_state` (a jax.eval_shape tree, optionally with shardings
+    attached) the restore is sharded/in-layout.
+
+    Integrity contract (graftguard): every candidate step is verified
+    against its manifest first; a corrupt step (checksum mismatch, or
+    a torn dir whose restore raises while its manifest is absent/
+    failing) is QUARANTINED and — for `step=None` — the walk falls
+    back to the next-newest step instead of raising. A restore failure
+    on a step whose manifest VERIFIED clean is not corruption (wrong
+    abstract state, topology mismatch) and re-raises unchanged — as
+    does one on a manifest-less step that is structurally intact
+    (`_step_looks_torn`), so pre-manifest checkpoints are never
+    displaced by a caller error. An explicit `step` that turns out
+    corrupt raises `CheckpointCorruptionError`; an explicit step not
+    on disk raises `FileNotFoundError` — the caller asked for that
+    step specifically."""
+    self.wait_until_finished()  # commits async saves + writes manifests
+    explicit = step is not None
+    on_disk = self._fs_steps()
+    if explicit and int(step) not in on_disk:
+      # A missing explicit step (GC'd by max_to_keep, never saved, or
+      # already quarantined) is not-found, not corruption.
+      raise FileNotFoundError(
+          f"checkpoint step {step} not found in {self._directory}")
+    candidates = [int(step)] if explicit else list(reversed(on_disk))
+    if not candidates:
       raise FileNotFoundError(f"No checkpoint in {self._directory}")
-    if abstract_state is not None:
-      return self._manager.restore(
-          step, args=ocp.args.StandardRestore(abstract_state))
-    return self._manager.restore(step)
+    last_error: Optional[BaseException] = None
+    for candidate in candidates:
+      verdict = self.verify_step(candidate)
+      if verdict is False:
+        quarantine_step(self._directory, candidate, "checksum mismatch")
+        self._reload_manager()
+        if explicit:
+          raise CheckpointCorruptionError(
+              f"checkpoint step {candidate} in {self._directory} failed "
+              "manifest verification (quarantined)")
+        continue
+      try:
+        # Always pass StandardRestore args: the no-target form keeps a
+        # read-only manager (which never registered a save handler)
+        # restorable — `self._manager.restore(step)` bare raises
+        # KeyError('default') on such managers under orbax 0.7.
+        restored = self._manager.restore(
+            candidate, args=ocp.args.StandardRestore(abstract_state))
+        self.last_restored_step = candidate
+        return restored
+      except Exception as e:  # noqa: BLE001 - classified below
+        if verdict is True:
+          # Bytes verified clean: this is a caller/topology error, not
+          # corruption — surfacing it is the only honest move.
+          raise
+        if verdict is None and not _step_looks_torn(self._directory,
+                                                    candidate):
+          # No manifest to consult (pre-manifest/legacy step), but the
+          # dir is structurally intact: a restore failure here is a
+          # caller error too — quarantining would displace every good
+          # legacy checkpoint on e.g. a changed abstract_state.
+          raise
+        last_error = e
+        quarantine_step(self._directory, candidate,
+                        f"restore failed: {type(e).__name__}: {e}")
+        self._reload_manager()
+        if explicit:
+          raise CheckpointCorruptionError(
+              f"checkpoint step {candidate} in {self._directory} is torn "
+              "(restore failed; quarantined)") from e
+        metrics_lib.counter("ckpt/restore_fallbacks").inc()
+    raise CheckpointCorruptionError(
+        f"no intact checkpoint in {self._directory}: every candidate "
+        f"step was quarantined") from last_error
+
+  def _reload_manager(self) -> None:
+    """Rebuilds the orbax manager after a quarantine move: its cached
+    step list would re-offer the quarantined step, and `reload()`
+    leaves the default-item handler registry unusable for later
+    no-args restores (observed on orbax 0.7.0) — a fresh manager has
+    neither problem."""
+    try:
+      self._manager.close()
+    except Exception:  # noqa: BLE001 - the old manager may be wedged
+      pass
+    self._manager = ocp.CheckpointManager(self._directory,
+                                          options=self._options)
 
   def latest_step(self) -> Optional[int]:
-    return self._manager.latest_step()
+    steps = self._fs_steps()
+    return steps[-1] if steps else None
 
   def all_steps(self):
-    return self._manager.all_steps()
+    return self._fs_steps()
 
   def wait_until_finished(self) -> None:
     self._manager.wait_until_finished()
+    self._write_pending_manifests()
 
   def reached_preemption(self, step: int) -> bool:
     """True when the orchestrator signaled preemption (SIGTERM on Borg /
@@ -173,7 +520,11 @@ def checkpoints_iterator(directory: str,
                          min_interval_secs: float = 0.0
                          ) -> Iterator[int]:
   """Yields new checkpoint steps as they appear (the reference's
-  continuous-eval driver, /root/reference/utils/train_eval.py:585-611)."""
+  continuous-eval driver, /root/reference/utils/train_eval.py:585-611).
+
+  The poll sleep is jittered around `timeout_secs`
+  (`utils.retry.jittered_s`) so N continuous-eval pollers on one
+  filesystem de-synchronize instead of stat-ing in lockstep."""
   seen = set()
   start = time.time()
   while True:
@@ -187,7 +538,7 @@ def checkpoints_iterator(directory: str,
     if (total_timeout_secs is not None
         and time.time() - start > total_timeout_secs):
       return
-    time.sleep(timeout_secs)
+    time.sleep(retry_lib.jittered_s(timeout_secs, jitter=0.25))
 
 
 def backup_checkpoint(directory: str, step: int,
@@ -195,23 +546,28 @@ def backup_checkpoint(directory: str, step: int,
                       max_attempts: int = 3) -> Optional[str]:
   """Copies a checkpoint out of GC's reach before a long eval (reference
   create_backup_checkpoint_for_eval + retrying save_copy,
-  /root/reference/utils/train_eval.py:616-733). Retries if the writer
-  races us; returns the backup path or None."""
+  /root/reference/utils/train_eval.py:616-733). Retries under the
+  shared `RetryPolicy` (jittered backoff, `retry/ckpt_backup/*`
+  telemetry) if the writer races us; returns the backup path or None."""
   src = os.path.join(directory, str(step))
   backup_root = backup_root or os.path.join(directory, "eval_backup")
   dst = os.path.join(backup_root, str(step))
-  for attempt in range(max_attempts):
-    try:
-      if os.path.isdir(dst):
-        shutil.rmtree(dst)
-      os.makedirs(backup_root, exist_ok=True)
-      shutil.copytree(src, dst, copy_function=_link_or_copy)
-      return dst
-    except (FileNotFoundError, shutil.Error, OSError):
-      if attempt == max_attempts - 1:
-        return None
-      time.sleep(0.5 * (attempt + 1))
-  return None
+
+  def _copy() -> str:
+    if os.path.isdir(dst):
+      shutil.rmtree(dst)
+    os.makedirs(backup_root, exist_ok=True)
+    shutil.copytree(src, dst, copy_function=_link_or_copy)
+    return dst
+
+  policy = retry_lib.RetryPolicy(
+      name="ckpt_backup", max_attempts=max_attempts, base_delay_s=0.5,
+      multiplier=1.5, max_delay_s=2.0,
+      retryable=lambda e: isinstance(e, (OSError, shutil.Error)))
+  try:
+    return policy.call(_copy)
+  except retry_lib.RetryBudgetExhausted:
+    return None
 
 
 def _link_or_copy(src: str, dst: str) -> None:

@@ -177,6 +177,10 @@ class OverlappedLoader:
   """
 
   _END = object()
+  # A batch dropped by `skip_batch_on_error` (the graftguard corrupt-
+  # record quota): flows through the futures queue so ordering is
+  # untouched, filtered before the output queue.
+  _SKIPPED = object()
 
   def __init__(self,
                raw: Iterator[Any],
@@ -186,7 +190,9 @@ class OverlappedLoader:
                depth: int = 2,
                max_bytes: int = DEFAULT_QUEUE_BYTES,
                telemetry: bool = True,
-               fuse_preprocess: bool = False):
+               fuse_preprocess: bool = False,
+               skip_batch_on_error: Optional[
+                   Callable[[BaseException], bool]] = None):
     from concurrent.futures import ThreadPoolExecutor
 
     parse_workers = max(int(parse_workers), 1)
@@ -210,20 +216,37 @@ class OverlappedLoader:
       out_bytes_gauge = obs_metrics.gauge("data/overlap_out_bytes")
     perf_counter_ns = time.perf_counter_ns
 
+    skipped = self._SKIPPED
+
+    def _absorb(e: BaseException) -> bool:
+      """graftguard quota hook: True = drop this batch and continue."""
+      if skip_batch_on_error is None or isinstance(
+          e, (KeyboardInterrupt, SystemExit)):
+        return False
+      try:
+        return bool(skip_batch_on_error(e))
+      except Exception:  # noqa: BLE001 - a broken hook must not mask `e`
+        return False
+
     def _timed_parse(item):
       t0 = perf_counter_ns()
-      out = parse_fn(item)
-      if telemetry:
-        parse_hist.record((perf_counter_ns() - t0) * 1e-6)
-      if fuse_preprocess:
-        # Fused mode (module docstring): preprocess runs HERE, on the
-        # pool thread, immediately after its own batch's parse — the
-        # per-stage telemetry split is preserved so attribution in
-        # runs.jsonl reads the same either way.
-        t0 = perf_counter_ns()
-        out = preprocess_fn(out)
+      try:
+        out = parse_fn(item)
         if telemetry:
-          preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
+          parse_hist.record((perf_counter_ns() - t0) * 1e-6)
+        if fuse_preprocess:
+          # Fused mode (module docstring): preprocess runs HERE, on the
+          # pool thread, immediately after its own batch's parse — the
+          # per-stage telemetry split is preserved so attribution in
+          # runs.jsonl reads the same either way.
+          t0 = perf_counter_ns()
+          out = preprocess_fn(out)
+          if telemetry:
+            preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
+      except BaseException as e:  # noqa: BLE001 - quota decides
+        if _absorb(e):
+          return skipped
+        raise
       return out
 
     # Stage threads close over locals ONLY — never `self` — so an
@@ -260,9 +283,16 @@ class OverlappedLoader:
             out_q.put(got, 0, stop)
             return
           batch = got.result()
+          if batch is skipped:
+            continue  # dropped under the corrupt-record quota
           if not fuse_preprocess:
             t0 = perf_counter_ns()
-            batch = preprocess_fn(batch)
+            try:
+              batch = preprocess_fn(batch)
+            except BaseException as e:  # noqa: BLE001 - quota decides
+              if _absorb(e):
+                continue
+              raise
             if telemetry:
               preprocess_hist.record((perf_counter_ns() - t0) * 1e-6)
           if not out_q.put(batch, batch_nbytes(batch), stop):
@@ -354,11 +384,35 @@ class OverlappedLoader:
       return
     self._done = True
     self._stop.set()
-    self._feeder.join(timeout=timeout)
+    # Stalled-source handling under the shared RetryPolicy: the join is
+    # paced in jittered growing slices (instead of one opaque blocking
+    # join), so a source that stays stalled shows up as
+    # `retry/overlap_source_stall/*` pressure in telemetry while the
+    # total wait stays bounded by `timeout`.
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    # jitter=0: this paces joins on our OWN thread (nothing to
+    # de-synchronize), and a jittered draw could shrink the summed
+    # ladder to ~0.75*timeout — abandoning a feeder that would have
+    # unstalled within the documented budget. The zero-jitter ladder
+    # sums to exactly `timeout` (t/64 * (1+1+2+4+8+16+16+16)).
+    policy = retry_lib.RetryPolicy(
+        name="overlap_source_stall", max_attempts=8,
+        base_delay_s=timeout / 64.0, multiplier=2.0,
+        max_delay_s=timeout / 4.0, jitter=0.0, deadline_s=timeout)
+    self._feeder.join(timeout=policy.backoff_s(0))
+    if self._feeder.is_alive():
+      retries = obs_metrics.counter("retry/overlap_source_stall/retries")
+      for delay in policy.delays():
+        retries.inc()
+        self._feeder.join(timeout=delay)
+        if not self._feeder.is_alive():
+          break
     feeder_stalled = self._feeder.is_alive()
     if feeder_stalled:
       from absl import logging
 
+      obs_metrics.counter("retry/overlap_source_stall/giveups").inc()
       logging.error(
           "OverlappedLoader.close(): feeder still alive after %.0fs — "
           "blocked in next(raw) on a stalled data source; abandoning "

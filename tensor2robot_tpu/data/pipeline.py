@@ -14,6 +14,19 @@ Differences from the reference, by design:
   (/root/reference/utils/tfdata.py:38-61);
 * deterministic mode for eval, nondeterministic interleave for training
   (reference options, :629-689).
+
+graftguard data-plane degradation: with `max_corrupt_records` > 0 a
+batch that fails to parse/preprocess (corrupt record bytes, a poisoned
+preprocess) is SKIPPED and its records counted
+(`data/corrupt_records_skipped`, `data/corrupt_batches_skipped`)
+instead of killing the epoch, and a record-source I/O error ends the
+current epoch early (counted, training continues on the next epoch)
+— raising only once the counted quota is exceeded, so a rotten shard
+still surfaces instead of silently starving the run. The quota is 0
+by default: eval and parity paths keep the strict raise-immediately
+contract. `obs.faultlab` points (`data.record_io`,
+`data.corrupt_record`, `data.preprocess`) inject exactly these
+failures for the chaos bench.
 """
 
 from __future__ import annotations
@@ -33,6 +46,7 @@ from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.data import overlap as overlap_lib
 from tensor2robot_tpu.data import parsing, tfrecord
 from tensor2robot_tpu.data import stager as stager_lib
+from tensor2robot_tpu.obs import faultlab as faultlab_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
@@ -46,6 +60,28 @@ PreprocessFn = Callable[[specs_lib.SpecStruct, specs_lib.SpecStruct, str],
 # How many per-batch wait observations the prefetch consumer buffers
 # locally before one `record_many` flush into the metrics registry.
 _FLUSH_EVERY = 64
+
+# Sentinel for a batch dropped under the graftguard corrupt-record
+# quota (filtered out of the serial chain before the consumer).
+_SKIP = object()
+
+
+def _corrupted_copy(batch):
+  """faultlab `data.corrupt_record` payload: returns `batch` with the
+  FIRST record's bytes overwritten with 0xFF (an invalid proto wire
+  tag), so the parser fails exactly the way real corruption fails.
+  Copies — the raw batch may be shared with telemetry/retries."""
+  if isinstance(batch, stager_lib.StagedBatch):
+    arena = batch.arena.copy()
+    offset = int(batch.offsets[0])
+    length = int(batch.lengths[0])
+    arena[offset:offset + length] = 0xFF
+    return stager_lib.StagedBatch(arena, batch.offsets, batch.lengths)
+  batch = list(batch)
+  first = {key: b"\xff" * max(len(value), 4)
+           for key, value in batch[0].items()}
+  batch[0] = first
+  return batch
 
 
 def resolve_file_patterns(
@@ -303,7 +339,8 @@ class RecordBatchPipeline:
                use_native_stager: Optional[bool] = None,
                overlap: Optional[bool] = None,
                overlap_queue_mb: Optional[float] = None,
-               fused_preprocess: Optional[bool] = None):
+               fused_preprocess: Optional[bool] = None,
+               max_corrupt_records: int = 0):
     self._parse_fn = parse_fn
     self._batch_size = batch_size
     self._mode = mode
@@ -323,6 +360,12 @@ class RecordBatchPipeline:
         overlap_lib.DEFAULT_QUEUE_BYTES if overlap_queue_mb is None
         else max(int(overlap_queue_mb * (1 << 20)), 1))
     self._fused_preprocess = fused_preprocess
+    # graftguard degradation quota (module docstring): total RECORDS
+    # allowed to be dropped over this pipeline's lifetime before a
+    # parse/preprocess/source failure raises. 0 = strict.
+    self._max_corrupt_records = max(int(max_corrupt_records), 0)
+    self._corrupt_records_seen = 0
+    self._corrupt_lock = threading.Lock()
     self._warned_stager_unavailable = False
     dataset_keys = parse_fn.dataset_keys
     if isinstance(file_patterns, Mapping):
@@ -380,6 +423,78 @@ class RecordBatchPipeline:
     return (None if self._seed is None
             else self._seed + epoch + self._host_seed_offset)
 
+  # -- graftguard degradation (module docstring) ----------------------------
+
+  def _charge_quota(self, exc: BaseException, what: str) -> bool:
+    """Charges one batch's worth of records against the corruption
+    quota; False when the quota is off or exceeded (the caller must
+    raise). Thread-safe — the overlap plane calls this from pool
+    threads. The accounting unit is the batch's records (`batch_size`;
+    a corrupt record costs its batch — the parse unit)."""
+    if self._max_corrupt_records <= 0:
+      return False
+    with self._corrupt_lock:
+      self._corrupt_records_seen += self._batch_size
+      over = self._corrupt_records_seen > self._max_corrupt_records
+    if over:
+      logging.error(
+          "data: corrupt-record quota exceeded (%d records skipped > "
+          "max_corrupt_records=%d); surfacing %s", self._corrupt_records_seen,
+          self._max_corrupt_records, type(exc).__name__)
+      return False
+    logging.warning("data: skipped %s under quota (%s: %s)", what,
+                    type(exc).__name__, exc)
+    return True
+
+  def _absorb_batch_error(self, exc: BaseException) -> bool:
+    """Decides whether a failed parse/preprocess batch is SKIPPED
+    (True: counted against the record quota as corrupt records) or
+    must raise (False: quota disabled or exceeded)."""
+    if not self._charge_quota(exc, "a corrupt batch"):
+      return False
+    obs_metrics.counter("data/corrupt_records_skipped").inc(self._batch_size)
+    obs_metrics.counter("data/corrupt_batches_skipped").inc()
+    return True
+
+  def _absorb_source_error(self, exc: BaseException) -> bool:
+    """A record-source I/O error ends the CURRENT epoch early instead
+    of killing the run (the remaining epoch records are charged as one
+    batch against the same quota); False past the quota or when the
+    quota is off. Counted ONLY as `data/source_io_errors` — an I/O
+    flake is not data corruption, and conflating the counters would
+    point a dashboard at the wrong failure."""
+    if not self._charge_quota(exc, "the rest of the epoch (source I/O)"):
+      return False
+    obs_metrics.counter("data/source_io_errors").inc()
+    return True
+
+  def _inject_record_faults(self, stream: Iterator[Any]) -> Iterator[Any]:
+    """`data.record_io` faultlab seam: the stream raises a (real-
+    IOError-subclass) injected error mid-epoch. Wrapped only while a
+    plan is active, so the steady-state chain pays nothing."""
+    for item in stream:
+      if faultlab_lib.maybe_fire(faultlab_lib.DATA_RECORD_IO) is not None:
+        raise faultlab_lib.InjectedIOError(
+            "faultlab: injected record-source I/O error")
+      yield item
+
+  def _guarded(self, fn):
+    """Quota-absorbing wrapper for the serial parse/preprocess chain:
+    a failed batch becomes the `_SKIP` sentinel (filtered before the
+    consumer) while the quota holds."""
+    def inner(batch):
+      if batch is _SKIP:
+        return _SKIP
+      try:
+        return fn(batch)
+      except (KeyboardInterrupt, SystemExit):
+        raise
+      except BaseException as e:  # noqa: BLE001 - quota decides
+        if self._absorb_batch_error(e):
+          return _SKIP
+        raise
+    return inner
+
   def _epoch_files(self, files: Sequence[str],
                    epoch_seed: Optional[int]) -> List[str]:
     """Final per-epoch file order: train mode shuffles in Python with
@@ -396,8 +511,13 @@ class RecordBatchPipeline:
     toolchain is present, the Python generator chain otherwise."""
     files = self._epoch_files(files, epoch_seed)
     if self._stager_enabled() and files:
-      return stager_lib.iter_staged_records(files, self._cycle_length)
-    return interleave_records(files, self._cycle_length)
+      stream: Iterator[bytes] = stager_lib.iter_staged_records(
+          files, self._cycle_length)
+    else:
+      stream = interleave_records(files, self._cycle_length)
+    if faultlab_lib.active() is not None:
+      stream = self._inject_record_faults(stream)
+    return stream
 
   def _record_tuples(self, epoch_seed: Optional[int]
                      ) -> Iterator[Dict[str, bytes]]:
@@ -429,19 +549,29 @@ class RecordBatchPipeline:
     while True:
       epoch_seed = self._epoch_seed(epoch)
       files = next(iter(self._files.values())) if single_key else None
-      if files and self._stager_enabled():
-        yield from stager_lib.stage_batches(
-            self._epoch_files(files, epoch_seed),
-            batch_size=self._batch_size,
-            cycle_length=self._cycle_length,
-            shuffle_buffer=self._shuffle_buffer_size,
-            seed=epoch_seed,
-            drop_remainder=self._drop_remainder)
-      else:
-        stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
-        if self._shuffle_buffer_size:
-          stream = shuffled(stream, self._shuffle_buffer_size, epoch_seed)
-        yield from _batched(stream, self._batch_size, self._drop_remainder)
+      try:
+        if files and self._stager_enabled():
+          epoch_batches: Iterator[Any] = stager_lib.stage_batches(
+              self._epoch_files(files, epoch_seed),
+              batch_size=self._batch_size,
+              cycle_length=self._cycle_length,
+              shuffle_buffer=self._shuffle_buffer_size,
+              seed=epoch_seed,
+              drop_remainder=self._drop_remainder)
+          if faultlab_lib.active() is not None:
+            epoch_batches = self._inject_record_faults(epoch_batches)
+          yield from epoch_batches
+        else:
+          stream: Iterator[Dict[str, bytes]] = self._record_tuples(epoch_seed)
+          if self._shuffle_buffer_size:
+            stream = shuffled(stream, self._shuffle_buffer_size, epoch_seed)
+          yield from _batched(stream, self._batch_size, self._drop_remainder)
+      except (IOError, OSError) as e:
+        # graftguard: a mid-epoch source I/O error (rotten shard, NFS
+        # hiccup, an injected data.record_io fault) ends THIS epoch
+        # early under the counted quota; strict mode re-raises.
+        if not self._absorb_source_error(e):
+          raise
       if not self._repeat:
         return
       epoch += 1
@@ -496,24 +626,33 @@ class RecordBatchPipeline:
     workers = (self._num_parallel_parses if num_parallel_parses is None
                else num_parallel_parses)
     size = self._prefetch_size if prefetch_size is None else prefetch_size
+    degrade = self._max_corrupt_records > 0
     if self._overlap_enabled(size):
       return overlap_lib.OverlappedLoader(
           iter(raw), self._parse_only, self._apply_preprocess,
           parse_workers=max(workers, 1), depth=max(size, 1),
           max_bytes=self._overlap_queue_bytes,
-          fuse_preprocess=self._fuse_preprocess_enabled())
+          fuse_preprocess=self._fuse_preprocess_enabled(),
+          skip_batch_on_error=(self._absorb_batch_error if degrade
+                               else None))
     if workers > 1:
-      parsed = parallel_map_ordered(self._parse_only, raw,
-                                    num_workers=workers)
-      stream: Iterator[specs_lib.SpecStruct] = map(
-          self._apply_preprocess, parsed)
+      parse = self._guarded(self._parse_only) if degrade else self._parse_only
+      parsed = parallel_map_ordered(parse, raw, num_workers=workers)
+      preprocess = (self._guarded(self._apply_preprocess) if degrade
+                    else self._apply_preprocess)
+      stream: Iterator[specs_lib.SpecStruct] = map(preprocess, parsed)
     else:
-      stream = map(self._finalize, raw)
+      finalize = self._guarded(self._finalize) if degrade else self._finalize
+      stream = map(finalize, raw)
+    if degrade:
+      stream = (batch for batch in stream if batch is not _SKIP)
     if size:
       stream = prefetch(stream, size)
     return stream
 
   def _parse_only(self, batch: Any) -> specs_lib.SpecStruct:
+    if faultlab_lib.maybe_fire(faultlab_lib.DATA_CORRUPT_RECORD) is not None:
+      batch = _corrupted_copy(batch)
     if isinstance(batch, stager_lib.StagedBatch):
       # Arena batch from the native staging plane: hand it through
       # whole — the native parser reads records in place (parse_arena),
@@ -528,6 +667,9 @@ class RecordBatchPipeline:
 
   def _apply_preprocess(self, parsed: specs_lib.SpecStruct
                         ) -> specs_lib.SpecStruct:
+    if faultlab_lib.maybe_fire(faultlab_lib.DATA_PREPROCESS) is not None:
+      raise faultlab_lib.InjectedPreprocessError(
+          "faultlab: injected preprocess failure")
     features = parsed["features"] if "features" in parsed \
         else specs_lib.SpecStruct()
     labels = parsed["labels"] if "labels" in parsed else specs_lib.SpecStruct()

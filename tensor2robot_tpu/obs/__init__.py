@@ -28,6 +28,10 @@ timing that diagnosed every perf round by hand (PERFORMANCE.md):
   EWMA/MAD step-time spikes, data starvation, non-finite divergence
   (piggybacked on the barrier fetch — zero extra tunnel round trips),
   HBM-watermark drift; emits `graftscope-incident-v1` records;
+* `faultlab`  — graftguard's seeded deterministic fault-injection
+  plane: named injection points threaded through the data/checkpoint/
+  train/serving seams, every injected fault counted and stamped into
+  the run record so a chaos run (`bench.py --chaos`) is attributable;
 * `flightrec` — crash/hang flight recorder: bounded ring buffers of
   recent steps/incidents dumped as a `graftscope-postmortem-v1` bundle
   on unhandled exception, SIGTERM (tunnel-safe: host-side state only),
@@ -46,8 +50,8 @@ Read telemetry back with `python -m tensor2robot_tpu.bin.graftscope
 `... graftscope diff <runA> <runB>` / `... graftscope history <dir>`.
 """
 
-from tensor2robot_tpu.obs import (excache, flightrec, metrics, runlog,
-                                  sentinel, stepstats, trace, xray)
+from tensor2robot_tpu.obs import (excache, faultlab, flightrec, metrics,
+                                  runlog, sentinel, stepstats, trace, xray)
 
-__all__ = ["excache", "flightrec", "metrics", "runlog", "sentinel",
-           "stepstats", "trace", "xray"]
+__all__ = ["excache", "faultlab", "flightrec", "metrics", "runlog",
+           "sentinel", "stepstats", "trace", "xray"]

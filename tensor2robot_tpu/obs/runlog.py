@@ -123,6 +123,16 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # and flags).
     "fleet_vs_single_replica": ("down", 0.15),
     "fleet_rollout_shed": ("up", 0.0),
+    # Chaos/robustness gates (bench.py --chaos / scripts/chaos_bench.sh,
+    # PERFORMANCE.md "Reading a chaos bench"): chaos_goodput_ratio is
+    # the paired faulted/clean serving-goodput ratio under the seeded
+    # fault storm (back-to-back pairs => load-invariant like
+    # data_vs_synthetic; a drop means recovery got more expensive or
+    # stopped working). chaos_recovery_ms is the worst per-fault-class
+    # recovery wall time (probation readmit / divergence rewind) on the
+    # 1-core host — wall-clock, so it gets the loose band warmup_ms has.
+    "chaos_goodput_ratio": ("down", 0.15),
+    "chaos_recovery_ms": ("up", 0.50),
 }
 
 
@@ -391,6 +401,12 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
   # replica-scaling ratio and the rollout-window shed/failure count.
   if bench.get("fleet_vs_single_replica") is not None:
     out["fleet_vs_single_replica"] = float(bench["fleet_vs_single_replica"])
+  # Chaos bench (bench.py --chaos): goodput under the seeded fault
+  # storm vs clean, and the worst per-fault-class recovery time.
+  if bench.get("chaos_goodput_ratio") is not None:
+    out["chaos_goodput_ratio"] = float(bench["chaos_goodput_ratio"])
+  if bench.get("chaos_recovery_ms") is not None:
+    out["chaos_recovery_ms"] = float(bench["chaos_recovery_ms"])
   rollout = bench.get("rollout") or {}
   if rollout.get("window_shed") is not None:
     out["fleet_rollout_shed"] = float(rollout["window_shed"])

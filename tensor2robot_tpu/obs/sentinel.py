@@ -185,6 +185,17 @@ class Sentinel:
         self._emit(NONFINITE_METRIC, step, severity="fatal", value=scalar,
                    detail={"metric": str(key)})
 
+  def reset_nonfinite_latch(self) -> None:
+    """Re-arms the non-finite detectors (metrics + params). The latch
+    de-dupes one continuous NaN episode; the divergence-rewind path
+    must call this after restoring, because a NaN that recurs on the
+    first post-rewind observation — no finite value in between — is a
+    NEW divergence that has to re-trigger (and eventually exhaust the
+    rewind budget), not ride the old episode's latch to a silent
+    'successful' run full of NaNs."""
+    self._nonfinite_latched.clear()
+    self._params_latched = False
+
   # -- detectors ------------------------------------------------------------
 
   def _check_spike(self, step: int, record: Mapping[str, Any]) -> None:

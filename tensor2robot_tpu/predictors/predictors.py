@@ -301,10 +301,14 @@ class CheckpointPredictor(_JaxPredictorBase):
     self._build_predict()
 
   def restore(self) -> bool:
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    # Jittered appearance poll: N replica predictors waiting on one
+    # model_dir de-synchronize instead of stat-ing in lockstep.
     deadline = time.time() + self._timeout_secs
     step = checkpoints_lib.latest_step(self._checkpoint_dir)
     while step is None and time.time() < deadline:
-      time.sleep(1.0)
+      time.sleep(retry_lib.jittered_s(1.0, jitter=0.25))
       step = checkpoints_lib.latest_step(self._checkpoint_dir)
     if step is None:
       return False
@@ -313,7 +317,12 @@ class CheckpointPredictor(_JaxPredictorBase):
     abstract = jax.tree_util.tree_map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._state)
     with checkpoints_lib.CheckpointManager(self._checkpoint_dir) as manager:
-      self._state = manager.restore(step, abstract_state=abstract)
+      # step=None: the graftguard verified-fallback walk — a corrupt
+      # newest step (torn write racing the poll, bit rot) is
+      # quarantined and the newest VERIFIED step serves instead of the
+      # hot-swap raising out of a live rollout().
+      self._state = manager.restore(abstract_state=abstract)
+      step = manager.last_restored_step
     if self._device is not None:
       # Replica pin survives a hot-swap: the restored tree lands on the
       # default device otherwise, silently migrating this replica's

@@ -56,6 +56,18 @@ multislice):
   counted `serve/fleet/session_reopens`; `session_reopen='evict'`
   raises the established `SessionEvictedError` instead for policies
   that must know). `probe_replica` + `mark_healthy` re-admit.
+* PROBATION (graftguard): with `probation_probe` set (a request
+  factory), an evicted replica gets a background probe loop under the
+  shared `utils.retry.RetryPolicy` — jittered growing backoff, counted
+  `serve/fleet/probation_probes` — and AUTO-READMITS on the first
+  successful direct probe (`serve/fleet/probation_readmits`, time
+  from eviction to readmission in `serve/fleet/readmit_ms`), so a
+  transient fault self-heals instead of waiting for an operator's
+  `mark_healthy`. A replica whose probe budget exhausts stays evicted
+  (`serve/fleet/probation_giveups`) until re-evicted/operator action —
+  displaced-session reopen is unchanged either way. The `obs.faultlab`
+  points `serve.dispatch` / `serve.latency` inject per-replica
+  dispatch failures and latency spikes for the chaos bench.
 * ZERO-DOWNTIME ROLLOUT (`rollout()`): canary-first one-at-a-time
   checkpoint swap under live traffic. Per replica: steer the router
   around it, wait for its outstanding work to drain, `restore()` under
@@ -82,7 +94,10 @@ graftscope telemetry (runs.jsonl via the standard registry snapshot):
                                                    model_version)
   serve/fleet/{requests,shed,retries,no_healthy,unhealthy,
                session_opens,session_reopens,rollouts,
-               rollout_swapped}                    counters
+               rollout_swapped,probation_probes,
+               probation_readmits,probation_giveups} counters
+  serve/fleet/readmit_ms                           histogram (eviction
+                                                   -> readmission MTTR)
 
 Backend-free at import like the rest of `serving/` (jax only ever
 appears inside factories the CALLER provides; tests/test_fleet.py runs
@@ -99,12 +114,14 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
 import numpy as np
 
+from tensor2robot_tpu.obs import faultlab as faultlab_lib
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import sentinel as sentinel_lib
 from tensor2robot_tpu.serving import batcher as batcher_lib
 from tensor2robot_tpu.serving import session as session_lib
 from tensor2robot_tpu.utils import config
+from tensor2robot_tpu.utils import retry as retry_lib
 
 __all__ = ["ServingFleet", "FleetShedError", "NoHealthyReplicaError"]
 
@@ -201,7 +218,10 @@ class ServingFleet:
                session_batching: bool = False,
                warmup: bool = False,
                name: str = "serve/fleet",
-               sinks: Optional[List[Callable[[Dict[str, Any]], Any]]] = None):
+               sinks: Optional[List[Callable[[Dict[str, Any]], Any]]] = None,
+               probation_probe: Optional[
+                   Callable[[], Mapping[str, Any]]] = None,
+               probation_policy: Optional[retry_lib.RetryPolicy] = None):
     if replica_factory is None:
       raise ValueError("replica_factory is required.")
     if num_replicas < 1:
@@ -218,6 +238,17 @@ class ServingFleet:
                               is not None else max_queue)
     self._lock = threading.Lock()
     self._closed = False
+    # Replica probation (module docstring): probe factory + policy
+    # template; per-replica probe state lives in _probation (attempt
+    # index, next-probe monotonic time) and the lazy worker thread.
+    self._probation_probe = probation_probe
+    self._probation_policy = probation_policy or retry_lib.RetryPolicy(
+        name="fleet_probation", max_attempts=8, base_delay_s=0.05,
+        multiplier=2.0, max_delay_s=1.0, jitter=0.5)
+    self._probation: Dict[int, Dict[str, float]] = {}
+    self._probation_thread: Optional[threading.Thread] = None
+    self._probation_wake = threading.Event()
+    self._evicted_at: Dict[int, float] = {}
     groups: List[Any]
     if devices is not None:
       from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -325,43 +356,127 @@ class ServingFleet:
     """Evicts a replica from the routing set: the router steers around
     it, its batcher finishes in-flight work (drain, not kill), and its
     fleet sessions are displaced to re-open elsewhere on their next
-    tick."""
+    tick. With probation armed the replica also enters the background
+    probe loop (auto-readmit on success)."""
     with self._lock:
       replica = self._replicas[index]
       if replica.state in (UNHEALTHY, CLOSED):
         return
       replica.state = UNHEALTHY
       replica.unhealthy_reason = reason
+      self._evicted_at[index] = time.monotonic()
       for entry in self._sessions.values():
         if entry.replica is replica:
           entry.displaced = True
       self._healthy_gauge_locked()
     obs_metrics.counter("serve/fleet/unhealthy").inc()
     self._emit_incident(sentinel_lib.REPLICA_UNHEALTHY, index, reason)
+    self._enter_probation(index)
 
   def mark_healthy(self, index: int) -> None:
-    """Re-admits a replica (after `probe_replica` or operator action)."""
+    """Re-admits a replica (after `probe_replica`, the probation loop,
+    or operator action); records eviction-to-readmission wall time in
+    `serve/fleet/readmit_ms` (the fleet's MTTR histogram)."""
     with self._lock:
       replica = self._replicas[index]
       if replica.state == CLOSED:
         raise ValueError(f"replica {index} is closed")
+      was_unhealthy = replica.state == UNHEALTHY
       replica.state = SERVING
       replica.failure_streak = 0
       replica.unhealthy_reason = None
       replica.last_ok_s = time.monotonic()
+      evicted_at = self._evicted_at.pop(index, None)
+      self._probation.pop(index, None)
       self._healthy_gauge_locked()
+    if was_unhealthy and evicted_at is not None:
+      obs_metrics.histogram("serve/fleet/readmit_ms").record(
+          (time.monotonic() - evicted_at) * 1e3)
 
   def probe_replica(self, index: int,
                     request: Mapping[str, Any]) -> bool:
     """Sends one request DIRECTLY to a replica (bypassing the router);
     marks it healthy on success. The recovery half of eviction."""
     replica = self._replicas[index]
+    obs_metrics.counter("serve/fleet/probation_probes").inc()
     try:
       replica.engine.predict(request)
     except Exception:  # noqa: BLE001 - a failed probe just stays evicted
       return False
     self.mark_healthy(index)
     return True
+
+  # -- probation (module docstring) -----------------------------------------
+
+  def _enter_probation(self, index: int) -> None:
+    """Seeds the probe schedule for a just-evicted replica and makes
+    sure the (lazy, single) probation worker is running."""
+    if self._probation_probe is None:
+      return
+    policy = self._probation_policy
+    with self._lock:
+      if self._closed:
+        return
+      self._probation[index] = {
+          "attempt": 0.0,
+          "next_s": time.monotonic() + policy.backoff_s(0)}
+      if self._probation_thread is None:
+        self._probation_thread = threading.Thread(
+            target=self._probation_main, daemon=True,
+            name=f"{self._name.replace('/', '-')}-probation")
+        self._probation_thread.start()
+    self._probation_wake.set()
+
+  def _probation_main(self) -> None:
+    """Background probe loop: every evicted replica on the schedule is
+    probed directly under the RetryPolicy's jittered backoff;
+    auto-readmit on success (probe_replica -> mark_healthy), give-up
+    past the attempt budget. The loop idles on an event when nothing
+    is in probation — it costs nothing in the healthy steady state."""
+    policy = self._probation_policy
+    while True:
+      with self._lock:
+        if self._closed:
+          return
+        now = time.monotonic()
+        due = [i for i, s in self._probation.items() if now >= s["next_s"]]
+        next_s = min((s["next_s"] for s in self._probation.values()),
+                     default=None)
+      if not due:
+        # Sleep exactly until the earliest scheduled probe — forever
+        # when nothing is in probation (the healthy steady state costs
+        # zero wakeups and zero routing-lock traffic). _enter_probation
+        # and close() set the event; clearing AFTER the wait and
+        # re-reading the schedule above means no wakeup can be lost.
+        timeout = (None if next_s is None
+                   else max(next_s - time.monotonic(), 0.0))
+        if timeout is None or timeout > 0.0:
+          self._probation_wake.wait(timeout=timeout)
+        self._probation_wake.clear()
+        continue
+      for index in due:
+        try:
+          request = self._probation_probe()
+          readmitted = self.probe_replica(index, request)
+        except Exception:  # noqa: BLE001 - a probe must never kill the loop
+          readmitted = False
+        if readmitted:
+          obs_metrics.counter("serve/fleet/probation_readmits").inc()
+          continue
+        with self._lock:
+          state = self._probation.get(index)
+          if state is None:
+            continue
+          attempt = int(state["attempt"]) + 1
+          if attempt >= policy.max_attempts:
+            self._probation.pop(index, None)
+            give_up = True
+          else:
+            state["attempt"] = float(attempt)
+            state["next_s"] = time.monotonic() + policy.backoff_s(attempt)
+            give_up = False
+        if give_up:
+          obs_metrics.counter("serve/fleet/probation_giveups").inc()
 
   def sentinel_sink(self) -> Callable[[Mapping[str, Any]], None]:
     """An incident-sink callable for `obs.sentinel.Sentinel(sinks=...)`:
@@ -475,6 +590,19 @@ class ServingFleet:
       ok = False
       health_relevant = True
       try:
+        # faultlab seams (chaos bench): a latency spike holds the
+        # dispatch open (spec.arg ms), a dispatch fault fails it — both
+        # INSIDE the health accounting, so injected faults exercise
+        # exactly the eviction/failover machinery real ones do.
+        spike = faultlab_lib.maybe_fire(faultlab_lib.SERVE_LATENCY,
+                                        key=replica.index)
+        if spike is not None:
+          time.sleep(float(spike.arg or 25.0) / 1e3)
+        if faultlab_lib.maybe_fire(faultlab_lib.SERVE_DISPATCH,
+                                   key=replica.index) is not None:
+          raise faultlab_lib.InjectedDispatchError(
+              f"faultlab: injected dispatch failure on replica "
+              f"{replica.index}")
         if deadline_ms is not None:
           result = replica.front.predict(features, deadline_ms=deadline_ms)
         else:
@@ -799,7 +927,13 @@ class ServingFleet:
       for replica in self._replicas:
         replica.state = CLOSED
       self._sessions.clear()
+      self._probation.clear()
+      probation_thread = self._probation_thread
+      self._probation_thread = None
       self._healthy_gauge_locked()
+    if probation_thread is not None:
+      self._probation_wake.set()  # unblock the idle wait promptly
+      probation_thread.join(timeout=5.0)
     for replica in self._replicas:
       if replica.front is not None:
         replica.front.close()

@@ -34,6 +34,7 @@ from tensor2robot_tpu import modes as modes_lib
 from tensor2robot_tpu import specs as specs_lib
 from tensor2robot_tpu.hooks import core as hooks_lib
 from tensor2robot_tpu.obs import excache as excache_lib
+from tensor2robot_tpu.obs import faultlab as faultlab_lib
 from tensor2robot_tpu.obs import flightrec as flightrec_lib
 from tensor2robot_tpu.obs import metrics as metrics_registry_lib
 from tensor2robot_tpu.obs import runlog as runlog_lib
@@ -208,6 +209,8 @@ def train_eval_model(
     enable_sentinel: bool = True,
     watchdog_timeout_secs: Optional[float] = None,
     executable_cache_dir: Optional[str] = "auto",
+    rewind_on_divergence: bool = True,
+    max_rewinds: int = 2,
 ) -> dict:
   """Runs the requested mode; returns final metrics.
 
@@ -267,6 +270,23 @@ def train_eval_model(
   renders it). The default watchdog is OFF: over the axon tunnel a
   first compile legitimately takes minutes, so the timeout is a
   per-deployment choice.
+
+  **Divergence rewind (graftguard).** With the sentinel on and
+  `rewind_on_divergence` (default), a FATAL non-finite incident (NaN
+  loss scalar at the log fetch, non-finite params on the stepstats
+  barrier) no longer kills the run: the loop restores the newest
+  VERIFIED checkpoint (`CheckpointManager` manifest walk — a torn or
+  bit-flipped step is quarantined and the next-newest serves), rebuilds
+  the data stream from the input generator (deterministically re-seeded
+  — a rewound run and a clean run resumed from the same checkpoint see
+  the same records, which is what makes the chaos bench's numerical-
+  parity pin possible), and continues. Each rewind is counted
+  (`train/rewinds`, wall time in `train/rewind_ms`); the budget is
+  BOUNDED (`max_rewinds`) and exhausting it escalates to the existing
+  flight-recorder abort — a model that keeps diverging is a bug, not
+  bad luck, and infinite rewinds would hide it. The flight recorder
+  still dumps its postmortem bundle on the FIRST fatal incident
+  (sink order), so every rewind is attributable.
 
   `executable_cache_dir` arms graftcache (`obs.excache`): the X-rayed
   train step/loop executables persist to disk keyed by (jaxpr, shapes/
@@ -446,11 +466,20 @@ def train_eval_model(
       abstract = jax.tree_util.tree_map(
           lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                          sharding=x.sharding), state)
-      state = manager.restore(restored_step, abstract_state=abstract)
-      logging.info("Resumed from checkpoint step %d", restored_step)
+      # step=None verified walk, NOT restore(latest_step()): a torn or
+      # corrupt newest step (crash mid-save — the canonical restart
+      # case) quarantines and falls back to the newest intact step; the
+      # explicit-step form would raise CheckpointCorruptionError here.
+      state = manager.restore(abstract_state=abstract)
+      logging.info("Resumed from checkpoint step %d",
+                   manager.last_restored_step)
 
     run_memory: dict = {}
     sentinel = flight_recorder = None
+    # Divergence-rewind latch (graftguard): set by a sentinel sink on a
+    # fatal non-finite incident, consumed once per loop iteration. A
+    # dict, not a bare flag, so the sink closure and the loop share it.
+    rewind_state = {"pending": False, "count": 0, "targets": []}
     if step_stats.enabled:
       hooks.append(hooks_lib.StepStatsHook())
       if enable_sentinel:
@@ -464,9 +493,21 @@ def train_eval_model(
             hang_timeout_secs=watchdog_timeout_secs)
         incidents_path = os.path.join(model_dir,
                                       runlog_lib.INCIDENTS_FILENAME)
+
+        def _rewind_sink(record):
+          # AFTER the flight recorder in the sink order: the postmortem
+          # bundle for the incident is on disk before the rewind
+          # machinery touches anything.
+          if (rewind_on_divergence
+              and record.get("severity") == "fatal"
+              and record.get("kind") in (sentinel_lib.NONFINITE_METRIC,
+                                         sentinel_lib.NONFINITE_PARAMS)):
+            rewind_state["pending"] = True
+
         sentinel = sentinel_lib.Sentinel(sinks=[
             lambda record: runlog_lib.append_record(incidents_path, record),
-            flight_recorder.record_incident])
+            flight_recorder.record_incident,
+            _rewind_sink])
         # Order matters: the recorder must ring a window BEFORE the
         # sentinel sees it — a fatal incident dumps the bundle
         # synchronously from the sentinel's sink, and the bundle must
@@ -809,6 +850,11 @@ def train_eval_model(
       if _crossed(log_every_n_steps, prev_step, step) \
           or step == max_train_steps:
         scalars = {k: float(np.asarray(v)) for k, v in metrics.items()}
+        if faultlab_lib.maybe_fire(faultlab_lib.TRAIN_NONFINITE) is not None:
+          # Chaos seam: poison the host-side loss scalar exactly where
+          # a real divergence would surface — the sentinel's non-finite
+          # detector and the rewind below see the same signal either way.
+          scalars["loss"] = float("nan")
         if sentinel is not None:
           # The scalars were JUST fetched for logging anyway — the
           # non-finite check rides that fetch for free (the hook path
@@ -822,6 +868,80 @@ def train_eval_model(
         last_log = now
         last_log_step = step
         final_metrics = scalars
+      if rewind_state["pending"]:
+        # Divergence rewind (docstring): restore the newest VERIFIED
+        # checkpoint and continue, instead of dying on a NaN. Sits
+        # BEFORE the checkpoint cadence on purpose — the diverged state
+        # must never be saved. The postmortem bundle for the incident
+        # is already on disk (flight-recorder sink runs first).
+        rewind_state["pending"] = False
+        rewind_state["count"] += 1
+        rewind_started = time.perf_counter()
+        # Commit in-flight async saves first: the newest checkpoint may
+        # still be a tmp-named dir, invisible to the verified walk, and
+        # the rewind would wrongly escalate as "no verified checkpoint"
+        # (timing-dependent — seen on the loaded 1-core host).
+        manager.wait_until_finished()
+        target = manager.latest_verified_step()
+        if rewind_state["count"] > max(int(max_rewinds), 0) \
+            or target is None:
+          reason = ("rewind budget exhausted" if target is not None
+                    else "no verified checkpoint to rewind to")
+          if flight_recorder is not None:
+            flight_recorder.dump(f"rewind-escalation:{reason}")
+          raise RuntimeError(
+              f"graftguard: divergence at step {step} not recoverable "
+              f"({reason}; rewinds={rewind_state['count'] - 1}, "
+              f"max_rewinds={max_rewinds})")
+        logging.warning(
+            "graftguard: divergence at step %d — rewinding to verified "
+            "checkpoint step %d (rewind %d/%d)", step, target,
+            rewind_state["count"], max_rewinds)
+        if prefetcher is not None:
+          prefetcher.close()
+          prefetcher = None
+        _close_dataset(raw_train_dataset)
+        pending_host_batches.clear()
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding), state)
+        state = manager.restore(abstract_state=abstract)
+        step = int(state.step)
+        # Steps the restore walk just quarantined must become SAVEABLE
+        # again: leaving them in the dedup set would make _checkpoint
+        # skip re-saving them on the replay, leaving a checkpoint gap
+        # behind the rewind.
+        saved_steps.intersection_update(manager.all_steps())
+        rewind_state["targets"].append(step)
+        metrics_registry_lib.counter("train/rewinds").inc()
+        # Fresh, deterministically re-seeded stream: a rewound run and
+        # a clean resume from the same checkpoint consume the same
+        # records (the chaos bench's numerical-parity pin).
+        train_dataset = input_generator_train.create_dataset(
+            modes_lib.TRAIN)
+        raw_train_dataset = train_dataset
+        if step < max_train_steps:
+          with step_stats.data_wait():
+            placed, placed_k = _place_next(max_train_steps - step,
+                                           train_dataset)
+          if device_prefetch_depth:
+            prefetcher = mesh_lib.DevicePrefetcher(
+                _host_items(max_train_steps - step - placed_k,
+                            train_dataset),
+                mesh, place_fn=_place_item, depth=device_prefetch_depth,
+                close_source=True, source=raw_train_dataset)
+        metrics_registry_lib.histogram("train/rewind_ms").record(
+            (time.perf_counter() - rewind_started) * 1e3)
+        if sentinel is not None:
+          # Re-arm the non-finite latch: if the divergence recurs on the
+          # very first post-rewind observation (no finite value in
+          # between), the latch would otherwise swallow it and the run
+          # would complete "successfully" with NaNs instead of burning
+          # the rewind budget into the escalation above.
+          sentinel.reset_nonfinite_latch()
+        if flight_recorder is not None:
+          flight_recorder.touch()  # a restore is legitimate non-train time
+        continue
       if _crossed(checkpoint_every_n_steps, prev_step, step):
         _checkpoint(step)
       if manager.reached_preemption(step):
@@ -897,7 +1017,9 @@ def train_eval_model(
     hook.end(ctx)
   if step_stats.enabled:
     _append_run_record(model_dir, run_memory, final_metrics, step,
-                       sentinel=sentinel)
+                       sentinel=sentinel,
+                       rewinds=rewind_state["count"],
+                       rewind_steps=rewind_state["targets"])
   manager.wait_until_finished()
   manager.close()
   writer.close()
@@ -906,7 +1028,8 @@ def train_eval_model(
 
 def _append_run_record(model_dir: str, run_memory: dict,
                        final_metrics: dict, final_step: int,
-                       sentinel=None) -> None:
+                       sentinel=None, rewinds: int = 0,
+                       rewind_steps: Optional[List[int]] = None) -> None:
   """Appends this run's schema-versioned record to model_dir/runs.jsonl
   (`obs.runlog`): step-stat summary from the registry, xray compile
   records, memory accounting + HBM watermark estimate, final metrics,
@@ -944,6 +1067,14 @@ def _append_run_record(model_dir: str, run_memory: dict,
              "cache": excache_lib.cache_stats()}
     if sentinel is not None:
       extra["sentinel"] = sentinel.summary()
+    # graftguard: recovery accounting + the active fault plan's
+    # injection totals — a chaos run's record is attributable.
+    extra["graftguard"] = {"rewinds": int(rewinds),
+                           "rewind_steps": [int(s) for s in
+                                            (rewind_steps or [])]}
+    plan = faultlab_lib.active()
+    if plan is not None:
+      extra["faultlab"] = plan.summary()
     record = runlog_lib.make_record(
         "train",
         platform=device.platform,

@@ -446,6 +446,176 @@ class TestFleetHealthWiring:
       fleet.close()
 
 
+class TestFleetProbation:
+  """graftguard replica probation (ISSUE 13): eviction -> background
+  probe loop under the shared RetryPolicy -> auto-readmit, plus the
+  manual `mark_healthy` / `probe_replica` paths (previously untested)."""
+
+  def _probation_policy(self, **kwargs):
+    from tensor2robot_tpu.utils import retry as retry_lib
+
+    kwargs.setdefault("name", "fleet_probation")
+    kwargs.setdefault("max_attempts", 10)
+    kwargs.setdefault("base_delay_s", 0.01)
+    kwargs.setdefault("max_delay_s", 0.05)
+    return retry_lib.RetryPolicy(**kwargs)
+
+  def _wait_healthy(self, fleet, want, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+      if len(fleet.healthy_replicas()) >= want:
+        return True
+      time.sleep(0.01)
+    return False
+
+  def test_manual_mark_healthy_readmits_and_routes(self):
+    from tensor2robot_tpu.obs import metrics as metrics_lib
+
+    with metrics_lib.isolated() as registry:
+      fleet, engines = _make_fleet()
+      try:
+        fleet.mark_unhealthy(0, "operator drill")
+        assert fleet.healthy_replicas() == [1]
+        for _ in range(4):
+          fleet.predict(X1)
+        assert not engines[0].served_rows  # router steered around it
+        fleet.mark_healthy(0)
+        assert sorted(fleet.healthy_replicas()) == [0, 1]
+        for _ in range(8):
+          fleet.predict(X1)
+        assert engines[0].served_rows  # routed again
+      finally:
+        fleet.close()
+      snap = registry.snapshot(prefix="serve/fleet/")
+    # Eviction-to-readmission MTTR recorded even for the manual path.
+    assert snap["hist/serve/fleet/readmit_ms/count"] == 1.0
+
+  def test_manual_probe_replica_paths(self):
+    fleet, engines = _make_fleet()
+    try:
+      fleet.mark_unhealthy(1, "drill")
+      engines[1].fail = True
+      assert fleet.probe_replica(1, X1) is False  # failed probe: stays out
+      assert fleet.healthy_replicas() == [0]
+      engines[1].fail = False
+      assert fleet.probe_replica(1, X1) is True
+      assert sorted(fleet.healthy_replicas()) == [0, 1]
+    finally:
+      fleet.close()
+
+  def test_probation_auto_readmits_after_transient_failure(self):
+    from tensor2robot_tpu.obs import metrics as metrics_lib
+
+    with metrics_lib.isolated() as registry:
+      fleet, engines = _make_fleet(
+          probation_probe=lambda: X1,
+          probation_policy=self._probation_policy())
+      try:
+        engines[1].fail = True  # replica down: probes fail too
+        fleet.mark_unhealthy(1, "transient fault")
+        assert fleet.healthy_replicas() == [0]
+        time.sleep(0.05)  # a few failed probes accumulate
+        engines[1].fail = False  # fault clears; next probe readmits
+        assert self._wait_healthy(fleet, 2), fleet.replica_states()
+      finally:
+        fleet.close()
+      snap = registry.snapshot(prefix="serve/fleet/")
+    assert snap["counter/serve/fleet/probation_readmits"] == 1.0
+    assert snap["counter/serve/fleet/probation_probes"] >= 2.0
+    assert snap.get("counter/serve/fleet/probation_giveups", 0.0) == 0.0
+    assert snap["hist/serve/fleet/readmit_ms/count"] == 1.0
+
+  def test_probation_giveup_stays_evicted_until_manual(self):
+    from tensor2robot_tpu.obs import metrics as metrics_lib
+
+    with metrics_lib.isolated() as registry:
+      fleet, engines = _make_fleet(
+          probation_probe=lambda: X1,
+          probation_policy=self._probation_policy(max_attempts=2))
+      try:
+        engines[0].fail = True  # stays broken past the probe budget
+        fleet.mark_unhealthy(0, "hard fault")
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+          if registry.snapshot(prefix="serve/fleet/").get(
+              "counter/serve/fleet/probation_giveups"):
+            break
+          time.sleep(0.01)
+        snap = registry.snapshot(prefix="serve/fleet/")
+        assert snap["counter/serve/fleet/probation_giveups"] == 1.0
+        assert fleet.healthy_replicas() == [1]  # gave up, stays out
+        # The manual recovery half still works after a give-up.
+        engines[0].fail = False
+        assert fleet.probe_replica(0, X1) is True
+        assert sorted(fleet.healthy_replicas()) == [0, 1]
+      finally:
+        fleet.close()
+
+  def test_sentinel_roundtrip_readmit_rebalance_under_load(self):
+    """The full detect->recover round trip under open-loop load:
+    sentinel fatal incident -> eviction -> displaced session re-opens
+    on a healthy replica -> probation probe auto-readmits -> new
+    sessions re-balance onto the readmitted replica — with ZERO failed
+    requests in the concurrent open-loop window."""
+    from tensor2robot_tpu.obs import runlog as runlog_lib
+
+    fleet, engines = _make_fleet(
+        probation_probe=lambda: X1,
+        probation_policy=self._probation_policy())
+    try:
+      sid = fleet.open(session_key="robot-7")
+      owner = fleet.session_replica(sid)
+      assert owner is not None
+      survivor = 1 - owner
+      outcome: dict = {}
+
+      def choreography():
+        time.sleep(0.05)  # load window established
+        # 1. Fatal sentinel incident names the session's replica.
+        fleet.sentinel_sink()(runlog_lib.make_incident(
+            sentinel_lib.NONFINITE_PARAMS, step=7, severity="fatal",
+            detail={"replica": owner}))
+        outcome["evicted"] = fleet.replica_states()[owner]
+        # 2. The displaced session's next tick re-opens elsewhere.
+        out = fleet.step(sid, X1)
+        outcome["tick_ok"] = bool(np.asarray(out["out"]).shape)
+        outcome["reopened_on"] = fleet.session_replica(sid)
+        # 3. Probation auto-readmits (probes succeed: the fake engine
+        #    never actually broke — the incident was the fault).
+        outcome["readmitted"] = self._wait_healthy(fleet, 2)
+        # 4. New sessions re-balance: the readmitted replica accepts
+        #    an open again (its own affinity key routes back to it).
+        for i in range(64):
+          new_sid = fleet.open(session_key=f"rebalance-{i}")
+          if fleet.session_replica(new_sid) == owner:
+            outcome["rebalanced"] = True
+            break
+        else:
+          outcome["rebalanced"] = False
+
+      chaos = threading.Thread(target=choreography)
+      chaos.start()
+      result = loadgen.run_trace_load(
+          predict=fleet.predict, make_request=lambda i: X1,
+          num_arrivals=600, rate_hz=1500.0, profile="poisson", seed=3,
+          max_client_threads=16)
+      chaos.join(timeout=10.0)
+      assert not chaos.is_alive()
+      assert outcome["evicted"] == fleet_lib.UNHEALTHY
+      assert outcome["tick_ok"]
+      assert outcome["reopened_on"] == survivor  # never the dead replica
+      assert outcome["readmitted"], fleet.replica_states()
+      assert outcome["rebalanced"]
+      # The pin: the open-loop window saw ZERO failed requests across
+      # the whole eviction->readmission cycle (failover + the healthy
+      # replica absorbed everything).
+      assert result["errors"] == {}
+      assert result["ok_requests"] == result["arrivals"]
+      assert sorted(fleet.healthy_replicas()) == [0, 1]
+    finally:
+      fleet.close()
+
+
 # ---------------------------------------------------------------------------
 # Rollout (backend-free fakes; the real-checkpoint pin is below).
 # ---------------------------------------------------------------------------
