@@ -74,6 +74,19 @@ per-fault-class MTTR), diff-gated via `scripts/chaos_bench.sh`
 (PERFORMANCE.md "Reading a chaos bench"); an unrecovered fault class
 exits 3.
 
+graftloop (ISSUE 14): `bench.py --loop` runs the seeded chaos storm
+over the WHOLE always-on actor/learner loop (`tensor2robot_tpu.loop`)
+— paired clean/chaos arms of collect-train-publish-rollout on the
+pose toy task, the chaos arm injecting an actor kill, a learner NaN
+divergence (rewound mid-collection), a torn published checkpoint
+(REFUSED publication by the manifest walk), and a replica-eviction
+dispatch burst (probation-readmitted) — headlining
+`loop_goodput_ratio` (chaos/clean collection episodes/s; acceptance
+floor 0.8) and `publish_to_serve_ms`, with the no-unverified-serve
+audit and the staleness bound pinned; diff-gated via
+`scripts/loop_bench.sh` (PERFORMANCE.md "Reading a loop bench"); an
+unrecovered fault class exits 3.
+
 graftcache (PR 7): every probe routes trace->compile through the
 persistent executable cache at GRAFTCACHE_DIR (default `.graftcache`),
 so re-benching an unchanged config deserializes instead of recompiling;
@@ -2500,6 +2513,223 @@ def chaos_main() -> None:
     sys.exit(3)
 
 
+# graftloop chaos bench config (bench.py --loop): one seed drives every
+# fault decision, so a loop storm is reproducible fault-for-fault.
+LOOP_SEED = 17
+LOOP_ACTORS = 2
+LOOP_REPLICAS = 2
+LOOP_STEPS_PER_ROUND = 10
+LOOP_ROUNDS = 3
+# Log-fetch arrival of the injected NaN (log every step, arrivals
+# accumulate across the learner's rounds): 13 = step 14, round 2 —
+# AFTER the round-1 step-10 save, so the divergence rewind has a
+# verified target while collection keeps serving the published v10.
+LOOP_NONFINITE_AT = 13
+# Save arrival of the torn checkpoint: 2 = the round-3 step-30 save —
+# the manifest is written from the good bytes then the step is torn, so
+# the publisher's verification walk must REFUSE it (the fleet keeps
+# serving step 20; nothing unverified ever reaches an actor).
+LOOP_TORN_SAVE_AT = 2
+# ISSUE 14 acceptance floor: chaos-arm collection goodput vs clean.
+LOOP_GOODPUT_FLOOR = 0.8
+LOOP_WALL_TIMEOUT_S = 420.0
+
+
+def loop_main() -> None:
+  """graftloop chaos bench: ONE JSON headline line (CPU smoke path).
+
+  Paired clean/chaos arms of the WHOLE always-on loop — an actor pool
+  collecting pose-task episodes through a 2-replica ServingFleet into
+  the bounded replay sink, the learner training in rounds and
+  publishing verified checkpoints that hot-swap into the fleet — with
+  the chaos arm running a SEEDED four-fault storm (actor kill, learner
+  NaN divergence, torn published checkpoint, replica-eviction dispatch
+  burst) that must recover with ZERO operator intervention:
+
+  * collection goodput (episodes/s) >= LOOP_GOODPUT_FLOOR x the clean
+    arm (`loop_goodput_ratio`, the headline value);
+  * NO unverified checkpoint ever served: the served-version audit is
+    empty in BOTH arms and the torn step was explicitly REFUSED
+    (publish_rejected >= 1 in the chaos arm, pinned by re-verifying
+    the torn step's manifest verdict);
+  * the staleness bound held (no action from a policy > K published
+    versions behind);
+  * the learner reached its training target through the rewind, every
+    eviction was probation-readmitted, and no worker escalated to
+    FAILED.
+
+  Headline gates (`scripts/loop_bench.sh`): `loop_goodput_ratio`
+  (down-bad) and `publish_to_serve_ms` (deploy latency, up-bad loose
+  wall-clock band); `publish_to_first_action_ms` rides along in the
+  headline. `all_recovered` false exits 3 — an unrecovered fault class
+  is an acceptance failure, not a diff question.
+  """
+  flags = os.environ.get("XLA_FLAGS", "")
+  if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+  backend_lib.pin_cpu()
+  backend_lib.assert_cpu_backend()
+  import shutil
+
+  import jax
+
+  from tensor2robot_tpu import checkpoints as checkpoints_lib
+  from tensor2robot_tpu.envs import pose_env
+  from tensor2robot_tpu.loop import loop as loop_lib
+  from tensor2robot_tpu.obs import faultlab
+  from tensor2robot_tpu.policies import policies as policies_lib
+  from tensor2robot_tpu.research.pose_env import models as pose_models
+
+  device = jax.devices()[0]
+  total_steps = LOOP_STEPS_PER_ROUND * LOOP_ROUNDS
+
+  def run_arm(faulted: bool, root: str) -> dict:
+    plan = None
+    if faulted:
+      plan = faultlab.FaultPlan([
+          # Actor 0 dies mid-collection: the supervisor's restart path.
+          faultlab.FaultSpec(point=faultlab.LOOP_ACTOR_CRASH, key=0,
+                             at=(5,), count=1),
+          # NaN divergence in learner round 2: graftguard rewind to the
+          # published step-10 checkpoint — collection must NOT stop.
+          faultlab.FaultSpec(point=faultlab.TRAIN_NONFINITE,
+                             at=(LOOP_NONFINITE_AT,), count=1),
+          # Torn step-30 save: the publish path must refuse it.
+          faultlab.FaultSpec(point=faultlab.CKPT_TORN,
+                             at=(LOOP_TORN_SAVE_AT,), count=1),
+          # Six consecutive dispatch failures on replica 1 (>= the
+          # unhealthy_after=3 streak; 6 because a success completing
+          # between two failure recordings legitimately resets it):
+          # eviction mid-loop, probation must auto-readmit.
+          faultlab.FaultSpec(point=faultlab.SERVE_DISPATCH, key=1,
+                             at=tuple(range(40, 46)), count=6),
+      ], seed=LOOP_SEED)
+    with obs_metrics.isolated() as registry:
+      graft_loop = loop_lib.GraftLoop(
+          model_factory=lambda: pose_models.PoseEnvContinuousMCModel(
+              device_type="cpu"),
+          model_dir=root,
+          env_factory=lambda i: pose_env.PoseToyEnv(seed=i),
+          policy_factory=lambda fleet: policies_lib.CEMPolicy(
+              predictor=fleet, action_size=2, cem_samples=8,
+              cem_iterations=2, cem_elites=3, seed=0),
+          episode_to_transitions_fn=pose_env.episode_to_transitions,
+          num_actors=LOOP_ACTORS, num_replicas=LOOP_REPLICAS,
+          max_batch_size=8, train_batch_size=16,
+          steps_per_round=LOOP_STEPS_PER_ROUND, num_rounds=LOOP_ROUNDS,
+          max_staleness_versions=1, replay_max_bytes=64 << 20,
+          episodes_per_shard=8, max_episode_steps=2,
+          # Collection pacing (both arms, so the pair stays fair): on
+          # this 1-core host an unthrottled warm actor pool starves the
+          # learner of the GIL and round 1 never finishes.
+          actor_pause_s=0.05, seed=LOOP_SEED)
+      if plan is not None:
+        faultlab.activate(plan)
+      try:
+        summary = graft_loop.run(wall_timeout_s=LOOP_WALL_TIMEOUT_S)
+      finally:
+        if plan is not None:
+          faultlab.deactivate()
+      snap = registry.snapshot()
+    summary["injected"] = plan.summary() if plan is not None else None
+    summary["learner_rewinds"] = snap.get(
+        "counter/loop/learner_rewinds", 0.0)
+    summary["evictions"] = snap.get("counter/serve/fleet/unhealthy", 0.0)
+    summary["probation_readmits"] = snap.get(
+        "counter/serve/fleet/probation_readmits", 0.0)
+    summary["worker_downtime_ms_max"] = snap.get(
+        "hist/loop/worker_downtime_ms/max")
+    summary["final_checkpoint_step"] = checkpoints_lib.latest_step(
+        os.path.join(root, loop_lib.CHECKPOINT_DIRNAME))
+    return summary
+
+  loop_root = tempfile.mkdtemp(prefix="loop-bench-")
+  try:
+    print("bench-loop: clean arm (collect/train/publish, no faults)",
+          file=sys.stderr)
+    clean = run_arm(False, os.path.join(loop_root, "clean"))
+    print(f"bench-loop: clean {clean['episodes']} episodes in "
+          f"{clean['wall_sec']}s ({clean['episodes_per_sec']}/s), "
+          f"{clean['publishes']} publishes", file=sys.stderr)
+    print("bench-loop: chaos arm (actor kill + NaN rewind + torn "
+          "publish + replica eviction)", file=sys.stderr)
+    chaos = run_arm(True, os.path.join(loop_root, "chaos"))
+    print(f"bench-loop: chaos {chaos['episodes']} episodes in "
+          f"{chaos['wall_sec']}s ({chaos['episodes_per_sec']}/s), "
+          f"{chaos['publishes']} publishes, "
+          f"{chaos['publish_rejected']:.0f} rejected, "
+          f"{chaos['worker_restarts']:.0f} restarts", file=sys.stderr)
+
+    # The torn step must be provably the one the manifest walk refused:
+    # its verdict re-checked from disk is False, and it never appears in
+    # the served-version audit.
+    torn_verdict = checkpoints_lib.verify_step_files(
+        os.path.join(loop_root, "chaos", loop_lib.CHECKPOINT_DIRNAME),
+        total_steps)
+    # A wedged clean arm (zero episodes) must FAIL the goodput gate,
+    # not vacuously pass it as ratio=inf (which strict-JSON consumers
+    # also choke on): ratio 0.0 trips the down-bad floor loudly.
+    goodput_ratio = (chaos["episodes_per_sec"] / clean["episodes_per_sec"]
+                     if clean["episodes_per_sec"] > 0 else 0.0)
+    recovered = {
+        # Supervisor restarted the killed actor; nobody escalated.
+        "actor_crash": bool(
+            chaos["worker_restarts"] >= 1
+            and chaos["worker_escalations"] == 0
+            and "failed" not in chaos["worker_states"].values()),
+        # The rewind happened AND the learner still reached its target.
+        "learner_rewind": bool(
+            chaos["learner_rewinds"] >= 1
+            and (chaos["final_checkpoint_step"] or 0) >= total_steps),
+        # The torn checkpoint was refused, and no unverified version was
+        # ever acted on (in either arm — the clean arm pins the audit's
+        # baseline).
+        "torn_publish": bool(
+            chaos["publish_rejected"] >= 1 and torn_verdict is False
+            and not chaos["unverified_served"]
+            and not clean["unverified_served"]),
+        # The dispatch burst evicted, probation readmitted every one.
+        "replica_eviction": bool(
+            chaos["evictions"] >= 1
+            and chaos["probation_readmits"] >= chaos["evictions"]),
+        # The staleness bound held under the storm.
+        "staleness_bound": bool(chaos["staleness_bound_held"]
+                                and clean["staleness_bound_held"]),
+        "goodput": bool(goodput_ratio >= LOOP_GOODPUT_FLOOR),
+    }
+    all_recovered = all(recovered.values())
+    headline = {
+        "metric": "qtopt_loop_cpu_smoke",
+        "value": round(goodput_ratio, 3),
+        "unit": "chaos/clean collection goodput ratio",
+        "loop_goodput_ratio": round(goodput_ratio, 3),
+        "publish_to_serve_ms": chaos["publish_to_serve_ms_max"],
+        "publish_to_first_action_ms": chaos[
+            "publish_to_first_action_ms_max"],
+        "worker_downtime_ms": chaos["worker_downtime_ms_max"],
+        "all_recovered": all_recovered,
+        "recovered": recovered,
+        "goodput_floor": LOOP_GOODPUT_FLOOR,
+        "seed": LOOP_SEED,
+        "clean": clean,
+        "chaos": chaos,
+        "device_kind": device.device_kind,
+        "platform": device.platform,
+        "host_load": _host_load_block(),
+        "graftscope": _graftscope_block(),
+    }
+    print(json.dumps(headline))
+    _write_runlog(headline, platform=device.platform,
+                  device_kind=device.device_kind)
+    if not all_recovered:
+      print("bench-loop: ACCEPTANCE FAILURE — not every fault class "
+            f"recovered: {recovered}", file=sys.stderr)
+      sys.exit(3)
+  finally:
+    shutil.rmtree(loop_root, ignore_errors=True)
+
+
 def main() -> None:
   if len(sys.argv) >= 2 and sys.argv[1] == "--probe":
     _probe_child_entry(sys.argv[2], sys.argv[3])
@@ -2522,6 +2752,9 @@ def main() -> None:
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
     chaos_main()
+    return
+  if len(sys.argv) >= 2 and sys.argv[1] == "--loop":
+    loop_main()
     return
   if len(sys.argv) >= 2 and sys.argv[1] == "--data":
     data_main()

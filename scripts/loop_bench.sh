@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# graftloop chaos bench + regression gate (ISSUE 14).
+#
+# `bench.py --loop` runs the seeded four-fault storm (actor kill,
+# learner NaN rewind, torn published checkpoint, replica eviction) over
+# the WHOLE always-on actor/learner loop (qtopt_loop_cpu_smoke,
+# PERFORMANCE.md "Reading a loop bench") and EXITS 3 ITSELF when any
+# fault class fails to recover, when the served-version audit finds an
+# unverified checkpoint, or when the staleness bound breaks — the
+# acceptance gate is the bench's own exit code, the diff below prices
+# round-over-round drift on top of it:
+#
+#   loop_goodput_ratio  — chaos/clean collection goodput (episodes/s)
+#                         under the storm (down-bad 15%; back-to-back
+#                         arms make it load-invariant),
+#   publish_to_serve_ms — checkpoint-verified to rollout-complete
+#                         deploy latency (up-bad 50% — wall-clock on
+#                         the 1-core host, same loose band as
+#                         warmup_ms).
+#
+# A regression in either exits non-zero exactly like a training one.
+#
+# Usage: scripts/loop_bench.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUNS="${GRAFTSCOPE_RUNS:-runs.jsonl}"
+
+# Diff the last two records whose bench metric contains $1 (no-op with
+# exit 0 when this was the family's first record — nothing to diff).
+# The index lookup runs OUTSIDE a process substitution so a failure
+# (unreadable runs.jsonl, broken import) fails the script loudly
+# instead of reading as "no baseline" and silently skipping the gate.
+gate_family() {
+  local family="$1"
+  shift
+  local idx_out
+  idx_out=$(JAX_PLATFORMS=cpu python - "$RUNS" "$family" <<'EOF'
+import sys
+from tensor2robot_tpu.obs import runlog
+records = runlog.load_records(sys.argv[1])
+data = [i for i, r in enumerate(records)
+        if sys.argv[2] in str((r.get("bench") or {}).get("metric", ""))]
+for i in data[-2:]:
+    print(i)
+EOF
+  ) || { echo "loop_bench: runs.jsonl index lookup failed" >&2; return 1; }
+  local idx=()
+  [ -n "$idx_out" ] && mapfile -t idx <<< "$idx_out"
+  if [ "${#idx[@]}" -lt 2 ]; then
+    echo "loop_bench: first '$family' record in $RUNS; no diff baseline" >&2
+    return 0
+  fi
+  JAX_PLATFORMS=cpu python -m tensor2robot_tpu.bin.graftscope diff \
+      "$RUNS#${idx[0]}" "$RUNS#${idx[1]}" "$@"
+}
+
+# The bench itself exit-code-gates recovery (3 = a fault class did not
+# recover / the audit failed); set -e propagates it before any diff
+# runs.
+JAX_PLATFORMS=cpu python bench.py --loop
+
+# The loop family gates on its two purpose-built metrics; every other
+# wall-clock in the record swings with host load on this VM, so those
+# absolute thresholds are opened wide rather than training people to
+# ignore a flappy gate.
+gate_family qtopt_loop \
+    --threshold examples_per_sec=10.0 --threshold compile_time_s=10.0 \
+    --threshold flops_per_step=10.0 --threshold bytes_per_step=10.0 \
+    --threshold jaxpr_eqns=10.0 --threshold warmup_ms=10.0

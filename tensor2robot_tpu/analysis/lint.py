@@ -28,10 +28,10 @@ import sys
 from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       fleet_check, native_check, pp_check,
-                                       retry_check, session_check,
-                                       spec_check, thread_check,
-                                       tracer_check)
+                                       fleet_check, loop_check,
+                                       native_check, pp_check, retry_check,
+                                       session_check, spec_check,
+                                       thread_check, tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -109,6 +109,14 @@ fleet rules (.py):
                          (the tunnel-safe join discipline the batchers
                          follow, mechanized for the fleet layer)
 
+loop rules (.py, the loop/ package only):
+  unsupervised-loop-worker a bare threading.Thread construction in a
+                         loop-package module other than supervisor.py —
+                         the worker is outside the supervisor's restart/
+                         heartbeat/escalation machinery (dies silently,
+                         hangs invisibly); register it with
+                         Supervisor.spawn instead
+
 thread rules (.py):
   thread-stage-missing-close     a class starts a threading.Thread but
                          defines no close() — its worker can never be
@@ -179,6 +187,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(fleet_check.check_python_file(path))
     findings.extend(retry_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
+    findings.extend(loop_check.check_python_file(path))
     # A native-package wrapper pulls in the export/binding coverage
     # check for its whole directory (.cc sources aren't walked
     # directly — the wrapper is the unit whose drift matters).

@@ -1,0 +1,39 @@
+"""graftloop CLI: the always-on async actor/learner loop, from config.
+
+Reference twin: the SEPARATE collect/eval + trainer binaries the
+reference decoupled through SavedModel exports
+(/root/reference/bin/run_collect_eval.py:40-43, README.md:44-51) — here
+ONE supervised process runs actors, learner, and continuous deployment
+(`tensor2robot_tpu.loop.run_graftloop`).
+
+Usage:
+  python -m tensor2robot_tpu.bin.run_graftloop \
+      --config_files tensor2robot_tpu/configs/loop_qtopt.gin \
+      --config "run_graftloop.model_dir = '/tmp/loop1'"
+"""
+
+from __future__ import annotations
+
+import json
+
+from absl import app, flags
+
+from tensor2robot_tpu.loop import loop as loop_lib
+from tensor2robot_tpu.utils import config
+
+FLAGS = flags.FLAGS
+flags.DEFINE_multi_string("config_files", [],
+                          "Config (.gin) files to parse.")
+flags.DEFINE_multi_string("config", [],
+                          "Individual binding strings, applied last.")
+
+
+def main(argv):
+  del argv
+  config.parse_config_files_and_bindings(FLAGS.config_files, FLAGS.config)
+  summary = loop_lib.run_graftloop()
+  print(json.dumps(summary, default=str))
+
+
+if __name__ == "__main__":
+  app.run(main)

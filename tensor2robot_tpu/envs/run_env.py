@@ -44,8 +44,19 @@ def run_env(env=config.REQUIRED,
             tag: str = "collect",
             episode_to_transitions_fn: Optional[EpisodeToTransitionsFn] = None,
             replay_writer: Optional[writer_lib.TFRecordReplayWriter] = None,
-            max_episode_steps: Optional[int] = None) -> Dict[str, float]:
-  """Runs episodes; returns aggregate reward stats."""
+            max_episode_steps: Optional[int] = None,
+            log_stats: bool = True) -> Dict[str, float]:
+  """Runs episodes; returns aggregate reward stats.
+
+  Episode-teardown contract: an env/policy exception mid-episode still
+  releases the policy's serving-side episode state
+  (`Policy.abort_episode` — a session-backed policy closes its
+  server-side slot; one leaked slot per crashed episode is
+  denial-of-service under shed admission). The exception then
+  propagates unchanged; aborted episodes are counted
+  (`env/aborted_episodes`). `log_stats=False` silences the per-call
+  info log for high-frequency callers (the graftloop actor pool calls
+  this once per episode)."""
   explore_prob = (explore_schedule(global_step) if explore_schedule
                   else 0.0)
   episode_rewards: List[float] = []
@@ -57,23 +68,37 @@ def run_env(env=config.REQUIRED,
     with obs_trace.span("env/episode", cat="env", tag=tag,
                         episode=episode_idx), \
         obs_metrics.histogram("env/episode_ms").time_ms():
-      policy.reset()
-      obs, _ = env.reset()
-      episode: List[Dict[str, Any]] = []
-      total_reward, steps, done = 0.0, 0, False
-      while not done:
-        action = policy.sample_action(obs, explore_prob=explore_prob)
-        q = getattr(policy, "last_q_value", None)
-        if q is not None:
-          q_values.append(float(q))
-        next_obs, reward, terminated, truncated, info = env.step(action)
-        episode.append({"obs": obs, "action": action, "reward": reward,
-                        "done": terminated or truncated, "info": info})
-        total_reward += float(reward)
-        obs = next_obs
-        steps += 1
-        done = terminated or truncated or (
-            max_episode_steps is not None and steps >= max_episode_steps)
+      try:
+        policy.reset()
+        obs, _ = env.reset()
+        episode: List[Dict[str, Any]] = []
+        total_reward, steps, done = 0.0, 0, False
+        while not done:
+          action = policy.sample_action(obs, explore_prob=explore_prob)
+          q = getattr(policy, "last_q_value", None)
+          if q is not None:
+            q_values.append(float(q))
+          next_obs, reward, terminated, truncated, info = env.step(action)
+          episode.append({"obs": obs, "action": action, "reward": reward,
+                          "done": terminated or truncated, "info": info})
+          total_reward += float(reward)
+          obs = next_obs
+          steps += 1
+          done = terminated or truncated or (
+              max_episode_steps is not None and steps >= max_episode_steps)
+      except BaseException:
+        # The episode is dead, but the policy's serving-side state must
+        # not outlive it: without this close a session-backed policy
+        # leaks its server slot on every env crash (the episode audit,
+        # ISSUE 14). The error itself propagates unchanged.
+        obs_metrics.counter("env/aborted_episodes").inc()
+        abort = getattr(policy, "abort_episode", None)
+        if abort is not None:
+          try:
+            abort()
+          except Exception:  # noqa: BLE001 - teardown must not mask the error
+            logging.exception("run_env: abort_episode failed")
+        raise
       episode_rewards.append(total_reward)
       episode_lengths.append(steps)
       if replay_writer is not None and episode_to_transitions_fn is not None:
@@ -93,7 +118,8 @@ def run_env(env=config.REQUIRED,
                                          use_tensorboard=False)
     writer.write_scalars(global_step, stats)
     writer.close()
-  logging.info("run_env[%s] @%d: %s", tag, global_step, stats)
+  if log_stats:
+    logging.info("run_env[%s] @%d: %s", tag, global_step, stats)
   return stats
 
 

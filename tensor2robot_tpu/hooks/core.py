@@ -65,6 +65,13 @@ class Hook:
   def after_checkpoint(self, ctx: TrainContext, step: int) -> None:
     pass
 
+  def after_rewind(self, ctx: TrainContext, step: int) -> None:
+    """Called after a graftguard divergence REWIND restored a verified
+    checkpoint (`step` = the step now resumed from). The coordination
+    seam an always-on loop needs: a publisher hook drops pending
+    publishes above the rewind target; collection-side consumers learn
+    the learner stepped back without the run dying."""
+
   def after_eval(self, ctx: TrainContext, step: int,
                  metrics: Mapping[str, Any]) -> None:
     pass

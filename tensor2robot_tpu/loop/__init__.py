@@ -1,0 +1,33 @@
+"""graftloop: always-on async actor/learner loop (ISSUE 14).
+
+The reference decoupled collection from learning via SavedModel export
+plus separate collect/eval binaries (/root/reference/README.md:44-51,
+bin/run_collect_eval.py); this package closes that loop IN-PROCESS and
+supervised: an actor pool runs env episodes through policies served by
+`serving.ServingFleet`, streaming episodes into a bounded byte-capped
+replay/record sink that the learner's record pipeline consumes, while
+the learner trains continuously and publishes VERIFIED checkpoints that
+hot-swap into the fleet via `rollout()` mid-flight.
+
+Modules:
+  supervisor  worker registration/heartbeat/restart under the shared
+              `utils.retry.RetryPolicy` with escalation budgets
+  replay      bounded byte-capped TFRecord episode sink (backpressure +
+              shed accounting)
+  publish     checkpoint verify -> fleet rollout, publish/rollout fence
+  actor       the per-actor episode loop with policy-staleness bounds
+  loop        `GraftLoop` orchestration + the `run_graftloop`
+              configurable entry point
+
+All modules are backend-free at import (jax only inside factories the
+caller provides); tests/test_loop.py runs the supervisor, sink,
+publisher fence and staleness machinery under a poisoned JAX_PLATFORMS.
+"""
+
+from tensor2robot_tpu.loop.actor import EpisodeActor
+from tensor2robot_tpu.loop.publish import CheckpointPublisher
+from tensor2robot_tpu.loop.replay import ReplayRecordSink
+from tensor2robot_tpu.loop.supervisor import Supervisor, WorkerHandle
+
+__all__ = ["Supervisor", "WorkerHandle", "ReplayRecordSink",
+           "CheckpointPublisher", "EpisodeActor"]

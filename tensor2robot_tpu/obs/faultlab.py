@@ -24,6 +24,12 @@ Injection points (the seam that checks each one is named in situ):
                        loader's preprocess stage
   serve.dispatch       per-replica dispatch failure (`ServingFleet`)
   serve.latency        per-replica latency spike (spec.arg = ms)
+  loop.actor_crash     actor-process death inside the graftloop episode
+                       loop (`loop/actor.py`; key = actor index) — the
+                       supervisor's restart path is the seam under test
+  loop.actor_hang      actor heartbeat stall (spec.arg = seconds the
+                       actor sleeps without beating) — drives the
+                       supervisor's hang detection
   ckpt.torn            torn (truncated) checkpoint file right after
                        `CheckpointManager.save`
   ckpt.bitflip         single flipped byte in a checkpoint file after
@@ -61,10 +67,10 @@ from tensor2robot_tpu.obs import metrics as metrics_lib
 
 __all__ = ["FaultSpec", "FaultPlan", "activate", "deactivate", "active",
            "maybe_fire", "InjectedIOError", "InjectedDispatchError",
-           "InjectedPreprocessError",
+           "InjectedPreprocessError", "InjectedActorCrash",
            "DATA_RECORD_IO", "DATA_CORRUPT_RECORD", "DATA_PREPROCESS",
            "SERVE_DISPATCH", "SERVE_LATENCY", "CKPT_TORN", "CKPT_BITFLIP",
-           "TRAIN_NONFINITE"]
+           "TRAIN_NONFINITE", "LOOP_ACTOR_CRASH", "LOOP_ACTOR_HANG"]
 
 DATA_RECORD_IO = "data.record_io"
 DATA_CORRUPT_RECORD = "data.corrupt_record"
@@ -74,11 +80,13 @@ SERVE_LATENCY = "serve.latency"
 CKPT_TORN = "ckpt.torn"
 CKPT_BITFLIP = "ckpt.bitflip"
 TRAIN_NONFINITE = "train.nonfinite"
+LOOP_ACTOR_CRASH = "loop.actor_crash"
+LOOP_ACTOR_HANG = "loop.actor_hang"
 
 KNOWN_POINTS = frozenset({
     DATA_RECORD_IO, DATA_CORRUPT_RECORD, DATA_PREPROCESS,
     SERVE_DISPATCH, SERVE_LATENCY, CKPT_TORN, CKPT_BITFLIP,
-    TRAIN_NONFINITE})
+    TRAIN_NONFINITE, LOOP_ACTOR_CRASH, LOOP_ACTOR_HANG})
 
 # Remembered fire events per plan (attribution, not accounting — the
 # registry counters are unbounded).
@@ -96,6 +104,10 @@ class InjectedDispatchError(RuntimeError):
 
 class InjectedPreprocessError(ValueError):
   """Injected preprocess-stage exception."""
+
+
+class InjectedActorCrash(RuntimeError):
+  """Injected graftloop actor death (the supervisor must restart)."""
 
 
 @dataclasses.dataclass(frozen=True)

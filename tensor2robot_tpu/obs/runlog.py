@@ -133,6 +133,18 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # 1-core host — wall-clock, so it gets the loose band warmup_ms has.
     "chaos_goodput_ratio": ("down", 0.15),
     "chaos_recovery_ms": ("up", 0.50),
+    # graftloop gates (bench.py --loop / scripts/loop_bench.sh,
+    # PERFORMANCE.md "Reading a loop bench"): loop_goodput_ratio is the
+    # paired chaos/clean COLLECTION goodput ratio (episodes/s) with the
+    # full actor/learner/deploy loop under the seeded storm
+    # (back-to-back arms => load-invariant; ISSUE 14 acceptance floor
+    # 0.8 — a drop means actor restarts / staleness drains / publish
+    # stalls started costing collection). publish_to_serve_ms is the
+    # deploy-latency half of the continuous-deployment headline
+    # (checkpoint-verified to rollout-complete) — wall-clock on the
+    # 1-core host, so the loose warmup_ms band.
+    "loop_goodput_ratio": ("down", 0.15),
+    "publish_to_serve_ms": ("up", 0.50),
 }
 
 

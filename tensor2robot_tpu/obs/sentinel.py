@@ -79,6 +79,17 @@ SLO_BREACH = "serving_slo_breach"
 # "reason": ...}; the fleet's sinks + the flight recorder both consume
 # these through the standard incident fan-out.
 REPLICA_UNHEALTHY = "replica_unhealthy"
+# Emitted by the graftloop supervisor (`loop/supervisor.py`): a worker
+# restart after a crash/hang (warn — the loop self-healed) and a worker
+# whose restart budget exhausted (fatal — the loop is degraded until an
+# operator intervenes). detail carries {"worker": name, "reason": ...}.
+LOOP_WORKER_RESTART = "loop_worker_restart"
+LOOP_WORKER_LOST = "loop_worker_lost"
+# Emitted by the graftloop publisher (`loop/publish.py`) when a
+# just-saved checkpoint FAILS the manifest verification walk and is
+# refused publication (warn — actors keep serving the last verified
+# version; the learner's own verified-restore walk quarantines it).
+LOOP_PUBLISH_REJECTED = "loop_publish_rejected"
 
 
 @dataclasses.dataclass(frozen=True)

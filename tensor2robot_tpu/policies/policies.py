@@ -61,6 +61,14 @@ class Policy(abc.ABC):
   def reset(self) -> None:
     """Per-episode state reset."""
 
+  def abort_episode(self) -> None:
+    """Mid-episode teardown: release any serving-side episode state
+    WITHOUT touching the predictor. Called by `envs.run_env` when the
+    env (or the policy itself) raises mid-episode — a session-backed
+    policy must close its server-side session slot here (a leaked slot
+    per crashed episode is denial-of-service under shed admission);
+    stateless policies have nothing to do."""
+
   def restore(self) -> bool:
     if self._predictor is not None:
       ok = self._predictor.restore()
@@ -242,6 +250,14 @@ class SessionRegressionPolicy(Policy):
   def reset(self) -> None:
     self._close_session()
     self._session_id = self._predictor.open()
+
+  def abort_episode(self) -> None:
+    """Mid-episode teardown (env crashed under `run_env`): the episode
+    will not resume, so the server-side slot must be freed NOW — the
+    next `reset()` starts clean either way, but without this close the
+    slot leaks until LRU pressure or engine close (one leaked slot per
+    crashed episode starves admission='shed' engines)."""
+    self._close_session()
 
   def _close_session(self) -> None:
     if self._session_id is None:

@@ -107,6 +107,8 @@ JAX_PLATFORMS).
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
 import time
 import zlib
@@ -221,7 +223,10 @@ class ServingFleet:
                sinks: Optional[List[Callable[[Dict[str, Any]], Any]]] = None,
                probation_probe: Optional[
                    Callable[[], Mapping[str, Any]]] = None,
-               probation_policy: Optional[retry_lib.RetryPolicy] = None):
+               probation_policy: Optional[retry_lib.RetryPolicy] = None,
+               autoscale_window_s: float = 30.0,
+               autoscale_sample_s: float = 0.25,
+               autoscale_target_utilization: float = 0.5):
     if replica_factory is None:
       raise ValueError("replica_factory is required.")
     if num_replicas < 1:
@@ -249,6 +254,23 @@ class ServingFleet:
     self._probation_thread: Optional[threading.Thread] = None
     self._probation_wake = threading.Event()
     self._evicted_at: Dict[int, float] = {}
+    # Advisory-autoscale load window (recommended_replicas): samples of
+    # (t, cumulative requests, cumulative queue-bound sheds, router-wide
+    # outstanding) appended on the routing hot path at most once per
+    # `autoscale_sample_s` — one time check + deque append per sample,
+    # nothing per request.
+    self._autoscale_window_s = float(autoscale_window_s)
+    self._autoscale_sample_s = float(autoscale_sample_s)
+    self._autoscale_target_util = float(autoscale_target_utilization)
+    if not 0.0 < self._autoscale_target_util <= 1.0:
+      raise ValueError("autoscale_target_utilization must be in (0, 1], "
+                       f"got {autoscale_target_utilization}")
+    self._load_requests = 0
+    self._load_sheds = 0
+    self._load_samples: collections.deque = collections.deque(
+        maxlen=max(int(math.ceil(autoscale_window_s
+                                 / max(autoscale_sample_s, 1e-3))) + 2, 8))
+    self._last_sample_s = 0.0
     groups: List[Any]
     if devices is not None:
       from tensor2robot_tpu.parallel import mesh as mesh_lib
@@ -334,6 +356,69 @@ class ServingFleet:
 
     return engine_lib.traffic_bucket_ladder(
         engine_lib.observed_request_rows(), max_batch_size, **kwargs)
+
+  # -- advisory autoscale (ROADMAP item 1 remainder) ------------------------
+
+  def _sample_load_locked(self, now: float) -> None:
+    """Appends one load-window sample at most every
+    `autoscale_sample_s` (called on the routing hot path under the
+    lock: one time comparison per request, one deque append per
+    interval)."""
+    if now - self._last_sample_s < self._autoscale_sample_s:
+      return
+    self._last_sample_s = now
+    self._load_samples.append(
+        (now, self._load_requests, self._load_sheds,
+         sum(r.outstanding for r in self._replicas)))
+
+  def recommended_replicas(self,
+                           window_s: Optional[float] = None) -> int:
+    """ADVISORY replica-count recommendation from the shed/occupancy/
+    outstanding counters over a sliding window — no actuation (ROADMAP
+    item 1 names the actuation policy as the next slice; this is the
+    signal an autoscaler or an operator dashboard consumes, exported as
+    the `serve/fleet/recommended_replicas` gauge).
+
+    The signal, over the samples inside `window_s` (default: the
+    constructor's `autoscale_window_s`):
+
+    * mean router-wide OUTSTANDING work, sized against the per-replica
+      queue-depth bound at `autoscale_target_utilization` (default
+      0.5): `ceil(mean_outstanding / (target_util * shed_outstanding))`
+      replicas keep steady-state occupancy at the target — a diurnal
+      peak reads high, the trough reads low;
+    * queue-bound SHEDS in the window are a hard under-capacity signal:
+      any shedding recommends at least one replica more than currently
+      healthy (backpressure means the bound already fired — occupancy
+      alone underestimates demand that was refused).
+
+    Never recommends below 1 or below what an in-window shed proves is
+    needed; with no traffic in the window it recommends the current
+    healthy count (no signal = no change).
+    """
+    window = self._autoscale_window_s if window_s is None else window_s
+    now = time.monotonic()
+    with self._lock:
+      self._sample_load_locked(now)
+      healthy = sum(1 for r in self._replicas if r.state == SERVING)
+      samples = [s for s in self._load_samples if now - s[0] <= window]
+    recommended = max(healthy, 1)
+    if len(samples) >= 2:
+      requests_delta = samples[-1][1] - samples[0][1]
+      sheds_delta = samples[-1][2] - samples[0][2]
+      if requests_delta > 0:
+        mean_outstanding = (sum(s[3] for s in samples)
+                            / float(len(samples)))
+        per_replica = max(self._shed_outstanding, 1)
+        recommended = max(
+            int(math.ceil(mean_outstanding
+                          / (self._autoscale_target_util * per_replica))),
+            1)
+        if sheds_delta > 0:
+          recommended = max(recommended, healthy + 1)
+    obs_metrics.gauge("serve/fleet/recommended_replicas").set(
+        float(recommended))
+    return recommended
 
   # -- health ---------------------------------------------------------------
 
@@ -544,6 +629,8 @@ class ServingFleet:
     with self._lock:
       if self._closed:
         raise batcher_lib.ShutdownError("fleet is closed")
+      self._load_requests += 1
+      self._sample_load_locked(time.monotonic())
       candidates = [r for r in self._replicas
                     if r.state == SERVING and r.index != exclude]
       if not candidates:
@@ -553,10 +640,12 @@ class ServingFleet:
               "no healthy replica in the fleet "
               f"({[r.state for r in self._replicas]})")
         obs_metrics.counter("serve/fleet/shed").inc()
+        self._load_sheds += 1
         raise FleetShedError("no alternative replica for failover")
       best = min(candidates, key=lambda r: (r.outstanding, r.index))
       if best.outstanding >= self._shed_outstanding:
         obs_metrics.counter("serve/fleet/shed").inc()
+        self._load_sheds += 1
         raise FleetShedError(
             f"every healthy replica is at the queue-depth bound "
             f"({self._shed_outstanding} outstanding); backpressure — "
@@ -725,18 +814,40 @@ class ServingFleet:
     replica = entry.replica
     with self._lock:
       replica.outstanding += 1
+      # Session ticks feed the advisory-autoscale window too: a fleet
+      # serving ONLY session-affine traffic must still open the
+      # requests_delta gate in recommended_replicas() (outstanding
+      # alone is sampled, but the gate keys on request flow).
+      self._load_requests += 1
+      self._sample_load_locked(time.monotonic())
     ok = False
     try:
       result = replica.session_front.step(entry.inner_sid, features)
       ok = True
       return result
-    except session_lib.SessionError:
+    except session_lib.SessionError as e:
       # A session-lifecycle outcome (evicted under slot pressure,
       # horizon, closed): the fleet mapping is gone but the REPLICA is
       # fine — don't let per-session outcomes accrue into eviction.
       ok = True
       with self._lock:
-        self._sessions.pop(session_id, None)
+        entry_now = self._sessions.pop(session_id, None)
+        if isinstance(e, session_lib.SessionShedError):
+          # Capacity refusal: the hard under-capacity signal of the
+          # autoscale window, same as a stateless queue-bound shed.
+          self._load_sheds += 1
+      if (isinstance(e, session_lib.SessionHorizonError)
+          and entry_now is not None):
+        # A horizon outcome leaves the INNER session alive and holding
+        # its arena slot (the engine contract expects the caller to
+        # close it) — but the fleet mapping is gone after the pop
+        # above, so the policy's close_session(sid) can never reach
+        # it: close the inner slot here or it leaks one replica slot
+        # per horizon-hitting episode.
+        try:
+          replica.session_front.close_session(entry_now.inner_sid)
+        except session_lib.SessionError:
+          pass  # already evicted/closed inside the replica
       raise
     finally:
       self._record_outcome(replica, ok)

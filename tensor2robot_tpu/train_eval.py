@@ -211,6 +211,7 @@ def train_eval_model(
     executable_cache_dir: Optional[str] = "auto",
     rewind_on_divergence: bool = True,
     max_rewinds: int = 2,
+    reset_run_telemetry: bool = True,
 ) -> dict:
   """Runs the requested mode; returns final metrics.
 
@@ -398,7 +399,7 @@ def train_eval_model(
   step_stats = stepstats_lib.StepStatsRecorder(
       batch_size=(input_generator_train.batch_size if needs_train else 0),
       every_n_steps=step_stats_every_n_steps if needs_train else 0)
-  if step_stats.enabled:
+  if step_stats.enabled and reset_run_telemetry:
     # Per-run telemetry: clear the process-global trace buffer, metrics
     # registry and xray compile-record collector so the saved trace,
     # final snapshot and run record cover exactly this run (the tracer
@@ -407,7 +408,11 @@ def train_eval_model(
     # overlapped loader and prefetcher cache their histogram objects at
     # construction, and a later registry reset would orphan them — the
     # run's data/overlap_* stage attribution would silently vanish from
-    # the final snapshot.
+    # the final snapshot. `reset_run_telemetry=False` is for embeddings
+    # where the process-global registry belongs to a LONGER-lived owner
+    # than this run — the graftloop learner trains in rounds inside a
+    # live actor/serving process, and a per-round reset would wipe the
+    # loop's own counters (episodes, sheds, staleness) mid-flight.
     trace_lib.clear()
     metrics_registry_lib.reset()
     xray_lib.clear_records()
@@ -914,6 +919,13 @@ def train_eval_model(
         saved_steps.intersection_update(manager.all_steps())
         rewind_state["targets"].append(step)
         metrics_registry_lib.counter("train/rewinds").inc()
+        for hook in hooks:
+          # Rewind coordination (graftloop): hooks learn the learner
+          # stepped back to `step` — a publish hook must drop pending
+          # publishes above it (those steps are quarantined or about to
+          # be re-trained) while collection keeps serving the last
+          # verified version.
+          hook.after_rewind(ctx, step)
         # Fresh, deterministically re-seeded stream: a rewound run and
         # a clean resume from the same checkpoint consume the same
         # records (the chaos bench's numerical-parity pin).

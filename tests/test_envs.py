@@ -90,6 +90,108 @@ class TestCollectEvalLoop:
     assert len(replays) == 1
 
 
+class _CrashingEnv:
+  """Explodes on step N — the mid-episode env failure of ISSUE 14's
+  teardown audit."""
+
+  def __init__(self, crash_at_step=1):
+    self._crash_at = crash_at_step
+    self._t = 0
+
+  def reset(self, seed=None):
+    self._t = 0
+    return {"x": np.zeros(2, np.float32)}, {}
+
+  def step(self, action):
+    self._t += 1
+    if self._t >= self._crash_at:
+      raise RuntimeError("simulator died mid-episode")
+    return ({"x": np.zeros(2, np.float32)}, 0.0, False, False, {})
+
+
+class _SessionPredictorSpy:
+  """Session-surface double: counts open/close so a leaked slot is
+  visible."""
+
+  def __init__(self):
+    self.open_sessions = set()
+    self.next_sid = 1
+    self.closed = []
+
+  def open(self):
+    sid = self.next_sid
+    self.next_sid += 1
+    self.open_sessions.add(sid)
+    return sid
+
+  def step(self, sid, features):
+    assert sid in self.open_sessions
+    return {"inference_output": np.zeros((2,), np.float32)}
+
+  def close_session(self, sid):
+    self.open_sessions.discard(sid)
+    self.closed.append(sid)
+
+
+class TestEpisodeTeardown:
+  """ISSUE 14 satellite: an env exception mid-episode must still close
+  the policy's serving-side episode state — one leaked session slot per
+  crashed episode is denial-of-service under shed admission."""
+
+  def test_env_crash_calls_abort_episode_and_propagates(self):
+    from tensor2robot_tpu.obs import metrics as metrics_lib
+
+    aborts = []
+
+    class _SpyPolicy(pose_env.RandomPolicy):
+      def abort_episode(self):
+        aborts.append(True)
+
+    with metrics_lib.isolated() as registry:
+      with pytest.raises(RuntimeError, match="simulator died"):
+        run_env.run_env(env=_CrashingEnv(), policy=_SpyPolicy(seed=0),
+                        num_episodes=3)
+      snap = registry.snapshot()
+    assert aborts == [True]  # torn down exactly once, then re-raised
+    assert snap["counter/env/aborted_episodes"] == 1
+
+  def test_session_policy_crash_frees_server_slot(self):
+    from tensor2robot_tpu.policies import policies as policies_lib
+
+    predictor = _SessionPredictorSpy()
+    policy = policies_lib.SessionRegressionPolicy(predictor=predictor)
+    with pytest.raises(RuntimeError, match="simulator died"):
+      run_env.run_env(env=_CrashingEnv(), policy=policy, num_episodes=1)
+    # THE regression: the crashed episode's session slot is freed, not
+    # leaked until LRU pressure / engine close.
+    assert predictor.open_sessions == set()
+    assert len(predictor.closed) == 1
+    assert policy.session_id is None
+
+  def test_abort_failure_does_not_mask_env_error(self):
+    class _BrokenAbortPolicy(pose_env.RandomPolicy):
+      def abort_episode(self):
+        raise ValueError("teardown exploded too")
+
+    # The ENV's error surfaces, not the teardown's.
+    with pytest.raises(RuntimeError, match="simulator died"):
+      run_env.run_env(env=_CrashingEnv(),
+                      policy=_BrokenAbortPolicy(seed=0), num_episodes=1)
+
+  def test_completed_episodes_unaffected(self, tmp_path):
+    # A normal run never calls abort_episode.
+    aborts = []
+
+    class _SpyPolicy(pose_env.RandomPolicy):
+      def abort_episode(self):
+        aborts.append(True)
+
+    stats = run_env.run_env(env=pose_env.PoseToyEnv(seed=0),
+                            policy=_SpyPolicy(seed=0), num_episodes=2)
+    assert "collect/episode_reward_mean" in stats
+    assert aborts == []
+
+
 class TestSubsample:
 
   def test_uniform(self):

@@ -1130,6 +1130,137 @@ class TestFleetRolloutRealCheckpoints:
 # ---------------------------------------------------------------------------
 
 
+class TestFleetAutoscaleSignal:
+  """ROADMAP item 1 remainder slice (ISSUE 14 satellite): the ADVISORY
+  `recommended_replicas()` signal from the shed/occupancy/outstanding
+  window — no actuation, just the number an autoscaler or operator
+  dashboard would consume."""
+
+  def test_no_traffic_recommends_current_healthy(self):
+    fleet, _ = _make_fleet(num_replicas=2)
+    try:
+      with metrics_lib.isolated() as registry:
+        assert fleet.recommended_replicas() == 2
+        snap = registry.snapshot()
+      assert snap["gauge/serve/fleet/recommended_replicas"] == 2.0
+    finally:
+      fleet.close()
+
+  def test_in_window_shed_recommends_scale_up(self):
+    # Slow replica + tiny queue bound: overload sheds, and shedding is
+    # a hard under-capacity signal — at least one MORE replica than
+    # currently healthy, whatever occupancy says.
+    fleet, _ = _make_fleet(
+        num_replicas=1, engines={0: _FakeEngine(0, delay_s=0.05)},
+        shed_outstanding=2, autoscale_sample_s=0.0)
+    try:
+      threads = [threading.Thread(
+          target=lambda: _swallow_shed(fleet)) for _ in range(12)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+      assert fleet.recommended_replicas() >= 2
+    finally:
+      fleet.close()
+
+  def test_diurnal_profile_exercises_window(self):
+    # The diurnal open-loop trace drives the sliding window end to end:
+    # samples accumulate on the routing hot path, the recommendation
+    # stays >= 1 and the gauge is (re)exported.
+    fleet, _ = _make_fleet(
+        num_replicas=2,
+        engines={0: _FakeEngine(0, delay_s=0.002),
+                 1: _FakeEngine(1, delay_s=0.002)},
+        autoscale_sample_s=0.0)
+    try:
+      with metrics_lib.isolated() as registry:
+        result = loadgen.run_trace_load(
+            predict=fleet.predict, make_request=lambda i: X1,
+            num_arrivals=120, rate_hz=600.0, profile="diurnal",
+            seed=3, max_client_threads=16)
+        assert result["ok_requests"] > 0
+        recommended = fleet.recommended_replicas()
+        snap = registry.snapshot()
+      assert recommended >= 1
+      assert snap["gauge/serve/fleet/recommended_replicas"] == float(
+          recommended)
+    finally:
+      fleet.close()
+
+  def test_horizon_outcome_closes_the_inner_slot(self):
+    # A SessionHorizonError leaves the INNER session alive holding its
+    # arena slot, but the fleet pops its sid mapping — so the policy's
+    # close_session(sid) can never reach it. The fleet must close the
+    # inner slot itself or one replica slot leaks per horizon-hitting
+    # episode (denial-of-service under admission='shed').
+    from tensor2robot_tpu.serving import session as session_lib
+
+    class _HorizonEngine(_FakeEngine):
+      def step(self, sid, obs):
+        raise session_lib.SessionHorizonError("episode outran horizon",
+                                              sid)
+
+    engine = _HorizonEngine(0)
+    fleet, _ = _make_fleet(num_replicas=1, engines={0: engine})
+    try:
+      sid = fleet.open()
+      assert engine.sessions  # the inner slot is held
+      with pytest.raises(session_lib.SessionHorizonError):
+        fleet.step(sid, X1)
+      assert engine.sessions == {}  # ...and freed by the fleet
+    finally:
+      fleet.close()
+
+  def test_session_only_traffic_feeds_the_window(self):
+    # A fleet serving ONLY session-affine traffic must still open the
+    # autoscale window's requests gate: light session occupancy
+    # computes ~1 replica via the utilization formula — distinguishable
+    # from the "no signal -> current healthy (2)" fallback that blind
+    # (stateless-only) accounting would produce.
+    fleet, _ = _make_fleet(num_replicas=2, autoscale_sample_s=0.0)
+    try:
+      sid = fleet.open()
+      for _ in range(6):
+        fleet.step(sid, X1)
+      fleet.close_session(sid)
+      assert fleet.recommended_replicas() == 1
+    finally:
+      fleet.close()
+
+  def test_idle_window_decays_back_to_healthy(self):
+    fleet, _ = _make_fleet(
+        num_replicas=1, engines={0: _FakeEngine(0, delay_s=0.05)},
+        shed_outstanding=2, autoscale_sample_s=0.0)
+    try:
+      threads = [threading.Thread(
+          target=lambda: _swallow_shed(fleet)) for _ in range(12)]
+      for t in threads:
+        t.start()
+      for t in threads:
+        t.join()
+      assert fleet.recommended_replicas() >= 2
+      # A window that excludes the burst sees no traffic: no signal, no
+      # change — the diurnal trough reads low instead of latching the
+      # peak forever.
+      time.sleep(0.05)
+      assert fleet.recommended_replicas(window_s=0.01) == 1
+    finally:
+      fleet.close()
+
+  def test_target_utilization_validated(self):
+    with pytest.raises(ValueError):
+      fleet, _ = _make_fleet(num_replicas=1,
+                             autoscale_target_utilization=1.5)
+
+
+def _swallow_shed(fleet):
+  try:
+    fleet.predict(X1)
+  except serving.FleetShedError:
+    pass
+
+
 class TestFleetLintRule:
 
   def _check(self, source):
