@@ -28,10 +28,11 @@ import sys
 from typing import List, Tuple
 
 from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       fleet_check, loop_check,
-                                       native_check, pp_check, retry_check,
-                                       session_check, spec_check,
-                                       thread_check, tracer_check)
+                                       fleet_check, forge_check,
+                                       loop_check, native_check, pp_check,
+                                       retry_check, session_check,
+                                       spec_check, thread_check,
+                                       tracer_check)
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
@@ -109,6 +110,18 @@ fleet rules (.py):
                          (the tunnel-safe join discipline the batchers
                          follow, mechanized for the fleet layer)
 
+forge rules (.py):
+  warmup-unforgeable     a BucketedEngine/SessionEngine construction
+                         whose `buckets=` is computed at runtime —
+                         graftforge cannot enumerate those rungs from
+                         the config/specs, so the compile farm cannot
+                         warm them and their first live request pays
+                         the 20-40 s tunnel compile; literal ladders,
+                         bucket_ladder(...), module-level literal
+                         constants, and `**splat` sites are accepted
+                         (route live ladder changes through
+                         ServingFleet.rollout(ladder=...))
+
 loop rules (.py, the loop/ package only):
   unsupervised-loop-worker a bare threading.Thread construction in a
                          loop-package module other than supervisor.py —
@@ -185,6 +198,7 @@ def run(paths: List[str]) -> List[Finding]:
     findings.extend(pp_check.check_python_file(path))
     findings.extend(session_check.check_python_file(path))
     findings.extend(fleet_check.check_python_file(path))
+    findings.extend(forge_check.check_python_file(path))
     findings.extend(retry_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     findings.extend(loop_check.check_python_file(path))

@@ -33,6 +33,16 @@ docs/ARCHITECTURE.md "Observability"); this is the read side:
       --older-than SECS, or --name-prefix P for one namespace of a
       shared dir). Metadata-only: never deserializes an executable,
       so it is backend-free like every other subcommand.
+  python -m tensor2robot_tpu.bin.graftscope forge <config.gin>
+      graftforge (obs.forge): enumerate every executable the config's
+      deployment needs (serving bucket rungs x replicas, decode rungs
+      + slot reset, train/eval steps) and compile them into the
+      graftcache in a pool of worker SUBPROCESSES before any fleet
+      member, loop worker, or trainer starts. --plan prints the
+      enumeration (backend-free), --jobs N sizes the farm, --verify
+      checks an existing cache against the plan without compiling;
+      exit codes match `cache` (0 ok / 1 bad or missing / 2 usage).
+      The parent process stays backend-free — jax lives in workers.
 
 Robustness contract: a torn tail line of a live run, a truncated trace
 JSON, or binary garbage in any telemetry file is skipped with a warning
@@ -702,9 +712,133 @@ def _main_postmortem(argv: List[str]) -> int:
   return 0
 
 
+def _main_forge(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope forge",
+      description="graftforge: enumerate the executable set a research "
+                  "config deploys and warm the graftcache for all of it "
+                  "BEFORE any process starts (obs.forge). --plan prints "
+                  "the enumeration without building anything; the "
+                  "default runs the compile farm; --verify checks an "
+                  "existing cache against the plan without compiling. "
+                  "Exit codes match `graftscope cache`: 0 ok, 1 bad/"
+                  "missing entries or farm errors, 2 usage.")
+  parser.add_argument("config_files", nargs="+",
+                      help="research config (.gin) files, e.g. "
+                           "tensor2robot_tpu/configs/serve_fleet.gin")
+  parser.add_argument("--binding", action="append", default=[],
+                      help="extra binding strings, applied last "
+                           "(repeatable)")
+  parser.add_argument("--cache-dir", default=os.environ.get(
+      "GRAFTCACHE_DIR", ".graftcache"),
+                      help="graftcache directory to populate/verify "
+                           "(default $GRAFTCACHE_DIR or .graftcache)")
+  parser.add_argument("--jobs", type=int, default=2,
+                      help="parallel compile-farm worker subprocesses")
+  parser.add_argument("--plan", action="store_true",
+                      help="dry-run: print the executable enumeration "
+                           "and exit (backend-free)")
+  parser.add_argument("--verify", action="store_true",
+                      help="check the cache against the plan without "
+                           "compiling (exit 1 on missing/corrupt)")
+  parser.add_argument("--model", default=None,
+                      help="model source for serving-only configs: a "
+                           "registered configurable name, or 'flagship' "
+                           "(the QT-Opt smoke critic)")
+  parser.add_argument("--export-dir", default=None,
+                      help="serve the model from this export-bundle "
+                           "root instead of a configurable ctor")
+  parser.add_argument("--model-dir", default=None,
+                      help="deployment model_dir: predictors restore "
+                           "its checkpoints when present (else random-"
+                           "init — keys are value-independent), and "
+                           "'--cache-dir auto' resolves to its excache/")
+  parser.add_argument("--device-count", type=int, default=None,
+                      help="force the worker topology (XLA host-"
+                           "platform device count) to match the "
+                           "deployment — the mesh fingerprint is a "
+                           "cache-key component")
+  parser.add_argument("--runs", default=None,
+                      help="runs.jsonl to append the forge manifest to "
+                           "(default $GRAFTSCOPE_RUNS or ./runs.jsonl; "
+                           "'' disables)")
+  args = parser.parse_args(argv)
+  missing = [p for p in args.config_files if not os.path.isfile(p)]
+  if missing:
+    print(f"graftscope forge: no such config: {', '.join(missing)}",
+          file=sys.stderr)
+    return 2
+  from tensor2robot_tpu.obs import forge as forge_lib
+
+  cache_dir = args.cache_dir
+  if cache_dir == "auto":
+    if not args.model_dir:
+      print("graftscope forge: --cache-dir auto needs --model-dir",
+            file=sys.stderr)
+      return 2
+    cache_dir = os.path.join(args.model_dir, "excache")
+  try:
+    plan = forge_lib.plan_from_config(
+        args.config_files, args.binding, model=args.model,
+        export_dir=args.export_dir, model_dir=args.model_dir)
+  except Exception as e:  # noqa: BLE001 - a config error is a usage error
+    print(f"graftscope forge: cannot enumerate {args.config_files}: "
+          f"{type(e).__name__}: {e}", file=sys.stderr)
+    return 2
+  print(forge_lib.format_plan(plan))
+  if args.plan:
+    return 0
+  forgeable = [t for t in plan["targets"] if t["forgeable"]]
+  if forgeable and plan.get("model") is None:
+    print("graftscope forge: the plan has forgeable serving/train "
+          "targets but no model source — pass --model/--export-dir or "
+          "bind graftforge.model in the config", file=sys.stderr)
+    return 2
+  if args.verify:
+    report = forge_lib.verify_plan(plan, cache_dir,
+                                   device_count=args.device_count)
+    print(f"graftforge verify: {cache_dir} — "
+          f"{len(report['present'])} present, "
+          f"{len(report['missing'])} missing, "
+          f"{len(report['corrupt'])} corrupt, "
+          f"{len(report['errors'])} error(s)")
+    for entry in report["missing"]:
+      print(f"  MISSING {entry.get('name')}  {entry.get('key')}")
+    for entry in report["corrupt"]:
+      print(f"  CORRUPT {entry.get('name')}  {entry.get('key')}")
+    for entry in report["errors"]:
+      print(f"  ERROR   {entry.get('name')}: {entry.get('error')}",
+            file=sys.stderr)
+    return 1 if (report["missing"] or report["corrupt"]
+                 or report["errors"]) else 0
+  runs_path = args.runs
+  if runs_path is None:
+    runs_path = os.environ.get("GRAFTSCOPE_RUNS", "runs.jsonl")
+  manifest = forge_lib.run_forge(plan, cache_dir, jobs=args.jobs,
+                                 device_count=args.device_count,
+                                 runs_path=runs_path or None)
+  counts = manifest["counts"]
+  print(f"graftforge: {counts['forged']} compiled + {counts['cached']} "
+        f"already-cached executable(s) into {cache_dir} in "
+        f"{manifest['wall_s']:.1f}s ({manifest['jobs']} job(s); "
+        f"{counts['unforgeable']} unforgeable, {counts['fallback']} "
+        f"fallback(s), {counts['errors']} error(s))")
+  for entry in manifest["executables"]:
+    print(f"  {entry.get('action', '?'):<9}{entry.get('name'):<28}"
+          f"compile_s={entry.get('compile_s')}  {entry.get('key')}")
+  for entry in manifest["errors"]:
+    print(f"  ERROR   {entry.get('name')}: {entry.get('error')}",
+          file=sys.stderr)
+  if counts["fallback"]:
+    print(f"graftscope forge: {counts['fallback']} executable(s) took "
+          "the AOT-less plain-jit fallback — nothing was stored for "
+          "them; this backend cannot be forged", file=sys.stderr)
+  return 1 if (manifest["errors"] or counts["fallback"]) else 0
+
+
 _SUBCOMMANDS = {"report": _main_report, "history": _main_history,
                 "diff": _main_diff, "postmortem": _main_postmortem,
-                "cache": _main_cache}
+                "cache": _main_cache, "forge": _main_forge}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -50,6 +50,20 @@ flags.DEFINE_integer("replicas", 1,
                      "BucketedEngine+MicroBatcher; >1 builds a "
                      "ServingFleet over disjoint device groups "
                      "(parallel.mesh.replica_device_groups).")
+flags.DEFINE_string("executable_cache_dir", None,
+                    "graftcache directory for the engine bucket "
+                    "ladder(s). Pre-populate it with `graftscope forge "
+                    "<config> --export-dir <dir>` (graftforge) and "
+                    "warmup deserializes instead of compiling — the "
+                    "20-40 s/executable tunnel cold start becomes "
+                    "ms-scale. NOTE for --replicas N > 1: replica "
+                    "placement is a cache-key component, so the forge "
+                    "plan must see the same replica count — bind "
+                    "ServingFleet.num_replicas = N in the config (or "
+                    "pass the same --binding to graftscope forge); a "
+                    "plan forged for a different count warms only the "
+                    "matching placements. Replicas share the "
+                    "'serve/engine' cache namespace.")
 
 
 def main(argv):
@@ -87,7 +101,9 @@ def main(argv):
         raise RuntimeError(f"replica {index}: export restore failed")
       if devices:
         p.place_on_device(devices[0])
-      return serving.BucketedEngine(predictor=p)
+      return serving.BucketedEngine(
+          predictor=p, cache=FLAGS.executable_cache_dir,
+          cache_namespace="serve/engine")
 
     with serving.ServingFleet(replica_factory=make_replica,
                               num_replicas=FLAGS.replicas,
@@ -103,7 +119,9 @@ def main(argv):
       compile_records = [r for i in range(fleet.num_replicas)
                          for r in fleet.replica(i).compile_records]
   else:
-    engine = serving.BucketedEngine(predictor=predictor)
+    engine = serving.BucketedEngine(
+        predictor=predictor, cache=FLAGS.executable_cache_dir,
+        cache_namespace="serve/engine")
     engine.warmup()
     with serving.MicroBatcher(backend=engine) as batcher:
       result = loadgen.run_load(

@@ -106,10 +106,22 @@ class GraftLoop:
                restart_policy: Optional[retry_lib.RetryPolicy] = None,
                trainer_kwargs: Optional[Dict[str, Any]] = None,
                input_generator_factory: Optional[Callable[[str], Any]] = None,
+               executable_cache_dir: Optional[str] = "auto",
                seed: int = 0):
     self._model_factory = model_factory
     self._model_dir = os.path.abspath(model_dir)
     os.makedirs(self._model_dir, exist_ok=True)
+    # graftforge/graftcache seam (ROADMAP item 5): ONE executable cache
+    # for the loop's whole executable surface — every fleet replica's
+    # bucket ladder (shared `serve/loop` cache namespace, so N replicas
+    # deserialize one forged entry set) and the learner's train rounds.
+    # `graftscope forge configs/loop_qtopt.gin --model-dir <dir>`
+    # populates it BEFORE the loop starts, so the first serve and the
+    # first round both start compile-free ("auto" = <model_dir>/excache,
+    # the same resolution train_eval uses; None/"" disables).
+    if executable_cache_dir == "auto":
+      executable_cache_dir = os.path.join(self._model_dir, "excache")
+    self._executable_cache_dir = executable_cache_dir or None
     # BEFORE any replica is built: CheckpointPredictor resolves its
     # polling directory at construction — if `<model_dir>/checkpoints`
     # does not exist yet it falls back to polling model_dir itself and
@@ -189,7 +201,13 @@ class GraftLoop:
       predictor.place_on_device(devices[0])
     return engine_lib.BucketedEngine(
         predictor=predictor, max_batch_size=self._max_batch_size,
-        name=f"serve/loop/replica{index}")
+        name=f"serve/loop/replica{index}",
+        # Shared namespace, per-replica telemetry name: every replica
+        # deserializes the ONE forged `serve/loop` entry set (graftforge
+        # pre-populates it; without a forge pass replica 0 compiles+
+        # stores and replicas 1..N-1 deserialize in the same process).
+        cache=self._executable_cache_dir,
+        cache_namespace="serve/loop")
 
   def _build_fleet(self) -> None:
     from tensor2robot_tpu import specs as specs_lib
@@ -353,7 +371,12 @@ class GraftLoop:
           max_train_steps=target,
           checkpoint_every_n_steps=self._steps_per_round,
           log_every_n_steps=1,
-          executable_cache_dir=None,
+          # The loop-wide cache (graftforge seam): the round's train
+          # step rides whatever tiers the toolchain admits — gated to
+          # counters-only while excache.DONATING_MESH_SAFE_FROM is
+          # unset (the donating-mesh step skips both tiers on this
+          # jax), compile-free rounds the moment the pin flips.
+          executable_cache_dir=self._executable_cache_dir,
           mesh_shape=(1, 1, 1),
           reset_run_telemetry=False,
           seed=self._seed)
@@ -535,6 +558,7 @@ def run_graftloop(model_ctor=config.REQUIRED,
                   actor_pause_s: float = 0.0,
                   heartbeat_timeout_s: Optional[float] = None,
                   wall_timeout_s: float = 600.0,
+                  executable_cache_dir: Optional[str] = "auto",
                   seed: int = 0) -> Dict[str, Any]:
   """Config-engine entry point (`configs/loop_qtopt.gin`,
   `bin/run_graftloop.py`): builds a `GraftLoop` from configurable
@@ -559,6 +583,7 @@ def run_graftloop(model_ctor=config.REQUIRED,
       max_episode_steps=max_episode_steps,
       actor_pause_s=actor_pause_s,
       heartbeat_timeout_s=heartbeat_timeout_s,
+      executable_cache_dir=executable_cache_dir,
       seed=seed)
   summary = loop.run(wall_timeout_s=wall_timeout_s)
   logging.info("graftloop summary: %s", summary)

@@ -76,13 +76,67 @@ from tensor2robot_tpu.obs import metrics as metrics_lib
 
 __all__ = ["CACHE_VERSION", "cache_key", "key_components_from_traced",
            "jaxpr_fingerprint", "mesh_fingerprint", "backend_fingerprint",
-           "aot_cache_unsafe", "ExecutableCache", "as_cache",
+           "aot_cache_unsafe", "donating_mesh_cache_unsafe",
+           "DONATING_MESH_SAFE_FROM", "ExecutableCache", "as_cache",
            "enable_xla_cache", "xla_cache_bypassed", "cache_stats"]
 
 # Bumped whenever the entry format (blob layout, meta schema, key
 # recipe) changes — part of every key, so an old-format entry can never
 # be deserialized by a new reader; it just misses and gets recompiled.
 CACHE_VERSION = 1
+
+# THE toolchain pin for the donating-mesh cache gate (ROADMAP item 5's
+# standing note, mechanized). On jax 0.4.37 a deserialized executable —
+# from the serialized-AOT tier OR the XLA persistent compilation cache
+# — that DONATES mesh-typed (NamedSharding) inputs heap-corrupts on
+# dispatch ("corrupted double-linked list" / SIGSEGV; repro conditions
+# documented on `aot_cache_unsafe` and pinned in tests/test_excache.py
+# + tests/test_forge.py). Until a newer toolchain is re-verified, every
+# jax version rides the gate: donating-mesh executables skip BOTH cache
+# tiers (train modes additionally disarm the XLA tier, train_eval.py).
+#
+# UN-GATING (one constant): when the image moves past 0.4.37, re-run
+# the repro (tests/test_forge.py::TestDonatingMeshGate documents the
+# exact conditions), and on a clean pass set this to that jax version
+# string (e.g. "0.4.38"). Every version >= it then caches donating-mesh
+# executables on both tiers; the existing per-component key-sensitivity
+# tests re-verify the key discipline for the newly admitted entries
+# unchanged — nothing else moves. None = no version verified safe yet.
+DONATING_MESH_SAFE_FROM: Optional[str] = None
+
+
+def _version_tuple(version: str) -> Tuple[int, ...]:
+  """Lenient numeric version parse ('0.4.37' -> (0, 4, 37); non-numeric
+  tails like '0.5.0.dev1' truncate at the first non-int segment)."""
+  parts: List[int] = []
+  for segment in str(version).split("."):
+    digits = re.match(r"\d+", segment)
+    if digits is None:
+      break
+    parts.append(int(digits.group()))
+  return tuple(parts)
+
+
+def donating_mesh_cache_unsafe(jax_version: Optional[str] = None) -> bool:
+  """True while the running jax rides the donating-mesh SIGSEGV gate.
+
+  Version-keyed against `DONATING_MESH_SAFE_FROM`: the gate is ACTIVE
+  (True) unless a safe-from version is pinned and the running jax is at
+  or past it. Both tiers consult this one predicate — the serialized
+  tier via `aot_cache_unsafe`, the XLA tier via train_eval's train-mode
+  disarm — so flipping the single constant above un-gates them
+  together, and the key-sensitivity tests re-verify both."""
+  if DONATING_MESH_SAFE_FROM is None:
+    return True
+  if jax_version is None:
+    import jax
+
+    jax_version = getattr(jax, "__version__", "0")
+  safe_from = _version_tuple(DONATING_MESH_SAFE_FROM)
+  current = _version_tuple(jax_version)
+  if not safe_from or not current:
+    return True  # unparseable pin/version: stay gated
+  return current < safe_from
 
 _META_SUFFIX = ".json"
 _BLOB_SUFFIX = ".bin"
@@ -207,8 +261,10 @@ def jaxpr_fingerprint(jaxpr) -> str:
 
 def aot_cache_unsafe(traced, args) -> bool:
   """True when serialize/deserialize round-trips must be SKIPPED for
-  this executable: it donates at least one input AND its inputs carry
-  mesh-typed (non-SingleDevice) shardings.
+  this executable: the toolchain rides the donating-mesh gate
+  (`donating_mesh_cache_unsafe` — version-keyed against the
+  `DONATING_MESH_SAFE_FROM` pin) AND it donates at least one input AND
+  its inputs carry mesh-typed (non-SingleDevice) shardings.
 
   Measured on this host (jax 0.4.37, virtual CPU meshes): a
   `deserialize_and_load`-ed executable that donates NamedSharding
@@ -219,13 +275,15 @@ def aot_cache_unsafe(traced, args) -> bool:
   AOT executable, non-donating deserialized executables (the whole
   serving path), and donating ones over plain SingleDeviceSharding
   (the bench probes, the tunnel's one-chip deployment: hundreds of
-  warm calls measured stable) are all fine. Until the upstream bug is
-  fixed, the donating mesh case rides the XLA compilation-cache tier
-  instead — warm restarts still skip the backend compile, they just
-  re-pay trace+lower.
+  warm calls measured stable) are all fine. Until a re-verified
+  toolchain lifts the gate, the donating mesh case rides the XLA
+  compilation-cache tier instead — warm restarts still skip the
+  backend compile, they just re-pay trace+lower.
   """
   import jax
 
+  if not donating_mesh_cache_unsafe():
+    return False  # toolchain re-verified past the pin: cache everything
   infos = jax.tree_util.tree_leaves(
       traced.args_info, is_leaf=lambda n: hasattr(n, "donated"))
   if not any(getattr(i, "donated", False) for i in infos):

@@ -145,6 +145,20 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # 1-core host, so the loose warmup_ms band.
     "loop_goodput_ratio": ("down", 0.15),
     "publish_to_serve_ms": ("up", 0.50),
+    # graftforge gates (bench.py --forge / scripts/forge_bench.sh,
+    # PERFORMANCE.md "Reading a forge bench"): forged_vs_cold is the
+    # paired cold/forged cold-start speedup ratio measured in two fresh
+    # subprocesses back-to-back (load-invariant like cold_vs_warm_warmup
+    # — >= 2.0 is the ISSUE 15 acceptance floor; a drop toward 1 means
+    # the farm's entries stopped deserializing). forged_start_ms is the
+    # absolute forged start wall on the 1-core host (loose band like
+    # warmup_ms), and forge_compile_share is the fraction of the forged
+    # start's warmup wall spent COMPILING (satellite: the
+    # warmup_load_ms/warmup_compile_ms split) — expected 0, so any
+    # growth means specific rungs went cold (read warmup_provenance).
+    "forged_vs_cold": ("down", 0.30),
+    "forged_start_ms": ("up", 0.50),
+    "forge_compile_share": ("up", 0.0),
 }
 
 
@@ -422,6 +436,15 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
   rollout = bench.get("rollout") or {}
   if rollout.get("window_shed") is not None:
     out["fleet_rollout_shed"] = float(rollout["window_shed"])
+  # graftforge bench (bench.py --forge): the paired cold/forged start
+  # ratio, the absolute forged start, and the forged start's compile
+  # share (0 when every rung deserialized).
+  if bench.get("forged_vs_cold") is not None:
+    out["forged_vs_cold"] = float(bench["forged_vs_cold"])
+  if bench.get("forged_start_ms") is not None:
+    out["forged_start_ms"] = float(bench["forged_start_ms"])
+  if bench.get("forge_compile_share") is not None:
+    out["forge_compile_share"] = float(bench["forge_compile_share"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)

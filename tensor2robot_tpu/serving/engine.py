@@ -228,7 +228,8 @@ class BucketedEngine:
                max_batch_size: int = 8,
                buckets: Optional[Sequence[int]] = None,
                name: str = "serve/engine",
-               cache=None):
+               cache=None,
+               cache_namespace: Optional[str] = None):
     if predictor is None:
       raise ValueError("predictor is required.")
     self._predictor = predictor
@@ -245,12 +246,22 @@ class BucketedEngine:
     # graftcache (obs.excache): persistent executable cache for the
     # bucket ladder. Deferred coercion — a str path must not import
     # excache machinery at construction in backend-free contexts.
+    # `cache_namespace` names the analyze_jit records (and so the cache
+    # KEY prefix) independently of the telemetry `name`: N fleet
+    # replicas with per-replica names share one namespace, so a single
+    # forged entry set warms every replica (graftforge; keys still
+    # diverge per replica when state placement differs — the sharding
+    # component — but identically-placed replicas deduplicate).
     self._cache = cache
+    self._cache_namespace = cache_namespace or name
     self._compiled: Dict[int, Callable] = {}
     self._records: Dict[int, Dict[str, Any]] = {}
     self._compile_count = 0
     self._cache_loads = 0
     self._warmup_ms: Optional[float] = None
+    self._warmup_load_ms = 0.0
+    self._warmup_compile_ms = 0.0
+    self._warmup_provenance: List[Dict[str, Any]] = []
     self._bundle = None
     self._lock = threading.Lock()
 
@@ -277,6 +288,29 @@ class BucketedEngine:
     """Wall-clock of the last warmup that did work (None before warmup).
     THE serving cold-start headline: graftscope diff gates it."""
     return self._warmup_ms
+
+  @property
+  def warmup_load_ms(self) -> float:
+    """Warmup wall spent DESERIALIZING cached executables (graftcache
+    hits). `warmup_ms == warmup_load_ms + warmup_compile_ms` up to
+    arena/bundle bookkeeping — the split that makes a forge regression
+    attributable: a forged start is all load, a cold start all compile,
+    and a creeping compile share means entries stopped hitting."""
+    return self._warmup_load_ms
+
+  @property
+  def warmup_compile_ms(self) -> float:
+    """Warmup wall spent on FRESH trace+lower+compile (cache misses and
+    AOT-less degrades)."""
+    return self._warmup_compile_ms
+
+  @property
+  def warmup_provenance(self) -> List[Dict[str, Any]]:
+    """Per-rung warmup provenance: `{rung, source, ms, key}` where
+    `source` is 'cache' (deserialized), 'compile' (fresh), or
+    'fallback' (AOT-less plain-jit degrade). Stamped into the serving
+    run records so per-rung forge regressions are attributable."""
+    return [dict(p) for p in self._warmup_provenance]
 
   @property
   def compile_records(self) -> List[Dict[str, Any]]:
@@ -308,41 +342,126 @@ class BucketedEngine:
         if bucket in self._compiled:
           continue
         did_work = True
-        wire = specs_lib.make_random_numpy(bundle.feature_spec,
-                                           batch_size=bucket, seed=0)
-        features = bundle.preprocess(wire)
-        start = time.perf_counter()
-        try:
-          compiled, record = obs_xray.analyze_jit(
-              f"{self._name}/bucket{bucket}", bundle.jit_predict,
-              bundle.get_state(), features, cache=cache)
-        except Exception as e:  # noqa: BLE001 - AOT-less backends
-          # No AOT support: dispatch the plain jit once at this shape —
-          # jax's own per-shape cache then serves later calls without
-          # recompiling, preserving the zero-recompile guarantee with
-          # degraded (no cost-analysis) telemetry.
-          bundle.jit_predict(bundle.get_state(), features)
-          compiled = None
-          record = {"name": f"{self._name}/bucket{bucket}",
-                    "compile_s": time.perf_counter() - start,
-                    "error": f"{type(e).__name__}: {e}"}
-        self._compiled[bucket] = compiled
-        self._records[bucket] = record
-        if (record.get("cache") or {}).get("hit"):
-          # Served from graftcache: a deserialize, not a compile — the
-          # cold-start economics this cache exists for.
-          self._cache_loads += 1
-          obs_metrics.counter("serve/engine/cache_loads").inc()
-        else:
-          self._compile_count += 1
-          obs_metrics.counter("serve/engine/compiles").inc()
-        obs_metrics.gauge(
-            f"serve/engine/bucket{bucket}/compile_s").set(
-                float(record.get("compile_s") or 0.0))
+        self._warm_bucket_locked(bucket, bundle, cache, specs_lib,
+                                 obs_xray)
       if did_work:
         self._warmup_ms = (time.perf_counter() - warmup_start) * 1e3
         obs_metrics.gauge("serve/engine/warmup_ms").set(self._warmup_ms)
+        obs_metrics.gauge("serve/engine/warmup_load_ms").set(
+            self._warmup_load_ms)
+        obs_metrics.gauge("serve/engine/warmup_compile_ms").set(
+            self._warmup_compile_ms)
     return self
+
+  def _warm_bucket_locked(self, bucket: int, bundle, cache,
+                          specs_lib, obs_xray) -> None:
+    """Compiles (or cache-loads) ONE rung, with per-rung provenance —
+    which rungs were deserializes vs fresh compiles is what makes a
+    forge/cache regression attributable (`warmup_provenance`)."""
+    wire = specs_lib.make_random_numpy(bundle.feature_spec,
+                                       batch_size=bucket, seed=0)
+    features = bundle.preprocess(wire)
+    start = time.perf_counter()
+    rec_name = f"{self._cache_namespace}/bucket{bucket}"
+    source = "compile"
+    try:
+      compiled, record = obs_xray.analyze_jit(
+          rec_name, bundle.jit_predict,
+          bundle.get_state(), features, cache=cache)
+    except Exception as e:  # noqa: BLE001 - AOT-less backends
+      # No AOT support: dispatch the plain jit once at this shape —
+      # jax's own per-shape cache then serves later calls without
+      # recompiling, preserving the zero-recompile guarantee with
+      # degraded (no cost-analysis) telemetry.
+      bundle.jit_predict(bundle.get_state(), features)
+      compiled = None
+      source = "fallback"
+      record = {"name": rec_name,
+                "compile_s": time.perf_counter() - start,
+                "error": f"{type(e).__name__}: {e}"}
+    elapsed_ms = (time.perf_counter() - start) * 1e3
+    self._compiled[bucket] = compiled
+    self._records[bucket] = record
+    cache_block = record.get("cache") or {}
+    if cache_block.get("hit"):
+      # Served from graftcache: a deserialize, not a compile — the
+      # cold-start economics this cache exists for.
+      source = "cache"
+      self._cache_loads += 1
+      self._warmup_load_ms += elapsed_ms
+      obs_metrics.counter("serve/engine/cache_loads").inc()
+    else:
+      self._compile_count += 1
+      self._warmup_compile_ms += elapsed_ms
+      obs_metrics.counter("serve/engine/compiles").inc()
+    self._warmup_provenance.append(
+        {"rung": bucket, "source": source, "ms": elapsed_ms,
+         "key": cache_block.get("key")})
+    obs_metrics.gauge(
+        f"serve/engine/bucket{bucket}/compile_s").set(
+            float(record.get("compile_s") or 0.0))
+
+  def reladder(self, buckets: Sequence[int]) -> "BucketedEngine":
+    """Atomically moves the engine onto a new bucket ladder, warming
+    any NEW rungs (compile or graftcache load) BEFORE the swap — the
+    rollout pre-forge seam: a traffic-derived ladder change
+    (`traffic_bucket_ladder`) must never put a cold rung in front of
+    live traffic (one fresh rung = one 20-40 s tunnel compile a client
+    would wait out). Rungs no longer on the ladder keep their cached
+    executables (an oversize request chunks through the top rung, so
+    dropped executables are simply unused; a reladder back is free).
+    """
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.obs import excache as excache_lib
+    from tensor2robot_tpu.obs import xray as obs_xray
+
+    buckets = sorted(set(int(b) for b in buckets))
+    if not buckets or buckets[0] < 1:
+      raise ValueError(f"buckets must be positive ints, got {buckets}")
+    with self._lock:
+      if self._bundle is None:
+        self._bundle = self._predictor.serving_bundle()
+      cache = excache_lib.as_cache(self._cache)
+      for bucket in buckets:
+        if bucket not in self._compiled:
+          self._warm_bucket_locked(bucket, self._bundle, cache,
+                                   specs_lib, obs_xray)
+      # Every rung warm: the swap itself is one assignment under the
+      # lock — concurrent predicts see either ladder, both fully warm.
+      self._buckets = buckets
+      self._max_batch_size = buckets[-1]
+      obs_metrics.counter("serve/engine/reladders").inc()
+    return self
+
+  def rung_cache_keys(self) -> Dict[int, str]:
+    """The graftcache key of every rung WITHOUT compiling (trace-only).
+
+    The graftforge `--verify` seam: keys come from the SAME bundle /
+    wire-synthesis / trace path `warmup()` compiles through, so a key
+    this returns is byte-identical to the one a live warmup would look
+    up — the engine owns its arg synthesis in one place and the forge
+    CLI can check an existing cache against it without paying a single
+    lower+compile. Tracing is cheap and side-effect-free (donation is
+    declared, not consumed, at trace time)."""
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.obs import excache as excache_lib
+
+    with self._lock:
+      if self._bundle is None:
+        self._bundle = self._predictor.serving_bundle()
+      bundle = self._bundle
+      state = bundle.get_state()
+      keys: Dict[int, str] = {}
+      for bucket in self._buckets:
+        wire = specs_lib.make_random_numpy(bundle.feature_spec,
+                                           batch_size=bucket, seed=0)
+        features = bundle.preprocess(wire)
+        traced = bundle.jit_predict.trace(state, features)
+        keys[bucket] = excache_lib.cache_key(
+            f"{self._cache_namespace}/bucket{bucket}",
+            **excache_lib.key_components_from_traced(
+                traced, (state, features)))
+      return keys
 
   def _bucket_for(self, rows: int) -> int:
     for bucket in self._buckets:

@@ -870,12 +870,32 @@ class ServingFleet:
   def warmup(self) -> "ServingFleet":
     """Warms every replica's executable ladder (graftcache-seamed when
     the factory threaded a cache through: N deserializes, not N
-    compiles)."""
+    compiles — graftforge's fleet seam). Fleet-level load-vs-compile
+    attribution lands in `serve/fleet/warmup_{load,compile}_ms` so a
+    forge regression (replicas compiling where they should deserialize)
+    is one gauge read, with `warmup_provenance()` naming the rungs."""
     for replica in self._replicas:
       warm = getattr(replica.engine, "warmup", None)
       if warm is not None:
         warm()
+    load_ms = sum(float(getattr(r.engine, "warmup_load_ms", 0.0) or 0.0)
+                  for r in self._replicas)
+    compile_ms = sum(
+        float(getattr(r.engine, "warmup_compile_ms", 0.0) or 0.0)
+        for r in self._replicas)
+    obs_metrics.gauge("serve/fleet/warmup_load_ms").set(load_ms)
+    obs_metrics.gauge("serve/fleet/warmup_compile_ms").set(compile_ms)
     return self
+
+  def warmup_provenance(self) -> List[Dict[str, Any]]:
+    """Per-replica per-rung warmup provenance (`{replica, rung, source,
+    ms, key}` — engine.warmup_provenance with the replica index stamped
+    in), for the run records the forge bench appends."""
+    out: List[Dict[str, Any]] = []
+    for replica in self._replicas:
+      for entry in getattr(replica.engine, "warmup_provenance", []) or []:
+        out.append({"replica": replica.index, **entry})
+    return out
 
   def _wait_drained(self, replica: _Replica, timeout_s: float) -> bool:
     """Waits out the replica's STATELESS outstanding work (the router
@@ -906,12 +926,23 @@ class ServingFleet:
               verify: Optional[Callable[[Mapping[str, Any]], bool]] = None,
               rtol: float = 1e-4,
               atol: float = 1e-6,
-              drain_timeout_s: float = 30.0) -> Dict[str, Any]:
+              drain_timeout_s: float = 30.0,
+              ladder: Optional[Sequence[int]] = None) -> Dict[str, Any]:
     """Zero-downtime checkpoint rollout: canary first, then one replica
     at a time, with the router steering around whichever replica is
     mid-swap (module docstring). Returns the rollout report; never
     raises for verification failures — an aborted rollout leaves the
     unswapped replicas serving the old checkpoint and says so.
+
+    `ladder` (graftforge): move every replica onto a new bucket ladder
+    — e.g. a traffic-derived one (`derived_ladder`) — as part of the
+    SAME canary-first swap. New rungs are PRE-FORGED (compiled, or
+    deserialized from graftcache when the forge farm already populated
+    them) inside the replica's drained window, BEFORE its restore() and
+    re-admission, so a ladder change never puts a cold rung in front of
+    live traffic (`engine.reladder`; one cold rung over the tunnel is a
+    20-40 s client-visible stall). Per-replica rung provenance lands in
+    the report's `reladder` entries.
     """
     obs_metrics.counter("serve/fleet/rollouts").inc()
     report: Dict[str, Any] = {"swapped": 0, "fresh_compiles": 0,
@@ -937,6 +968,19 @@ class ServingFleet:
       try:
         entry["drained"] = self._wait_drained(replica, drain_timeout_s)
         compiles_before = getattr(replica.engine, "compile_count", None)
+        if ladder is not None:
+          # Pre-forge the new rungs while the router steers around this
+          # replica: any compile/deserialize happens off the serving
+          # path, and the ladder swap itself is atomic under the
+          # engine's lock against the (drained) dispatch side.
+          reladder = getattr(replica.engine, "reladder", None)
+          if reladder is not None:
+            provenance_before = len(
+                getattr(replica.engine, "warmup_provenance", []) or [])
+            reladder(ladder)
+            entry["reladder"] = (getattr(
+                replica.engine, "warmup_provenance", [])
+                or [])[provenance_before:]
         ok = replica.engine.restore()
         entry["restored"] = bool(ok)
         if not ok:

@@ -151,7 +151,8 @@ class SessionEngine:
                buckets: Optional[Sequence[int]] = None,
                admission: str = "evict_lru",
                name: str = "serve/session",
-               cache=None):
+               cache=None,
+               cache_namespace: Optional[str] = None):
     if predictor is None:
       raise ValueError("predictor is required.")
     if max_sessions < 1:
@@ -177,7 +178,12 @@ class SessionEngine:
     self._max_tick_batch = max_tick_batch
     self._admission = admission
     self._name = name
+    # graftcache namespace: names the analyze_jit records (and the cache
+    # KEY prefix) independently of the telemetry `name`, so replicas
+    # with per-replica names share one forged entry set (BucketedEngine
+    # has the same seam; graftforge relies on it).
     self._cache = cache
+    self._cache_namespace = cache_namespace or name
     # Host bookkeeping (self._lock): slot table + LRU + in-flight set.
     self._lock = threading.Lock()
     self._idle = threading.Condition(self._lock)
@@ -208,6 +214,9 @@ class SessionEngine:
     self._compile_count = 0
     self._cache_loads = 0
     self._warmup_ms: Optional[float] = None
+    self._warmup_load_ms = 0.0
+    self._warmup_compile_ms = 0.0
+    self._warmup_provenance: List[Dict[str, Any]] = []
 
   # -- warmup ---------------------------------------------------------------
 
@@ -234,6 +243,23 @@ class SessionEngine:
   @property
   def warmup_ms(self) -> Optional[float]:
     return self._warmup_ms
+
+  @property
+  def warmup_load_ms(self) -> float:
+    """Warmup wall spent deserializing graftcache hits (the
+    BucketedEngine split contract — see engine.py)."""
+    return self._warmup_load_ms
+
+  @property
+  def warmup_compile_ms(self) -> float:
+    """Warmup wall spent on fresh trace+lower+compile."""
+    return self._warmup_compile_ms
+
+  @property
+  def warmup_provenance(self) -> List[Dict[str, Any]]:
+    """Per-rung provenance `{rung, source, ms, key}` (rung = decode
+    bucket int or 'reset'); source in cache/compile/fallback."""
+    return [dict(p) for p in self._warmup_provenance]
 
   @property
   def compile_records(self) -> List[Dict[str, Any]]:
@@ -321,18 +347,22 @@ class SessionEngine:
         features = {k: np.asarray(v) for k, v in dict(wire).items()}
         slots = np.zeros((bucket,), np.int32)  # null slot: warmup-safe
         mask = np.zeros((bucket,), bool)
-        rec_name = f"{self._name}/decode{bucket}"
+        rec_name = f"{self._cache_namespace}/decode{bucket}"
         self._compile_one(rec_name, bucket, fn, cache,
                           (state, self._arena, slots, features, mask),
                           obs_xray)
       if self._reset_compiled is None and self._reset_jit is None:
         self._reset_jit = self._make_reset()
-        rec_name = f"{self._name}/reset_slot"
+        rec_name = f"{self._cache_namespace}/reset_slot"
         self._compile_one(rec_name, "reset", self._reset_jit, cache,
                           (self._arena, np.int32(0), self._init_row),
                           obs_xray)
       self._warmup_ms = (time.perf_counter() - warmup_start) * 1e3
       obs_metrics.gauge("serve/session/warmup_ms").set(self._warmup_ms)
+      obs_metrics.gauge("serve/session/warmup_load_ms").set(
+          self._warmup_load_ms)
+      obs_metrics.gauge("serve/session/warmup_compile_ms").set(
+          self._warmup_compile_ms)
     return self
 
   def _compile_one(self, rec_name: str, key, fn, cache, args,
@@ -344,6 +374,7 @@ class SessionEngine:
     arena buffer survives; the no-AOT fallback dispatches for real and
     must rebind the donated-in arena from the result."""
     start = time.perf_counter()
+    source = "compile"
     try:
       compiled, record = obs_xray.analyze_jit(rec_name, fn, *args,
                                               cache=cache)
@@ -355,20 +386,79 @@ class SessionEngine:
       else:
         self._arena = out[0]
       compiled = None
+      source = "fallback"
       record = {"name": rec_name,
                 "compile_s": time.perf_counter() - start,
                 "error": f"{type(e).__name__}: {e}"}
+    elapsed_ms = (time.perf_counter() - start) * 1e3
     if key == "reset":
       self._reset_compiled = compiled
     else:
       self._compiled[key] = compiled
     self._records[rec_name] = record
-    if (record.get("cache") or {}).get("hit"):
+    cache_block = record.get("cache") or {}
+    if cache_block.get("hit"):
+      source = "cache"
       self._cache_loads += 1
+      self._warmup_load_ms += elapsed_ms
       obs_metrics.counter("serve/session/cache_loads").inc()
     else:
       self._compile_count += 1
+      self._warmup_compile_ms += elapsed_ms
       obs_metrics.counter("serve/session/compiles").inc()
+    self._warmup_provenance.append(
+        {"rung": key, "source": source, "ms": elapsed_ms,
+         "key": cache_block.get("key")})
+
+  def rung_cache_keys(self) -> Dict[Any, str]:
+    """The graftcache key of every decode rung + the slot reset WITHOUT
+    compiling (trace-only; the graftforge --verify seam — the
+    BucketedEngine.rung_cache_keys contract). Binds the decode bundle
+    exactly as warmup would (the dispatch jits in `_dispatch_jits`
+    close over its decode_fn, and a later warmup reuses them — they
+    must share ONE bundle) but builds only a LOCAL throwaway arena for
+    the trace avals, so probing a cold engine allocates no resident
+    device state."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensor2robot_tpu import specs as specs_lib
+    from tensor2robot_tpu.obs import excache as excache_lib
+
+    with self._arena_lock:
+      if self._bundle is None:
+        self._bundle = self._predictor.decode_bundle()
+        self._max_ticks = getattr(self._bundle, "max_ticks", None)
+      bundle = self._bundle
+      arena = self._arena
+      init_row = self._init_row
+      if arena is None:
+        arena = jax.tree_util.tree_map(
+            jnp.asarray, bundle.init_session_state(self._max_sessions + 1))
+        init_row = jax.tree_util.tree_map(
+            jnp.asarray, bundle.init_session_state(1))
+      state = bundle.get_state()
+      keys: Dict[Any, str] = {}
+      for bucket in self._buckets:
+        fn = self._dispatch_jits.setdefault(
+            bucket, self._make_dispatch(bundle.decode_fn))
+        wire = specs_lib.make_random_numpy(bundle.observation_spec,
+                                           batch_size=bucket, seed=0)
+        features = {k: np.asarray(v) for k, v in dict(wire).items()}
+        slots = np.zeros((bucket,), np.int32)
+        mask = np.zeros((bucket,), bool)
+        args = (state, arena, slots, features, mask)
+        traced = fn.trace(*args)
+        keys[bucket] = excache_lib.cache_key(
+            f"{self._cache_namespace}/decode{bucket}",
+            **excache_lib.key_components_from_traced(traced, args))
+      reset_fn = self._reset_jit or self._make_reset()
+      args = (arena, np.int32(0), init_row)
+      traced = reset_fn.trace(*args)
+      keys["reset"] = excache_lib.cache_key(
+          f"{self._cache_namespace}/reset_slot",
+          **excache_lib.key_components_from_traced(traced, args))
+      return keys
 
   # -- lifecycle ------------------------------------------------------------
 

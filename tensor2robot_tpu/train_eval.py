@@ -322,7 +322,12 @@ def train_eval_model(
                  else executable_cache_dir)
     try:
       executable_cache = excache_lib.ExecutableCache(cache_dir)
-      if mode in ("evaluate", "continuous_eval"):
+      if (mode in ("evaluate", "continuous_eval")
+          or not excache_lib.donating_mesh_cache_unsafe()):
+        # Eval-only modes never dispatch a donating executable; and a
+        # toolchain re-verified past excache.DONATING_MESH_SAFE_FROM
+        # lifts the train-mode gate below wholesale — both tiers un-gate
+        # on the one pin (ROADMAP item 5's standing note).
         excache_lib.enable_xla_cache(cache_dir)
       else:
         # Training modes must NOT arm the XLA persistent-cache tier on

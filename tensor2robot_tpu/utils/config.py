@@ -393,6 +393,41 @@ def query_parameter(dotted: str) -> Any:
   raise ConfigError(f"No binding for {dotted!r}")
 
 
+def query_parameter_or(dotted: str, default: Any = None) -> Any:
+  """`query_parameter` that returns `default` instead of raising when
+  the parameter is unbound — the graftforge enumeration reads a parsed
+  research config this way (a config that does not bind a knob means
+  the deployment uses the code default, not that enumeration fails).
+  Returns the binding UNRESOLVED when resolution needs a registry the
+  caller has not imported (a dangling @ref is still 'bound')."""
+  try:
+    return query_parameter(dotted)
+  except ConfigError:
+    pass
+  scope, name, param = _parse_lhs(dotted)
+  if (scope, name, param) in _REGISTRY.bindings:
+    return _REGISTRY.bindings[(scope, name, param)]
+  return default
+
+
+def bound_configurables() -> set:
+  """Names of every configurable with at least one active binding (any
+  scope) — how graftforge decides which executable families a parsed
+  research config deploys, without building anything."""
+  return {conf for (_, conf, _) in _REGISTRY.bindings}
+
+
+def raw_binding(dotted: str, default: Any = None) -> Any:
+  """The UNRESOLVED binding for `Conf.param` (default when unbound).
+
+  `@Name()` evaluated references resolve to a constructed INSTANCE —
+  graftforge's enumeration must read the reference's name without
+  building a model at plan time, so it reads the raw binding
+  (`_ConfigurableReference.name`) instead of `query_parameter`."""
+  scope, name, param = _parse_lhs(dotted)
+  return _REGISTRY.bindings.get((scope, name, param), default)
+
+
 # ---------------------------------------------------------------------------
 # Parser
 # ---------------------------------------------------------------------------
