@@ -27,6 +27,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -51,28 +52,36 @@ def _is_cache_key_call(func: ast.AST) -> bool:
   return False
 
 
+def _check_call(path: str, node: ast.Call) -> List[Finding]:
+  """Findings for one Call node (shared by the standalone parse path
+  and the engine's single-walk visitor dispatch)."""
+  if not _is_cache_key_call(node.func):
+    return []
+  if any(kw.arg is None for kw in node.keywords):
+    return []  # **splat: components arrive as a dict, not analyzable
+  passed = {kw.arg for kw in node.keywords}
+  missing = [c for c in REQUIRED_COMPONENTS if c not in passed]
+  if not missing:
+    return []
+  return [Finding(
+      path=path, line=node.lineno, rule=_RULE,
+      end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+      message=(f"cache_key call omits key component(s) "
+               f"{', '.join(missing)} — an under-keyed executable "
+               "cache can serve a mismatched executable (wrong "
+               "mesh/dtype/compiler); pass every component, e.g. "
+               "**excache.key_components_from_traced(traced, args)"))]
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine (née tracer_check) reports unparseable files
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not isinstance(node, ast.Call) or not _is_cache_key_call(node.func):
-      continue
-    if any(kw.arg is None for kw in node.keywords):
-      continue  # **splat: components arrive as a dict, not analyzable
-    passed = {kw.arg for kw in node.keywords}
-    missing = [c for c in REQUIRED_COMPONENTS if c not in passed]
-    if missing:
-      findings.append(Finding(
-          path=path, line=node.lineno, rule=_RULE,
-          end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
-          message=(f"cache_key call omits key component(s) "
-                   f"{', '.join(missing)} — an under-keyed executable "
-                   "cache can serve a mismatched executable (wrong "
-                   "mesh/dtype/compiler); pass every component, e.g. "
-                   "**excache.key_components_from_traced(traced, args)")))
+    if isinstance(node, ast.Call):
+      findings.extend(_check_call(path, node))
   return findings
 
 
@@ -81,3 +90,19 @@ def check_python_file(path: str) -> List[Finding]:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+engine_lib.register(engine_lib.Rule(
+    name="cache", kind="py", scope=".py", family="cache",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a `cache_key(...)` call site omits one\n"
+             "of the mandatory executable-cache key\n"
+             "components (jaxpr fingerprint, aval shapes/\n"
+             "dtypes, mesh topology, backend version,\n"
+             "donation layout, static args) — an under-keyed\n"
+             "cache can serve a mismatched executable;\n"
+             "a `**splat` call site is accepted"),
+        meaning=("a `cache_key(...)` call site omits a mandatory key "
+                 "component (`**splat` accepted)")),),
+    visitors={ast.Call: lambda ctx, node: _check_call(ctx.path, node)}))

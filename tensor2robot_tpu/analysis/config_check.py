@@ -398,3 +398,55 @@ def collect_mesh_axis_names(config_paths: Sequence[str]) -> Set[str]:
       if isinstance(st.value, (list, tuple)):
         axes.update(v for v in st.value if isinstance(v, str))
   return axes
+
+
+from tensor2robot_tpu.analysis import engine as engine_lib
+
+engine_lib.register(engine_lib.Rule(
+    name="config", kind="gin", scope=".gin", family="config",
+    infos=(
+        engine_lib.RuleInfo(
+            id="parse-error",
+            doc="file does not parse",
+            meaning="file does not parse"),
+        engine_lib.RuleInfo(
+            id="broken-import",
+            doc="an `import a.b.c` line fails to import",
+            meaning="an `import a.b.c` line fails to import"),
+        engine_lib.RuleInfo(
+            id="unknown-configurable",
+            doc="Name.param / @Name resolves to no configurable",
+            meaning=("`Name.param` / `@Name` resolves to no "
+                     "configurable")),
+        engine_lib.RuleInfo(
+            id="missing-import",
+            doc=("Name resolves, but only via import pollution —\n"
+                 "no import line (nor entry binary) covers its\n"
+                 "defining module in a fresh process"),
+            meaning=("resolves only via import pollution; a fresh "
+                     "process would fail")),
+        engine_lib.RuleInfo(
+            id="unknown-parameter",
+            doc="Name has no parameter `param`",
+            meaning=("`Name` has no parameter `param` (honors "
+                     "`**kwargs`)")),
+        engine_lib.RuleInfo(
+            id="duplicate-binding",
+            doc=("same (scope, Name, param) bound twice in one\n"
+                 "file (include-then-override is idiomatic)"),
+            meaning=("same (scope, Name, param) bound twice in one "
+                     "file; later shadows (include-then-override across "
+                     "files is idiomatic and not flagged)")),
+        engine_lib.RuleInfo(
+            id="undefined-macro",
+            doc="%MACRO referenced but never defined",
+            meaning="`%MACRO` referenced but never defined"),
+        engine_lib.RuleInfo(
+            id="type-mismatch",
+            doc="literal value contradicts annotation/default",
+            meaning=("literal value contradicts the parameter's "
+                     "annotation/default")),
+    ),
+    # Self-filtered (config_check applies each file's own suppressions,
+    # including across includes — the engine adds nothing on top).
+    check=lambda ctx: check_config_file(ctx.path)))

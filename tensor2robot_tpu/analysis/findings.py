@@ -50,12 +50,19 @@ class Suppressions:
 
   def is_suppressed(self, line: int, rule: str,
                     end_line: int = 0) -> bool:
+    return self.match(line, rule, end_line) is not None
+
+  def match(self, line: int, rule: str,
+            end_line: int = 0) -> Optional[int]:
+    """The physical line whose `# graftlint: disable` comment suppresses
+    (line, rule), or None — the suppression-provenance seam the engine's
+    JSON output reports (`suppressed_by`)."""
     for candidate in range(line, max(end_line, line) + 1):
       if candidate in self._by_line:
         rules = self._by_line[candidate]
         if not rules or rule in rules:
-          return True
-    return False
+          return candidate
+    return None
 
   def __bool__(self) -> bool:
     return bool(self._by_line)

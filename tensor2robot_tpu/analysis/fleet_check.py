@@ -35,6 +35,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -124,11 +125,9 @@ def _with_context_calls(body) -> List[ast.Call]:
   return calls
 
 
-def check_python_source(path: str, source: str) -> List[Finding]:
-  try:
-    tree = ast.parse(source, filename=path)
-  except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+def check_python_tree(path: str, tree: ast.Module) -> List[Finding]:
+  """Raw (unfiltered) findings over an already-parsed module (the
+  engine's entry point; `check_python_source` wraps it with a parse)."""
   findings: List[Finding] = []
   seen_ctors: set = set()
   for body, _ in _scope_bodies(tree):
@@ -177,8 +176,36 @@ def check_python_source(path: str, source: str) -> List[Finding]:
   return findings
 
 
+def check_python_source(path: str, source: str) -> List[Finding]:
+  try:
+    tree = ast.parse(source, filename=path)
+  except SyntaxError:
+    return []  # the engine reports unparseable files
+  return check_python_tree(path, tree)
+
+
 def check_python_file(path: str) -> List[Finding]:
   with open(path, encoding="utf-8", errors="replace") as f:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+engine_lib.register(engine_lib.Rule(
+    name="fleet", kind="py", scope=".py", family="fleet",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a `ServingFleet(...)` construction site whose\n"
+             "owning scope never calls close()/drain() on\n"
+             "it, uses it as a context manager, returns it,\n"
+             "or stores it on self — the fleet's\n"
+             "per-replica batcher workers are never joined\n"
+             "(the tunnel-safe join discipline the batchers\n"
+             "follow, mechanized for the fleet layer)"),
+        meaning=("a `ServingFleet(...)` construction site whose owning "
+                 "scope never calls `close()`/`drain()` on it, uses it "
+                 "as a context manager, returns it, or stores it on "
+                 "`self` — the fleet's per-replica batcher workers are "
+                 "never joined (the tunnel-safe join discipline, "
+                 "mechanized at the fleet layer)")),),
+    check=lambda ctx: check_python_tree(ctx.path, ctx.tree)))

@@ -32,6 +32,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -81,33 +82,42 @@ def _buckets_derivable(value: ast.AST,
   return False
 
 
+def _check_call(path: str, node: ast.Call,
+                literals: Dict[str, bool]) -> List[Finding]:
+  """Findings for one Call node (shared by the standalone parse path
+  and the engine's single-walk visitor dispatch; `literals` is the
+  once-per-file module-literal table)."""
+  if _callee_name(node.func) not in _ENGINE_NAMES:
+    return []
+  if any(kw.arg is None for kw in node.keywords):
+    return []  # **splat: not statically analyzable, accepted
+  findings: List[Finding] = []
+  for kw in node.keywords:
+    if kw.arg == "buckets" and not _buckets_derivable(kw.value,
+                                                      literals):
+      findings.append(Finding(
+          path=path, line=node.lineno, rule=_RULE,
+          end_line=getattr(node, "end_lineno", node.lineno),
+          message=(f"{_callee_name(node.func)} built with a runtime-"
+                   "derived bucket ladder: graftforge cannot "
+                   "enumerate these rungs from specs, so the compile "
+                   "farm cannot warm them — pass a literal ladder / "
+                   "bucket_ladder(...), or route the ladder change "
+                   "through ServingFleet.rollout(ladder=...) and "
+                   "suppress with justification")))
+  return findings
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine reports unparseable files
   literals = _module_literal_names(tree)
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not isinstance(node, ast.Call):
-      continue
-    if _callee_name(node.func) not in _ENGINE_NAMES:
-      continue
-    if any(kw.arg is None for kw in node.keywords):
-      continue  # **splat: not statically analyzable, accepted
-    for kw in node.keywords:
-      if kw.arg == "buckets" and not _buckets_derivable(kw.value,
-                                                        literals):
-        findings.append(Finding(
-            path=path, line=node.lineno, rule=_RULE,
-            end_line=getattr(node, "end_lineno", node.lineno),
-            message=(f"{_callee_name(node.func)} built with a runtime-"
-                     "derived bucket ladder: graftforge cannot "
-                     "enumerate these rungs from specs, so the compile "
-                     "farm cannot warm them — pass a literal ladder / "
-                     "bucket_ladder(...), or route the ladder change "
-                     "through ServingFleet.rollout(ladder=...) and "
-                     "suppress with justification")))
+    if isinstance(node, ast.Call):
+      findings.extend(_check_call(path, node, literals))
   return findings
 
 
@@ -119,3 +129,36 @@ def check_python_file(path: str) -> List[Finding]:
     return []
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+def _visit(ctx, node):
+  literals = ctx.memo("forge:literals",
+                      lambda: _module_literal_names(ctx.tree))
+  return _check_call(ctx.path, node, literals)
+
+
+engine_lib.register(engine_lib.Rule(
+    name="forge", kind="py", scope=".py", family="forge",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a BucketedEngine/SessionEngine construction\n"
+             "whose `buckets=` is computed at runtime —\n"
+             "graftforge cannot enumerate those rungs from\n"
+             "the config/specs, so the compile farm cannot\n"
+             "warm them and their first live request pays\n"
+             "the 20-40 s tunnel compile; literal ladders,\n"
+             "bucket_ladder(...), module-level literal\n"
+             "constants, and `**splat` sites are accepted\n"
+             "(route live ladder changes through\n"
+             "ServingFleet.rollout(ladder=...))"),
+        meaning=("a `BucketedEngine`/`SessionEngine` construction whose "
+                 "`buckets=` is computed at runtime — graftforge cannot "
+                 "enumerate those rungs from the config/specs, so the "
+                 "compile farm cannot warm them and their first live "
+                 "request pays the 20–40 s tunnel compile (literal "
+                 "ladders, `bucket_ladder(...)`, module-level literal "
+                 "constants, and `**splat` sites accepted; route live "
+                 "ladder changes through `ServingFleet.rollout("
+                 "ladder=...)`, which pre-forges inside the drained "
+                 "window)")),),
+    visitors={ast.Call: _visit}))

@@ -5,13 +5,36 @@ Usage (from the repo root):
   python -m tensor2robot_tpu.analysis.lint tensor2robot_tpu scripts
   python -m tensor2robot_tpu.analysis.lint --json some/file.py
   python -m tensor2robot_tpu.analysis.lint --list-rules
+  python -m tensor2robot_tpu.analysis.lint --cache-file .lintcache \
+      --changed-only tensor2robot_tpu
 
-Walks the given files/directories: `.gin` files go through the config
-checker, `.py` files through the tracer-hygiene and spec/sharding
-checkers. Mesh axis names are collected from ALL discovered configs
-before any Python file is checked, so spec annotations are validated
-against the full declared vocabulary. Exits non-zero iff findings
-remain after `# graftlint: disable=` suppressions.
+Thin shell over `analysis/engine.py`: the rule registry supplies the
+checkers, the engine parses each file ONCE and runs every registered
+rule over the shared tree (the old layout re-parsed every file per
+checker — ~10x the parses), and this module owns argv/exit-code/output
+concerns only. Mesh axis names are still collected from ALL discovered
+configs before any Python file is checked, so spec annotations are
+validated against the full declared vocabulary. Exits non-zero iff
+findings remain after `# graftlint: disable=` suppressions.
+
+Output contracts:
+
+* plain text — byte-stable `path:line: [rule] message` lines (existing
+  scripts parse this; the engine parity test pins the findings
+  themselves byte-identical to the per-checker pipeline);
+* `--json` — one JSON object per line with `severity` (from the rule
+  registry) and suppression provenance: suppressed findings are
+  emitted too, with `"suppressed": true` and `"suppressed_by": <line
+  of the disable comment>` (exit code counts only unsuppressed ones);
+* `--list-rules` — the catalog, generated from the registry
+  (docs/ARCHITECTURE.md renders the same registry; a test pins them);
+* `--stats` — `lint/files`, `lint/parse_ms`, `lint/rules_ms` on
+  stderr; `--runs PATH` appends the same block to a runs.jsonl so lint
+  latency is diff-gated like every other bench family;
+* `--baseline` / `--write-baseline` — accept today's findings, gate
+  only new ones (fingerprints are line-number-independent);
+* `--cache-file` / `--changed-only` — content-hash incremental mode
+  (`scripts/lint.sh --changed` is the CI entry point).
 
 No JAX backend is ever initialized (tests/test_static_analysis.py runs
 this CLI under a poisoned JAX_PLATFORMS to prove it); `scripts/lint.sh`
@@ -25,191 +48,55 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Tuple
+from typing import List, Optional
 
-from tensor2robot_tpu.analysis import (cache_check, config_check,
-                                       fleet_check, forge_check,
-                                       loop_check, native_check, pp_check,
-                                       retry_check, session_check,
-                                       spec_check, thread_check,
-                                       tracer_check)
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import Finding
 
 __all__ = ["run", "main"]
 
-_RULE_CATALOG = """\
-config rules (.gin):
-  parse-error            file does not parse
-  broken-import          an `import a.b.c` line fails to import
-  unknown-configurable   Name.param / @Name resolves to no configurable
-  missing-import         Name resolves, but only via import pollution —
-                         no import line (nor entry binary) covers its
-                         defining module in a fresh process
-  unknown-parameter      Name has no parameter `param`
-  duplicate-binding      same (scope, Name, param) bound twice in one
-                         file (include-then-override is idiomatic)
-  undefined-macro        %MACRO referenced but never defined
-  type-mismatch          literal value contradicts annotation/default
-
-tracer rules (.py):
-  block-until-ready      jax.block_until_ready outside utils/backend.py
-  import-time-backend    backend-touching call at module import level
-  host-sync-in-jit       .item() / float() / np.asarray() on traced
-                         values inside a jitted function
-  impure-in-jit          time.time / stateful np.random inside a jitted
-                         function
-  device-timing          time.time/perf_counter window around device
-                         dispatch without a host-fetch barrier (measures
-                         dispatch, not execution, over the tunnel);
-                         obs/ and utils/backend.py are exempt
-
-cache rules (.py):
-  cache-key-missing-component  a `cache_key(...)` call site omits one
-                         of the mandatory executable-cache key
-                         components (jaxpr fingerprint, aval shapes/
-                         dtypes, mesh topology, backend version,
-                         donation layout, static args) — an under-keyed
-                         cache can serve a mismatched executable;
-                         a `**splat` call site is accepted
-
-pipeline rules (.py):
-  pp-schedule-unaudited  a `make_pipelined_train_step(...)` call site
-                         that passes no `audit_name=` (or an explicit
-                         None) — the step skips the analyze_jit path,
-                         so per-stage donation bytes and the
-                         pp/bubble_fraction schedule telemetry never
-                         reach runs.jsonl; a `**splat` call site is
-                         accepted
-
-session rules (.py):
-  session-state-leak     a decode-step call site that discards the
-                         returned session state (bare expression, or
-                         the state slot bound to an underscore name) —
-                         later ticks replay the stale cache — or an
-                         np.asarray/device_get host fetch of a
-                         session_state/arena value, which re-buys the
-                         stateless per-tick cost (and ~1.5 s per eager
-                         fetch over the tunnel)
-
-retry rules (.py, serving//data/ hot paths only):
-  bare-retry-rule        a for/while loop containing BOTH a constant
-                         `time.sleep(<literal>)` AND a broad
-                         except-swallow (bare `except:` or
-                         `except (Base)Exception:` with a pass/continue
-                         body) — a hand-rolled retry with no jitter,
-                         deadline budget, or telemetry; migrate to
-                         `utils.retry.RetryPolicy` or suppress with
-                         justification
-
-fleet rules (.py):
-  fleet-replica-unjoined a `ServingFleet(...)` construction site whose
-                         owning scope never calls close()/drain() on
-                         it, uses it as a context manager, returns it,
-                         or stores it on self — the fleet's
-                         per-replica batcher workers are never joined
-                         (the tunnel-safe join discipline the batchers
-                         follow, mechanized for the fleet layer)
-
-forge rules (.py):
-  warmup-unforgeable     a BucketedEngine/SessionEngine construction
-                         whose `buckets=` is computed at runtime —
-                         graftforge cannot enumerate those rungs from
-                         the config/specs, so the compile farm cannot
-                         warm them and their first live request pays
-                         the 20-40 s tunnel compile; literal ladders,
-                         bucket_ladder(...), module-level literal
-                         constants, and `**splat` sites are accepted
-                         (route live ladder changes through
-                         ServingFleet.rollout(ladder=...))
-
-loop rules (.py, the loop/ package only):
-  unsupervised-loop-worker a bare threading.Thread construction in a
-                         loop-package module other than supervisor.py —
-                         the worker is outside the supervisor's restart/
-                         heartbeat/escalation machinery (dies silently,
-                         hangs invisibly); register it with
-                         Supervisor.spawn instead
-
-thread rules (.py):
-  thread-stage-missing-close     a class starts a threading.Thread but
-                         defines no close() — its worker can never be
-                         stopped/joined (the tunnel-wedging hazard);
-                         loader/stage classes must expose close()
-  thread-stage-missing-backstop  such a class has close() but neither
-                         __enter__ (context-manager use) nor a
-                         weakref.finalize backstop — an abandoned
-                         instance leaks its worker until process exit
-
-native rules (native/__init__.py ↔ native/*.cc):
-  native-binding-missing a .cc source exports a `t2r_*` symbol the
-                         ctypes wrapper never references
-  native-binding-unknown the wrapper references a `t2r_*` name no .cc
-                         source defines
-
-spec rules (.py):
-  unknown-mesh-axis      TensorSpec.sharding names an undeclared axis
-  duplicate-sharding-axis  same axis twice in one annotation
-  sharding-rank-mismatch more sharding entries than spec dims
-  sharding-conflict      feature vs label sharding disagreement
-                         (structure-level API only)
-
-Suppress a finding with a trailing `# graftlint: disable=<rule>`.
-"""
-
-_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
-
-
-def _discover(paths: List[str]) -> Tuple[List[str], List[str]]:
-  """(.py files, .gin files) under the given files/directories."""
-  py_files: List[str] = []
-  gin_files: List[str] = []
-  for path in paths:
-    if os.path.isfile(path):
-      (py_files if path.endswith(".py") else
-       gin_files if path.endswith(".gin") else []).append(path)
-      continue
-    for dirpath, dirnames, filenames in os.walk(path):
-      dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
-      for name in sorted(filenames):
-        if name.endswith(".py"):
-          py_files.append(os.path.join(dirpath, name))
-        elif name.endswith(".gin"):
-          gin_files.append(os.path.join(dirpath, name))
-  return py_files, gin_files
+# Back-compat alias: callers (and tests) reached lint._discover.
+_discover = engine_lib.discover
 
 
 def run(paths: List[str]) -> List[Finding]:
   """Runs all analyzers; returns every unsuppressed finding."""
-  py_files, gin_files = _discover(paths)
-  findings: List[Finding] = []
-  # The axis vocabulary always includes the repo's own shipped configs,
-  # not just configs under `paths` — otherwise linting a single .py file
-  # would flag axes (e.g. 'sp', 'pp') that a config elsewhere declares.
-  package_dir = os.path.dirname(os.path.abspath(__file__))
-  _, repo_gin = _discover([os.path.dirname(package_dir)])
-  mesh_axes = spec_check.known_mesh_axes(
-      sorted(set(gin_files) | set(repo_gin)))
-  for path in gin_files:
-    findings.extend(config_check.check_config_file(path))
-  for path in py_files:
-    findings.extend(tracer_check.check_python_file(path))
-    findings.extend(spec_check.check_python_file(path, mesh_axes))
-    findings.extend(cache_check.check_python_file(path))
-    findings.extend(pp_check.check_python_file(path))
-    findings.extend(session_check.check_python_file(path))
-    findings.extend(fleet_check.check_python_file(path))
-    findings.extend(forge_check.check_python_file(path))
-    findings.extend(retry_check.check_python_file(path))
-    findings.extend(thread_check.check_python_file(path))
-    findings.extend(loop_check.check_python_file(path))
-    # A native-package wrapper pulls in the export/binding coverage
-    # check for its whole directory (.cc sources aren't walked
-    # directly — the wrapper is the unit whose drift matters).
-    if (os.path.basename(path) == "__init__.py"
-        and os.path.basename(os.path.dirname(path)) == "native"):
-      findings.extend(native_check.check_native_bindings(
-          os.path.dirname(path)))
-  return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+  return engine_lib.run_engine(paths).findings
+
+
+def _finding_json(finding: Finding, suppressed_by: Optional[int] = None
+                  ) -> str:
+  record = {"path": finding.path, "line": finding.line,
+            "rule": finding.rule,
+            "severity": engine_lib.severity_of(finding.rule),
+            "message": finding.message,
+            "suppressed": suppressed_by is not None}
+  if suppressed_by is not None:
+    record["suppressed_by"] = suppressed_by
+  return json.dumps(record)
+
+
+def _append_runs_record(runs_path: str, stats: dict,
+                        finding_count: int) -> None:
+  """One runs.jsonl bench record carrying the lint telemetry block —
+  `graftscope diff` gates lint_parse_ms/lint_rules_ms like any other
+  wall-clock metric (runlog.DEFAULT_THRESHOLDS)."""
+  from tensor2robot_tpu.obs import runlog
+  record = runlog.make_record(
+      "bench",
+      bench={"name": "lint", "unit": "ms",
+             "lint_parse_ms": stats["parse_ms"],
+             "lint_rules_ms": stats["rules_ms"]},
+      extra={"lint": {"files": stats["files"],
+                      "py_files": stats["py_files"],
+                      "gin_files": stats["gin_files"],
+                      "parses": stats["parses"],
+                      "parse_ms": stats["parse_ms"],
+                      "rules_ms": stats["rules_ms"],
+                      "wall_ms": stats["wall_ms"],
+                      "cache_hits": stats["cache_hits"],
+                      "findings": finding_count}})
+  runlog.append_record(runs_path, record)
 
 
 def main(argv: List[str] = None) -> int:
@@ -222,13 +109,38 @@ def main(argv: List[str] = None) -> int:
                       help="files or directories to lint "
                            "(default: tensor2robot_tpu scripts)")
   parser.add_argument("--json", action="store_true", dest="as_json",
-                      help="emit findings as JSON lines")
+                      help="emit findings as JSON lines (includes rule "
+                           "severity and suppression provenance)")
   parser.add_argument("--list-rules", action="store_true",
-                      help="print the rule catalog and exit")
+                      help="print the rule catalog (generated from the "
+                           "rule registry) and exit")
+  parser.add_argument("--stats", action="store_true",
+                      help="print lint files/parse/rule timing to stderr")
+  parser.add_argument("--runs", metavar="PATH",
+                      help="append a lint telemetry record to this "
+                           "runs.jsonl (diff-gated like bench metrics)")
+  parser.add_argument("--baseline", metavar="PATH",
+                      help="suppress findings recorded in this baseline "
+                           "file (gate only NEW findings)")
+  parser.add_argument("--write-baseline", metavar="PATH",
+                      help="write current findings to a baseline file "
+                           "and exit 0")
+  parser.add_argument("--cache-file", metavar="PATH",
+                      help="incremental mode: reuse findings of files "
+                           "whose content hash is unchanged")
+  parser.add_argument("--changed-only", action="store_true",
+                      help="with --cache-file: report only files whose "
+                           "content hash moved (CI fast path; .gin "
+                           "results may be stale vs module edits — run "
+                           "a full lint before release)")
   args = parser.parse_args(argv)
   if args.list_rules:
-    print(_RULE_CATALOG, end="")
+    print(engine_lib.catalog_text(), end="")
     return 0
+  if args.changed_only and not args.cache_file:
+    print("graftlint: --changed-only requires --cache-file",
+          file=sys.stderr)
+    return 2
   missing = [p for p in args.paths if not os.path.exists(p)]
   if missing:
     print(f"graftlint: no such path: {', '.join(missing)}",
@@ -242,14 +154,42 @@ def main(argv: List[str] = None) -> int:
     print("graftlint: unsupported file type (want .py or .gin): "
           f"{', '.join(unsupported)}", file=sys.stderr)
     return 2
-  findings = run(list(args.paths))
+  result = engine_lib.run_engine(list(args.paths),
+                                 cache_path=args.cache_file,
+                                 changed_only=args.changed_only)
+  findings = result.findings
+  if args.write_baseline:
+    engine_lib.write_baseline(args.write_baseline, findings)
+    print(f"graftlint: baseline with {len(findings)} finding(s) "
+          f"written to {args.write_baseline}", file=sys.stderr)
+    return 0
+  if args.baseline:
+    try:
+      known = engine_lib.load_baseline(args.baseline)
+    except (OSError, ValueError) as e:
+      print(f"graftlint: cannot read baseline: {e}", file=sys.stderr)
+      return 2
+    findings = [f for f in findings
+                if engine_lib.finding_fingerprint(f) not in known]
   for finding in findings:
     if args.as_json:
-      print(json.dumps({"path": finding.path, "line": finding.line,
-                        "rule": finding.rule,
-                        "message": finding.message}))
+      print(_finding_json(finding))
     else:
       print(finding)
+  if args.as_json:
+    # Suppression provenance: what `# graftlint: disable` comments ate,
+    # and where — so a JSON consumer can audit the suppressions too.
+    for finding, at_line in result.suppressed:
+      print(_finding_json(finding, suppressed_by=at_line))
+  if args.stats:
+    s = result.stats
+    print(f"graftlint: lint/files={s['files']} "
+          f"lint/parse_ms={s['parse_ms']:.1f} "
+          f"lint/rules_ms={s['rules_ms']:.1f} "
+          f"(parses={s['parses']}, cache_hits={s['cache_hits']}, "
+          f"wall_ms={s['wall_ms']:.1f})", file=sys.stderr)
+  if args.runs:
+    _append_runs_record(args.runs, result.stats, len(findings))
   if findings:
     print(f"graftlint: {len(findings)} finding(s)", file=sys.stderr)
     return 1

@@ -32,6 +32,7 @@ import ast
 import os
 from typing import List
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -54,26 +55,38 @@ def _is_thread_ctor(func: ast.AST) -> bool:
   return False
 
 
-def check_python_source(path: str, source: str) -> List[Finding]:
-  if not _in_loop_package(path):
+def _rule_applies(path: str) -> bool:
+  return (_in_loop_package(path)
+          and os.path.basename(path) not in _EXEMPT_BASENAMES)
+
+
+def _check_call(path: str, node: ast.Call) -> List[Finding]:
+  """Findings for one Call node (shared by the standalone parse path
+  and the engine's single-walk visitor dispatch; the path gate is
+  applied by the caller)."""
+  if not _is_thread_ctor(node.func):
     return []
-  if os.path.basename(path) in _EXEMPT_BASENAMES:
+  end_line = getattr(node, "end_lineno", node.lineno) or node.lineno
+  return [Finding(
+      path=path, line=node.lineno, rule=_RULE, end_line=end_line,
+      message=("bare threading.Thread in the loop package: this "
+               "worker is outside the supervisor's restart/heartbeat"
+               "/escalation machinery — it dies silently and hangs "
+               "invisibly. Register it with Supervisor.spawn(name, "
+               "target) (loop/supervisor.py) instead."))]
+
+
+def check_python_source(path: str, source: str) -> List[Finding]:
+  if not _rule_applies(path):
     return []
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine reports unparseable files
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if isinstance(node, ast.Call) and _is_thread_ctor(node.func):
-      end_line = getattr(node, "end_lineno", node.lineno) or node.lineno
-      findings.append(Finding(
-          path=path, line=node.lineno, rule=_RULE, end_line=end_line,
-          message=("bare threading.Thread in the loop package: this "
-                   "worker is outside the supervisor's restart/heartbeat"
-                   "/escalation machinery — it dies silently and hangs "
-                   "invisibly. Register it with Supervisor.spawn(name, "
-                   "target) (loop/supervisor.py) instead.")))
+    if isinstance(node, ast.Call):
+      findings.extend(_check_call(path, node))
   return findings
 
 
@@ -82,3 +95,24 @@ def check_python_file(path: str) -> List[Finding]:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+engine_lib.register(engine_lib.Rule(
+    name="loop", kind="py", scope=".py, the loop/ package only",
+    family="loop",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a bare threading.Thread construction in a\n"
+             "loop-package module other than supervisor.py —\n"
+             "the worker is outside the supervisor's restart/\n"
+             "heartbeat/escalation machinery (dies silently,\n"
+             "hangs invisibly); register it with\n"
+             "Supervisor.spawn instead"),
+        meaning=("a bare `threading.Thread` construction in a "
+                 "loop-package module other than `supervisor.py` — the "
+                 "worker is outside the supervisor's restart/heartbeat/"
+                 "escalation machinery (dies silently, hangs "
+                 "invisibly); register it with `Supervisor.spawn` "
+                 "instead")),),
+    path_filter=_rule_applies,
+    visitors={ast.Call: lambda ctx, node: _check_call(ctx.path, node)}))

@@ -19,6 +19,7 @@ import os
 import re
 from typing import List, Set, Tuple
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -108,3 +109,34 @@ def check_native_bindings(native_dir: str) -> List[Finding]:
           message=f"{token!r} is referenced here but no .cc source "
                   "defines it (typo, or the C++ side was removed)"))
   return filter_findings(findings, load_suppressions(init_text))
+
+
+def _is_native_wrapper(path: str) -> bool:
+  """A native-package wrapper pulls in the export/binding coverage
+  check for its whole directory (.cc sources aren't walked directly —
+  the wrapper is the unit whose drift matters)."""
+  return (os.path.basename(path) == "__init__.py"
+          and os.path.basename(os.path.dirname(path)) == "native")
+
+
+engine_lib.register(engine_lib.Rule(
+    name="native", kind="native",
+    scope="native/__init__.py ↔ native/*.cc", family="native",
+    infos=(
+        engine_lib.RuleInfo(
+            id="native-binding-missing",
+            doc=("a .cc source exports a `t2r_*` symbol the\n"
+                 "ctypes wrapper never references"),
+            meaning=("a `.cc` source exports a `t2r_*` symbol the "
+                     "ctypes wrapper never references")),
+        engine_lib.RuleInfo(
+            id="native-binding-unknown",
+            doc=("the wrapper references a `t2r_*` name no .cc\n"
+                 "source defines"),
+            meaning=("the wrapper references a `t2r_*` name no `.cc` "
+                     "source defines")),
+    ),
+    path_filter=_is_native_wrapper,
+    # Self-filtered against __init__.py's own suppressions (the engine's
+    # central pass re-applies the same suppressions — a no-op).
+    check=lambda ctx: check_native_bindings(os.path.dirname(ctx.path))))

@@ -28,6 +28,7 @@ from __future__ import annotations
 import ast
 from typing import List
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -45,32 +46,40 @@ def _is_factory_call(func: ast.AST) -> bool:
   return False
 
 
+def _check_call(path: str, node: ast.Call) -> List[Finding]:
+  """Findings for one Call node (shared by the standalone parse path
+  and the engine's single-walk visitor dispatch)."""
+  if not _is_factory_call(node.func):
+    return []
+  if any(kw.arg is None for kw in node.keywords):
+    return []  # **splat: audit_name may arrive in the dict
+  audit = next((kw for kw in node.keywords if kw.arg == "audit_name"),
+               None)
+  audited = audit is not None and not (
+      isinstance(audit.value, ast.Constant) and audit.value.value is None)
+  if audited:
+    return []
+  return [Finding(
+      path=path, line=node.lineno, rule=_RULE,
+      end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+      message=("pipelined train step built without audit_name= — the "
+               "step never routes through analyze_jit, so its "
+               "per-stage donation bytes and pp/bubble_fraction "
+               "schedule telemetry stay out of runs.jsonl and "
+               "schedule regressions can't be diff-gated; pass "
+               "audit_name='<run>/pp_train_step' (or suppress a "
+               "deliberate opt-out)"))]
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine reports unparseable files
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not isinstance(node, ast.Call) or not _is_factory_call(node.func):
-      continue
-    if any(kw.arg is None for kw in node.keywords):
-      continue  # **splat: audit_name may arrive in the dict
-    audit = next((kw for kw in node.keywords if kw.arg == "audit_name"),
-                 None)
-    audited = audit is not None and not (
-        isinstance(audit.value, ast.Constant) and audit.value.value is None)
-    if not audited:
-      findings.append(Finding(
-          path=path, line=node.lineno, rule=_RULE,
-          end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
-          message=("pipelined train step built without audit_name= — the "
-                   "step never routes through analyze_jit, so its "
-                   "per-stage donation bytes and pp/bubble_fraction "
-                   "schedule telemetry stay out of runs.jsonl and "
-                   "schedule regressions can't be diff-gated; pass "
-                   "audit_name='<run>/pp_train_step' (or suppress a "
-                   "deliberate opt-out)")))
+    if isinstance(node, ast.Call):
+      findings.extend(_check_call(path, node))
   return findings
 
 
@@ -79,3 +88,22 @@ def check_python_file(path: str) -> List[Finding]:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+engine_lib.register(engine_lib.Rule(
+    name="pp", kind="py", scope=".py", family="pipeline",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a `make_pipelined_train_step(...)` call site\n"
+             "that passes no `audit_name=` (or an explicit\n"
+             "None) — the step skips the analyze_jit path,\n"
+             "so per-stage donation bytes and the\n"
+             "pp/bubble_fraction schedule telemetry never\n"
+             "reach runs.jsonl; a `**splat` call site is\n"
+             "accepted"),
+        meaning=("a `make_pipelined_train_step(...)` call site passes no "
+                 "`audit_name=` (or an explicit None) — the step skips "
+                 "analyze_jit, so per-stage donation bytes and "
+                 "pp/bubble_fraction telemetry never reach runs.jsonl "
+                 "(`**splat` accepted)")),),
+    visitors={ast.Call: lambda ctx, node: _check_call(ctx.path, node)}))

@@ -36,6 +36,7 @@ import ast
 import os
 from typing import List
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -117,36 +118,43 @@ def _in_hot_path(path: str) -> bool:
   return bool(_HOT_DIRS.intersection(parts))
 
 
+def _check_loop(path: str, node: ast.AST) -> List[Finding]:
+  """Findings for one For/While/AsyncFor node (shared by the standalone
+  parse path and the engine's single-walk visitor dispatch; the
+  hot-path gate is applied by the caller)."""
+  has_sleep = False
+  swallow_line = None
+  for inner in _walk_no_nested_defs(node):
+    if inner is node:
+      continue
+    if _is_constant_sleep(inner):
+      has_sleep = True
+    elif isinstance(inner, ast.ExceptHandler) and _swallows_broadly(inner):
+      swallow_line = inner.lineno
+  if not has_sleep or swallow_line is None:
+    return []
+  return [Finding(
+      path=path, line=node.lineno, rule=_RULE,
+      end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
+      message=(
+          "retry loop with a constant time.sleep and a broad "
+          f"except-swallow (line {swallow_line}) in a serving/data "
+          "hot path — use utils.retry.RetryPolicy (jittered "
+          "backoff, deadline budget, retry/* telemetry) or "
+          "suppress with justification"))]
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   if not _in_hot_path(path):
     return []
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # the tracer checker owns parse errors
+    return []  # the engine owns parse errors
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
-      continue
-    has_sleep = False
-    swallow_line = None
-    for inner in _walk_no_nested_defs(node):
-      if inner is node:
-        continue
-      if _is_constant_sleep(inner):
-        has_sleep = True
-      elif isinstance(inner, ast.ExceptHandler) and _swallows_broadly(inner):
-        swallow_line = inner.lineno
-    if has_sleep and swallow_line is not None:
-      findings.append(Finding(
-          path=path, line=node.lineno, rule=_RULE,
-          end_line=getattr(node, "end_lineno", node.lineno) or node.lineno,
-          message=(
-              "retry loop with a constant time.sleep and a broad "
-              f"except-swallow (line {swallow_line}) in a serving/data "
-              "hot path — use utils.retry.RetryPolicy (jittered "
-              "backoff, deadline budget, retry/* telemetry) or "
-              "suppress with justification")))
+    if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+      findings.extend(_check_loop(path, node))
   suppressions = load_suppressions(source)
   return filter_findings(findings, suppressions)
 
@@ -159,3 +167,33 @@ def check_python_file(path: str) -> List[Finding]:
     return [Finding(path=path, line=0, rule=_RULE,
                     message=f"cannot read file: {e}")]
   return check_python_source(path, source)
+
+
+def _visit(ctx, node):
+  return _check_loop(ctx.path, node)
+
+
+engine_lib.register(engine_lib.Rule(
+    name="retry", kind="py", scope=".py, serving//data/ hot paths only",
+    family="retry",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a for/while loop containing BOTH a constant\n"
+             "`time.sleep(<literal>)` AND a broad\n"
+             "except-swallow (bare `except:` or\n"
+             "`except (Base)Exception:` with a pass/continue\n"
+             "body) — a hand-rolled retry with no jitter,\n"
+             "deadline budget, or telemetry; migrate to\n"
+             "`utils.retry.RetryPolicy` or suppress with\n"
+             "justification"),
+        meaning=("a `for`/`while` loop in a `serving/`/`data/` hot path "
+                 "containing BOTH a constant `time.sleep(<literal>)` "
+                 "AND a broad except-swallow (bare `except:` / `except "
+                 "(Base)Exception:` with a pass/continue body) — a "
+                 "hand-rolled retry with no jitter, deadline budget, or "
+                 "`retry/*` telemetry; migrate to "
+                 "`utils.retry.RetryPolicy` (`analysis/retry_check.py`; "
+                 "computed delays like `sleep(policy.backoff_s(n))` and "
+                 "pure poll loops are not flagged)")),),
+    path_filter=_in_hot_path,
+    visitors={ast.For: _visit, ast.While: _visit, ast.AsyncFor: _visit}))

@@ -40,6 +40,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -86,46 +87,54 @@ def _finding(path: str, node: ast.AST, message: str) -> Finding:
       message=message)
 
 
+def _check_node(path: str, node: ast.AST) -> List[Finding]:
+  """Findings for one Expr/Assign/Call node (shared by the standalone
+  parse path and the engine's single-walk visitor dispatch)."""
+  findings: List[Finding] = []
+  # Dropped decode state: `decode_step(...)` as a bare statement.
+  if (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+      and _call_name(node.value.func) in _DECODE_NAMES):
+    findings.append(_finding(
+        path, node,
+        "decode-step result discarded — the returned session state is "
+        "never re-bound, so every later tick replays the stale cache; "
+        "bind it (`state, outputs = decode_step(...)`) or suppress a "
+        "deliberate throwaway"))
+    return findings
+  # Dropped decode state spelled as `_ , out = decode_step(...)`.
+  if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+      and _call_name(node.value.func) in _DECODE_NAMES:
+    for target in node.targets:
+      if isinstance(target, (ast.Tuple, ast.List)) and target.elts \
+          and _is_underscore(target.elts[0]):
+        findings.append(_finding(
+            path, node,
+            "decode-step state bound to an underscore name — the new "
+            "session state is dropped and later ticks replay the "
+            "stale cache; re-bind the state or suppress a deliberate "
+            "single-tick probe"))
+        break
+  # Host fetch of session state: np.asarray(...session_state/arena...).
+  if isinstance(node, ast.Call) and _call_name(node.func) in _FETCH_NAMES:
+    if any(_mentions_state(arg) for arg in node.args[:1]):
+      findings.append(_finding(
+          path, node,
+          "session state fetched to host — per-session decode caches "
+          "must stay device-resident between ticks (a KV-cache fetch "
+          "per tick re-buys the stateless cost, and each eager fetch "
+          "over the axon tunnel is ~1.5 s); fetch OUTPUTS only, or "
+          "suppress a deliberate debug dump"))
+  return findings
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine reports unparseable files
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    # Dropped decode state: `decode_step(...)` as a bare statement.
-    if (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
-        and _call_name(node.value.func) in _DECODE_NAMES):
-      findings.append(_finding(
-          path, node,
-          "decode-step result discarded — the returned session state is "
-          "never re-bound, so every later tick replays the stale cache; "
-          "bind it (`state, outputs = decode_step(...)`) or suppress a "
-          "deliberate throwaway"))
-      continue
-    # Dropped decode state spelled as `_ , out = decode_step(...)`.
-    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
-        and _call_name(node.value.func) in _DECODE_NAMES:
-      for target in node.targets:
-        if isinstance(target, (ast.Tuple, ast.List)) and target.elts \
-            and _is_underscore(target.elts[0]):
-          findings.append(_finding(
-              path, node,
-              "decode-step state bound to an underscore name — the new "
-              "session state is dropped and later ticks replay the "
-              "stale cache; re-bind the state or suppress a deliberate "
-              "single-tick probe"))
-          break
-    # Host fetch of session state: np.asarray(...session_state/arena...).
-    if isinstance(node, ast.Call) and _call_name(node.func) in _FETCH_NAMES:
-      if any(_mentions_state(arg) for arg in node.args[:1]):
-        findings.append(_finding(
-            path, node,
-            "session state fetched to host — per-session decode caches "
-            "must stay device-resident between ticks (a KV-cache fetch "
-            "per tick re-buys the stateless cost, and each eager fetch "
-            "over the axon tunnel is ~1.5 s); fetch OUTPUTS only, or "
-            "suppress a deliberate debug dump"))
+    findings.extend(_check_node(path, node))
   return findings
 
 
@@ -134,3 +143,28 @@ def check_python_file(path: str) -> List[Finding]:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+def _visit(ctx, node):
+  return _check_node(ctx.path, node)
+
+
+engine_lib.register(engine_lib.Rule(
+    name="session", kind="py", scope=".py", family="session",
+    infos=(engine_lib.RuleInfo(
+        id=_RULE,
+        doc=("a decode-step call site that discards the\n"
+             "returned session state (bare expression, or\n"
+             "the state slot bound to an underscore name) —\n"
+             "later ticks replay the stale cache — or an\n"
+             "np.asarray/device_get host fetch of a\n"
+             "session_state/arena value, which re-buys the\n"
+             "stateless per-tick cost (and ~1.5 s per eager\n"
+             "fetch over the tunnel)"),
+        meaning=("a decode-step call site drops the returned session "
+                 "state (bare expression / state bound to an underscore "
+                 "name) so later ticks replay the stale cache, or "
+                 "host-fetches a session_state/arena value "
+                 "(`np.asarray`/`device_get`), re-buying the stateless "
+                 "per-tick cost")),),
+    visitors={ast.Expr: _visit, ast.Assign: _visit, ast.Call: _visit}))

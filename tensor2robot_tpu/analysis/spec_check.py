@@ -32,6 +32,7 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -97,6 +98,32 @@ def _check_axes(axes: Tuple, rank: Optional[int], mesh_axes: Set[str],
   return findings
 
 
+def _check_tensorspec_call(path: str, node: ast.Call,
+                           mesh_axes: Set[str]) -> List[Finding]:
+  """Findings for one TensorSpec(...) Call node (shared by the
+  standalone parse path and the engine's single-walk dispatch)."""
+  if not _is_tensorspec_call(node):
+    return []
+  sharding_node = shape_node = None
+  for kw in node.keywords:
+    if kw.arg == "sharding":
+      sharding_node = kw.value
+    elif kw.arg == "shape":
+      shape_node = kw.value
+  if shape_node is None and node.args:
+    shape_node = node.args[0]
+  if sharding_node is None:
+    return []
+  sharding = _literal(sharding_node)
+  if not isinstance(sharding, (list, tuple)):
+    return []  # computed sharding: out of static reach
+  shape = _literal(shape_node) if shape_node is not None else None
+  rank = len(shape) if isinstance(shape, (list, tuple)) else None
+  return _check_axes(
+      tuple(sharding), rank, mesh_axes, path, node.lineno, "TensorSpec",
+      end_line=getattr(node, "end_lineno", 0) or 0)
+
+
 def check_python_source(text: str, path: str,
                         mesh_axes: Optional[Set[str]] = None
                         ) -> List[Finding]:
@@ -105,29 +132,11 @@ def check_python_source(text: str, path: str,
   try:
     tree = ast.parse(text, filename=path)
   except SyntaxError:
-    return []  # tracer_check owns the parse-error finding
+    return []  # the engine owns the parse-error finding
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not (isinstance(node, ast.Call) and _is_tensorspec_call(node)):
-      continue
-    sharding_node = shape_node = None
-    for kw in node.keywords:
-      if kw.arg == "sharding":
-        sharding_node = kw.value
-      elif kw.arg == "shape":
-        shape_node = kw.value
-    if shape_node is None and node.args:
-      shape_node = node.args[0]
-    if sharding_node is None:
-      continue
-    sharding = _literal(sharding_node)
-    if not isinstance(sharding, (list, tuple)):
-      continue  # computed sharding: out of static reach
-    shape = _literal(shape_node) if shape_node is not None else None
-    rank = len(shape) if isinstance(shape, (list, tuple)) else None
-    findings.extend(_check_axes(
-        tuple(sharding), rank, mesh_axes, path, node.lineno, "TensorSpec",
-        end_line=getattr(node, "end_lineno", 0) or 0))
+    if isinstance(node, ast.Call):
+      findings.extend(_check_tensorspec_call(path, node, mesh_axes))
   return sorted(filter_findings(findings, load_suppressions(text)),
                 key=lambda f: (f.line, f.rule))
 
@@ -172,3 +181,31 @@ def check_spec_structures(feature_spec,
             f"but {sharding!r} in label_spec"))
       by_key.setdefault(key, sharding)
   return findings
+
+
+engine_lib.register(engine_lib.Rule(
+    name="spec", kind="py", scope=".py", family="spec",
+    infos=(
+        engine_lib.RuleInfo(
+            id="unknown-mesh-axis",
+            doc="TensorSpec.sharding names an undeclared axis",
+            meaning=("`TensorSpec.sharding` names an axis no mesh "
+                     "declares")),
+        engine_lib.RuleInfo(
+            id="duplicate-sharding-axis",
+            doc="same axis twice in one annotation",
+            meaning=("same axis twice in one annotation (PartitionSpec "
+                     "forbids)")),
+        engine_lib.RuleInfo(
+            id="sharding-rank-mismatch",
+            doc="more sharding entries than spec dims",
+            meaning="more sharding entries than spec dims"),
+        engine_lib.RuleInfo(
+            id="sharding-conflict",
+            doc=("feature vs label sharding disagreement\n"
+                 "(structure-level API only)"),
+            meaning=("feature vs label sharding disagreement "
+                     "(structure-level API)")),
+    ),
+    visitors={ast.Call: lambda ctx, node: _check_tensorspec_call(
+        ctx.path, node, ctx.mesh_axes)}))

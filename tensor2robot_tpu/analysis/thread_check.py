@@ -38,6 +38,7 @@ from __future__ import annotations
 import ast
 from typing import List, Optional
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -94,40 +95,45 @@ def _scan_class(cls: ast.ClassDef):
   return thread_calls, has_close, has_enter, has_finalize
 
 
+def _check_class(path: str, node: ast.ClassDef) -> List[Finding]:
+  """Findings for one ClassDef (shared by the standalone parse path and
+  the engine's single-walk visitor dispatch)."""
+  thread_calls, has_close, has_enter, has_finalize = _scan_class(node)
+  findings: List[Finding] = []
+  for call in thread_calls:
+    end_line = getattr(call, "end_lineno", call.lineno) or call.lineno
+    if not has_close:
+      findings.append(Finding(
+          path=path, line=call.lineno, rule=_RULE_CLOSE,
+          end_line=end_line,
+          message=(f"class {node.name} starts a thread but defines no "
+                   "close(): the worker cannot be stopped/joined — a "
+                   "daemon thread killed at interpreter shutdown mid "
+                   "device op is the documented tunnel-wedging hazard. "
+                   "Add close() that stops AND joins the worker "
+                   "(DevicePrefetcher/OverlappedLoader discipline).")))
+    elif not (has_enter or has_finalize):
+      findings.append(Finding(
+          path=path, line=call.lineno, rule=_RULE_BACKSTOP,
+          end_line=end_line,
+          message=(f"class {node.name} starts a thread and has close() "
+                   "but neither __enter__ (context-manager use) nor a "
+                   "weakref.finalize backstop: an instance abandoned "
+                   "without close() leaks its worker until process "
+                   "exit. Add the CM protocol or register a finalizer "
+                   "that sets the stop event.")))
+  return findings
+
+
 def check_python_source(path: str, source: str) -> List[Finding]:
   try:
     tree = ast.parse(source, filename=path)
   except SyntaxError:
-    return []  # tracer_check already reports unparseable files
+    return []  # the engine reports unparseable files
   findings: List[Finding] = []
   for node in ast.walk(tree):
-    if not isinstance(node, ast.ClassDef):
-      continue
-    thread_calls, has_close, has_enter, has_finalize = _scan_class(node)
-    if not thread_calls:
-      continue
-    for call in thread_calls:
-      end_line = getattr(call, "end_lineno", call.lineno) or call.lineno
-      if not has_close:
-        findings.append(Finding(
-            path=path, line=call.lineno, rule=_RULE_CLOSE,
-            end_line=end_line,
-            message=(f"class {node.name} starts a thread but defines no "
-                     "close(): the worker cannot be stopped/joined — a "
-                     "daemon thread killed at interpreter shutdown mid "
-                     "device op is the documented tunnel-wedging hazard. "
-                     "Add close() that stops AND joins the worker "
-                     "(DevicePrefetcher/OverlappedLoader discipline).")))
-      elif not (has_enter or has_finalize):
-        findings.append(Finding(
-            path=path, line=call.lineno, rule=_RULE_BACKSTOP,
-            end_line=end_line,
-            message=(f"class {node.name} starts a thread and has close() "
-                     "but neither __enter__ (context-manager use) nor a "
-                     "weakref.finalize backstop: an instance abandoned "
-                     "without close() leaks its worker until process "
-                     "exit. Add the CM protocol or register a finalizer "
-                     "that sets the stop event.")))
+    if isinstance(node, ast.ClassDef):
+      findings.extend(_check_class(path, node))
   return findings
 
 
@@ -136,3 +142,29 @@ def check_python_file(path: str) -> List[Finding]:
     source = f.read()
   return filter_findings(check_python_source(path, source),
                          load_suppressions(source))
+
+
+engine_lib.register(engine_lib.Rule(
+    name="thread", kind="py", scope=".py", family="thread",
+    infos=(
+        engine_lib.RuleInfo(
+            id=_RULE_CLOSE,
+            doc=("a class starts a threading.Thread but\n"
+                 "defines no close() — its worker can never be\n"
+                 "stopped/joined (the tunnel-wedging hazard);\n"
+                 "loader/stage classes must expose close()"),
+            meaning=("a class starts a `threading.Thread` but defines "
+                     "no `close()` — its worker can never be "
+                     "stopped/joined")),
+        engine_lib.RuleInfo(
+            id=_RULE_BACKSTOP,
+            doc=("such a class has close() but neither\n"
+                 "__enter__ (context-manager use) nor a\n"
+                 "weakref.finalize backstop — an abandoned\n"
+                 "instance leaks its worker until process exit"),
+            meaning=("such a class has `close()` but neither "
+                     "`__enter__` nor a `weakref.finalize` backstop — "
+                     "abandoned instances leak their worker")),
+    ),
+    visitors={ast.ClassDef: lambda ctx, node: _check_class(ctx.path,
+                                                           node)}))

@@ -40,8 +40,9 @@ Suppress with a trailing `# graftlint: disable=<rule>` comment.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
+from tensor2robot_tpu.analysis import engine as engine_lib
 from tensor2robot_tpu.analysis.findings import (Finding, filter_findings,
                                                 load_suppressions)
 
@@ -351,16 +352,13 @@ def _check_device_timing(tree: ast.Module, aliases: Dict[str, str],
       _check_scope(node)
 
 
-def check_python_source(text: str, path: str,
-                        allow_block_until_ready: bool = False,
-                        allow_device_timing: bool = False
-                        ) -> List[Finding]:
-  """Lints one Python source; returns (suppression-filtered) findings."""
-  try:
-    tree = ast.parse(text, filename=path)
-  except SyntaxError as e:
-    return [Finding(path, e.lineno or 0, "parse-error",
-                    f"syntax error: {e.msg}")]
+def check_python_tree(tree: ast.Module, path: str,
+                      allow_block_until_ready: bool = False,
+                      allow_device_timing: bool = False
+                      ) -> List[Finding]:
+  """Raw (unfiltered, unsorted) findings over an already-parsed module
+  — the engine's entry point; `check_python_source` wraps it with the
+  parse/filter/sort tail the standalone API always had."""
   aliases = _import_aliases(tree)
   findings: List[Finding] = []
 
@@ -394,16 +392,88 @@ def check_python_source(text: str, path: str,
     seen_traced.add(id(node))
     _walk_traced(node, aliases, path, findings)
 
+  return findings
+
+
+def check_python_source(text: str, path: str,
+                        allow_block_until_ready: bool = False,
+                        allow_device_timing: bool = False
+                        ) -> List[Finding]:
+  """Lints one Python source; returns (suppression-filtered) findings."""
+  try:
+    tree = ast.parse(text, filename=path)
+  except SyntaxError as e:
+    return [Finding(path, e.lineno or 0, "parse-error",
+                    f"syntax error: {e.msg}")]
+  findings = check_python_tree(
+      tree, path, allow_block_until_ready=allow_block_until_ready,
+      allow_device_timing=allow_device_timing)
   return sorted(filter_findings(findings, load_suppressions(text)),
                 key=lambda f: (f.line, f.rule))
 
 
-def check_python_file(path: str) -> List[Finding]:
+def path_exemptions(path: str) -> Tuple[bool, bool]:
+  """(allow_block_until_ready, allow_device_timing) for one path —
+  shared by `check_python_file` and the engine registration, so the
+  exemption map cannot drift between the two call paths."""
   norm = path.replace("\\", "/")
   allow = norm.endswith("utils/backend.py")
   # obs/ owns the instrumentation clocks (its windows end in barriers by
   # design); backend.py owns the shared timing recipes.
   allow_timing = allow or "/obs/" in norm or norm.startswith("obs/")
+  return allow, allow_timing
+
+
+def check_python_file(path: str) -> List[Finding]:
+  allow, allow_timing = path_exemptions(path)
   with open(path) as f:
     return check_python_source(f.read(), path, allow_block_until_ready=allow,
                                allow_device_timing=allow_timing)
+
+
+def _engine_check(ctx) -> List[Finding]:
+  allow, allow_timing = path_exemptions(ctx.path)
+  return check_python_tree(ctx.tree, ctx.path,
+                           allow_block_until_ready=allow,
+                           allow_device_timing=allow_timing)
+
+
+engine_lib.register(engine_lib.Rule(
+    name="tracer", kind="py", scope=".py", family="tracer",
+    infos=(
+        engine_lib.RuleInfo(
+            id="block-until-ready",
+            doc="jax.block_until_ready outside utils/backend.py",
+            meaning=("`jax.block_until_ready` outside `utils/backend.py` "
+                     "— not a tunnel barrier, use `backend.sync`")),
+        engine_lib.RuleInfo(
+            id="import-time-backend",
+            doc="backend-touching call at module import level",
+            meaning=("backend-touching call (`jax.devices`, any "
+                     "`jnp`/`jax.random`/`jax.nn` call, fn default args) "
+                     "at module import level")),
+        engine_lib.RuleInfo(
+            id="host-sync-in-jit",
+            doc=(".item() / float() / np.asarray() on traced\n"
+                 "values inside a jitted function"),
+            meaning=("`.item()` / `float()` / `np.asarray()` on traced "
+                     "values inside a jitted function")),
+        engine_lib.RuleInfo(
+            id="impure-in-jit",
+            doc=("time.time / stateful np.random inside a jitted\n"
+                 "function"),
+            meaning=("`time.time` family / stateful global `np.random` "
+                     "inside a jitted function")),
+        engine_lib.RuleInfo(
+            id="device-timing",
+            doc=("time.time/perf_counter window around device\n"
+                 "dispatch without a host-fetch barrier (measures\n"
+                 "dispatch, not execution, over the tunnel);\n"
+                 "obs/ and utils/backend.py are exempt"),
+            meaning=("`time.time`/`perf_counter` window around a device "
+                     "dispatch without a host-fetch barrier — measures "
+                     "dispatch, not execution, over the tunnel; `obs/` "
+                     "and `utils/backend.py` (the clock owners) are "
+                     "exempt")),
+    ),
+    check=_engine_check))

@@ -836,9 +836,91 @@ def _main_forge(argv: List[str]) -> int:
   return 1 if (manifest["errors"] or counts["fallback"]) else 0
 
 
+def _main_audit(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope audit",
+      description="graftaudit: trace every jit entry point a research "
+                  "config deploys (train step, serving bucket rungs, "
+                  "session decode ticks) in a CPU-pinned worker and "
+                  "audit the jaxprs — baked-in constants, undonated "
+                  "state, host callbacks inside scan/while bodies "
+                  "(analysis.jaxpr_audit; rules catalogued by "
+                  "`lint --list-rules`, suppressible with a trailing "
+                  "`# graftlint: disable=<rule>` in the config). Exit "
+                  "codes: 0 clean, 1 findings or target errors, 2 "
+                  "usage.")
+  parser.add_argument("config_files", nargs="+",
+                      help="research config (.gin) files, e.g. "
+                           "tensor2robot_tpu/configs/serve_fleet.gin")
+  parser.add_argument("--binding", action="append", default=[],
+                      help="extra binding strings, applied last "
+                           "(repeatable)")
+  parser.add_argument("--model", default=None,
+                      help="model source for serving-only configs: a "
+                           "registered configurable name, or 'flagship' "
+                           "(the QT-Opt smoke critic)")
+  parser.add_argument("--export-dir", default=None,
+                      help="audit the model served from this export-"
+                           "bundle root instead of a configurable ctor")
+  parser.add_argument("--model-dir", default=None,
+                      help="deployment model_dir (predictors restore "
+                           "its checkpoints when present; the audit is "
+                           "value-independent either way)")
+  parser.add_argument("--device-count", type=int, default=None,
+                      help="force the worker topology (XLA host-"
+                           "platform device count) to match the "
+                           "deployment mesh")
+  parser.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit findings as JSON lines (the lint "
+                           "--json schema)")
+  parser.add_argument("--timeout", type=float, default=600.0,
+                      help="audit worker wall-clock budget in seconds")
+  args = parser.parse_args(argv)
+  missing = [p for p in args.config_files if not os.path.isfile(p)]
+  if missing:
+    print(f"graftscope audit: no such config: {', '.join(missing)}",
+          file=sys.stderr)
+    return 2
+  from tensor2robot_tpu.analysis import engine as lint_engine
+  from tensor2robot_tpu.analysis import jaxpr_audit
+
+  try:
+    plan, results, findings = jaxpr_audit.audit_config(
+        args.config_files, args.binding, model=args.model,
+        export_dir=args.export_dir, model_dir=args.model_dir,
+        device_count=args.device_count, timeout_s=args.timeout)
+  except Exception as e:  # noqa: BLE001 - a config error is a usage error
+    print(f"graftscope audit: cannot enumerate {args.config_files}: "
+          f"{type(e).__name__}: {e}", file=sys.stderr)
+    return 2
+  auditable = [t for t in plan["targets"]
+               if t["family"] in ("serve", "session", "train")]
+  if auditable and plan.get("model") is None:
+    print("graftscope audit: the plan has traceable serving/train "
+          "targets but no model source — pass --model/--export-dir or "
+          "bind graftforge.model in the config", file=sys.stderr)
+    return 2
+  print(jaxpr_audit.format_report(plan, results, findings))
+  for finding in findings:
+    if args.as_json:
+      print(json.dumps({
+          "path": finding.path, "line": finding.line,
+          "rule": finding.rule,
+          "severity": lint_engine.severity_of(finding.rule),
+          "message": finding.message, "suppressed": False}))
+    else:
+      print(finding)
+  errors = [r for r in results if r["status"] == "error"]
+  for entry in errors:
+    print(f"  ERROR   {entry.get('name')}: {entry.get('error')}",
+          file=sys.stderr)
+  return 1 if (findings or errors) else 0
+
+
 _SUBCOMMANDS = {"report": _main_report, "history": _main_history,
                 "diff": _main_diff, "postmortem": _main_postmortem,
-                "cache": _main_cache, "forge": _main_forge}
+                "cache": _main_cache, "forge": _main_forge,
+                "audit": _main_audit}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

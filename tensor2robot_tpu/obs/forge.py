@@ -76,7 +76,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from tensor2robot_tpu.utils import config
 
 __all__ = ["FORGE_SCHEMA", "plan_from_config", "run_forge", "verify_plan",
-           "forge_config", "format_plan", "graftforge"]
+           "forge_config", "format_plan", "graftforge", "build_train_step",
+           "build_rung_engine"]
 
 FORGE_SCHEMA = "forge-manifest-v1"
 FORGE_SCHEMA_VERSION = 1
@@ -644,25 +645,26 @@ def _engine_result(target: Dict[str, Any], engine,
   return out
 
 
-def _forge_train_target(spec: Dict[str, Any], target: Dict[str, Any],
-                        verify: bool) -> List[Dict[str, Any]]:
-  """The trainer's first-dispatch executable, exactly as train_eval /
-  bench pay it: the plain step at [B], or — for `loop_k` targets — the
-  `make_train_loop` [K, B] scan program (a DIFFERENT jaxpr; forging the
-  plain step under the loop name would store an entry the trainer never
-  looks up). `mesh_shape=None` is the one-chip deployment shape
-  (SingleDeviceSharding donation — serializes safely, the bench plan);
-  "default" is train_eval's unbound-mesh_shape case (all devices on the
-  data axis); an explicit shape mirrors the config. Mesh-built steps
-  only run here once the excache pin admits donating-mesh executables
-  (the plan gates them until then)."""
+def build_train_step(spec: Dict[str, Any],
+                     target: Dict[str, Any]) -> Tuple[Any, Tuple]:
+  """Builds the trainer's first-dispatch executable, exactly as
+  train_eval / bench pay it, and returns `(step, args)` ready to
+  `.trace(*args)` or dispatch: the plain step at [B], or — for
+  `loop_k` targets — the `make_train_loop` [K, B] scan program (a
+  DIFFERENT jaxpr; forging the plain step under the loop name would
+  store an entry the trainer never looks up). `mesh_shape=None` is the
+  one-chip deployment shape (SingleDeviceSharding donation —
+  serializes safely, the bench plan); "default" is train_eval's
+  unbound-mesh_shape case (all devices on the data axis); an explicit
+  shape mirrors the config. Shared by the farm worker
+  (`_forge_train_target`) and the jaxpr audit worker
+  (`analysis.jaxpr_audit`): whatever either traces is the program the
+  live trainer dispatches."""
   import jax
   import numpy as np
 
   from tensor2robot_tpu import modes as modes_lib
   from tensor2robot_tpu import specs as specs_lib
-  from tensor2robot_tpu.obs import excache as excache_lib
-  from tensor2robot_tpu.obs import xray as xray_lib
   from tensor2robot_tpu.parallel import mesh as mesh_lib
   from tensor2robot_tpu.parallel import train_step as ts
 
@@ -716,6 +718,18 @@ def _forge_train_target(spec: Dict[str, Any], target: Dict[str, Any],
         mesh, {"features": features, "labels": labels},
         batch_spec=batch_spec)
     args = (state, placed_features, placed_labels)
+  return step, args
+
+
+def _forge_train_target(spec: Dict[str, Any], target: Dict[str, Any],
+                        verify: bool) -> List[Dict[str, Any]]:
+  """Compiles (or --verify key-checks) the train-step executable that
+  `build_train_step` assembles, through the SAME analyze_jit +
+  graftcache path the live trainer takes."""
+  from tensor2robot_tpu.obs import excache as excache_lib
+  from tensor2robot_tpu.obs import xray as xray_lib
+
+  step, args = build_train_step(spec, target)
   cache = excache_lib.ExecutableCache(spec["cache_dir"])
   if verify:
     traced = step.trace(*args)
@@ -736,34 +750,44 @@ def _forge_train_target(spec: Dict[str, Any], target: Dict[str, Any],
   }]
 
 
+def build_rung_engine(spec: Dict[str, Any], target: Dict[str, Any]):
+  """The serving engine a "serve"/"session" target deploys, built
+  exactly as the live process builds it (predictor + spec-derived
+  ladder). Shared by the farm worker (`_forge_target`) and the jaxpr
+  audit worker (`analysis.jaxpr_audit`), so both reason over the SAME
+  engine the deployment runs."""
+  if target["family"] == "serve":
+    from tensor2robot_tpu.serving import engine as engine_lib
+
+    # The farm worker IS the enumeration: target["buckets"] came from
+    # plan_from_config's spec walk, so the ladder is spec-derived by
+    # construction.
+    return engine_lib.BucketedEngine(  # graftlint: disable=warmup-unforgeable
+        predictor=_build_predictor(spec, target),
+        buckets=target["buckets"],
+        name=target["name"],
+        cache=spec["cache_dir"],
+        cache_namespace=target["name"])
+  if target["family"] == "session":
+    from tensor2robot_tpu.serving import session as session_lib
+
+    # Spec-derived by construction, same as above.
+    return session_lib.SessionEngine(  # graftlint: disable=warmup-unforgeable
+        predictor=_build_predictor(spec, target),
+        max_sessions=int(target.get("max_sessions") or 64),
+        buckets=target["buckets"],
+        name=target["name"],
+        cache=spec["cache_dir"],
+        cache_namespace=target["name"])
+  raise ValueError(f"no rung engine for family {target['family']!r}")
+
+
 def _forge_target(spec: Dict[str, Any],
                   target: Dict[str, Any]) -> Dict[str, Any]:
   verify = bool(spec.get("verify"))
   try:
-    if target["family"] == "serve":
-      from tensor2robot_tpu.serving import engine as engine_lib
-
-      # The farm worker IS the enumeration: target["buckets"] came from
-      # plan_from_config's spec walk, so the ladder is spec-derived by
-      # construction.
-      engine = engine_lib.BucketedEngine(  # graftlint: disable=warmup-unforgeable
-          predictor=_build_predictor(spec, target),
-          buckets=target["buckets"],
-          name=target["name"],
-          cache=spec["cache_dir"],
-          cache_namespace=target["name"])
-      executables = _engine_result(target, engine, verify)
-    elif target["family"] == "session":
-      from tensor2robot_tpu.serving import session as session_lib
-
-      # Spec-derived by construction, same as above.
-      engine = session_lib.SessionEngine(  # graftlint: disable=warmup-unforgeable
-          predictor=_build_predictor(spec, target),
-          max_sessions=int(target.get("max_sessions") or 64),
-          buckets=target["buckets"],
-          name=target["name"],
-          cache=spec["cache_dir"],
-          cache_namespace=target["name"])
+    if target["family"] in ("serve", "session"):
+      engine = build_rung_engine(spec, target)
       executables = _engine_result(target, engine, verify)
     elif target["family"] == "train":
       executables = _forge_train_target(spec, target, verify)

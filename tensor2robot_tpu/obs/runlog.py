@@ -159,6 +159,14 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "forged_vs_cold": ("down", 0.30),
     "forged_start_ms": ("up", 0.50),
     "forge_compile_share": ("up", 0.0),
+    # graftlint engine telemetry (`lint --runs`, PERFORMANCE.md
+    # "Reading a lint record"): parse and rule wall inside the
+    # single-pass engine. Wall-clock on the 1-core host, so both get
+    # the loose warmup_ms band — the gate exists to catch the ~10x
+    # parse regression the per-checker layout used to pay, not 10%
+    # host noise.
+    "lint_parse_ms": ("up", 0.50),
+    "lint_rules_ms": ("up", 0.50),
 }
 
 
@@ -445,6 +453,13 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["forged_start_ms"] = float(bench["forged_start_ms"])
   if bench.get("forge_compile_share") is not None:
     out["forge_compile_share"] = float(bench["forge_compile_share"])
+  # graftlint telemetry (lint --runs): single-pass engine parse/rule
+  # wall, diff-gated so a rule-engine regression shows up like any
+  # other bench family.
+  if bench.get("lint_parse_ms") is not None:
+    out["lint_parse_ms"] = float(bench["lint_parse_ms"])
+  if bench.get("lint_rules_ms") is not None:
+    out["lint_rules_ms"] = float(bench["lint_rules_ms"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)

@@ -56,7 +56,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -433,35 +434,47 @@ class BucketedEngine:
       obs_metrics.counter("serve/engine/reladders").inc()
     return self
 
-  def rung_cache_keys(self) -> Dict[int, str]:
-    """The graftcache key of every rung WITHOUT compiling (trace-only).
-
-    The graftforge `--verify` seam: keys come from the SAME bundle /
-    wire-synthesis / trace path `warmup()` compiles through, so a key
-    this returns is byte-identical to the one a live warmup would look
-    up — the engine owns its arg synthesis in one place and the forge
-    CLI can check an existing cache against it without paying a single
-    lower+compile. Tracing is cheap and side-effect-free (donation is
+  def rung_traces(self) -> List[Tuple[int, Any, Tuple]]:
+    """`[(rung, traced, args), ...]` for every ladder rung — trace-only,
+    never a lower or compile. The one arg-synthesis seam `warmup()`,
+    `rung_cache_keys()` (graftforge --verify) and `graftscope audit`
+    (jaxpr_audit) all reason over: the traced program IS the program a
+    live warmup would compile, so whatever the audit reads off its
+    jaxpr (baked constants, donation flags, loop bodies) is what
+    deployment pays. Tracing is cheap and side-effect-free (donation is
     declared, not consumed, at trace time)."""
     from tensor2robot_tpu import specs as specs_lib
-    from tensor2robot_tpu.obs import excache as excache_lib
 
     with self._lock:
       if self._bundle is None:
         self._bundle = self._predictor.serving_bundle()
       bundle = self._bundle
       state = bundle.get_state()
-      keys: Dict[int, str] = {}
+      traces: List[Tuple[int, Any, Tuple]] = []
       for bucket in self._buckets:
         wire = specs_lib.make_random_numpy(bundle.feature_spec,
                                            batch_size=bucket, seed=0)
         features = bundle.preprocess(wire)
-        traced = bundle.jit_predict.trace(state, features)
-        keys[bucket] = excache_lib.cache_key(
+        args = (state, features)
+        traces.append((bucket, bundle.jit_predict.trace(*args), args))
+      return traces
+
+  def rung_cache_keys(self) -> Dict[int, str]:
+    """The graftcache key of every rung WITHOUT compiling (trace-only).
+
+    The graftforge `--verify` seam: keys come from the SAME bundle /
+    wire-synthesis / trace path `warmup()` compiles through
+    (`rung_traces`), so a key this returns is byte-identical to the one
+    a live warmup would look up — the engine owns its arg synthesis in
+    one place and the forge CLI can check an existing cache against it
+    without paying a single lower+compile."""
+    from tensor2robot_tpu.obs import excache as excache_lib
+
+    return {
+        bucket: excache_lib.cache_key(
             f"{self._cache_namespace}/bucket{bucket}",
-            **excache_lib.key_components_from_traced(
-                traced, (state, features)))
-      return keys
+            **excache_lib.key_components_from_traced(traced, args))
+        for bucket, traced, args in self.rung_traces()}
 
   def _bucket_for(self, rows: int) -> int:
     for bucket in self._buckets:

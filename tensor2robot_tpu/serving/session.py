@@ -410,20 +410,20 @@ class SessionEngine:
         {"rung": key, "source": source, "ms": elapsed_ms,
          "key": cache_block.get("key")})
 
-  def rung_cache_keys(self) -> Dict[Any, str]:
-    """The graftcache key of every decode rung + the slot reset WITHOUT
-    compiling (trace-only; the graftforge --verify seam — the
-    BucketedEngine.rung_cache_keys contract). Binds the decode bundle
-    exactly as warmup would (the dispatch jits in `_dispatch_jits`
-    close over its decode_fn, and a later warmup reuses them — they
-    must share ONE bundle) but builds only a LOCAL throwaway arena for
-    the trace avals, so probing a cold engine allocates no resident
-    device state."""
+  def rung_traces(self) -> List[Tuple[Any, Any, Tuple]]:
+    """`[(rung, traced, args), ...]` for every decode rung plus the
+    `"reset"` slot-reset — trace-only, never a lower or compile (the
+    BucketedEngine.rung_traces contract; shared by `rung_cache_keys`
+    and `graftscope audit`). Binds the decode bundle exactly as warmup
+    would (the dispatch jits in `_dispatch_jits` close over its
+    decode_fn, and a later warmup reuses them — they must share ONE
+    bundle) but builds only a LOCAL throwaway arena for the trace
+    avals, so probing a cold engine allocates no resident device
+    state."""
     import jax
     import jax.numpy as jnp
 
     from tensor2robot_tpu import specs as specs_lib
-    from tensor2robot_tpu.obs import excache as excache_lib
 
     with self._arena_lock:
       if self._bundle is None:
@@ -438,7 +438,7 @@ class SessionEngine:
         init_row = jax.tree_util.tree_map(
             jnp.asarray, bundle.init_session_state(1))
       state = bundle.get_state()
-      keys: Dict[Any, str] = {}
+      traces: List[Tuple[Any, Any, Tuple]] = []
       for bucket in self._buckets:
         fn = self._dispatch_jits.setdefault(
             bucket, self._make_dispatch(bundle.decode_fn))
@@ -448,17 +448,24 @@ class SessionEngine:
         slots = np.zeros((bucket,), np.int32)
         mask = np.zeros((bucket,), bool)
         args = (state, arena, slots, features, mask)
-        traced = fn.trace(*args)
-        keys[bucket] = excache_lib.cache_key(
-            f"{self._cache_namespace}/decode{bucket}",
-            **excache_lib.key_components_from_traced(traced, args))
+        traces.append((bucket, fn.trace(*args), args))
       reset_fn = self._reset_jit or self._make_reset()
       args = (arena, np.int32(0), init_row)
-      traced = reset_fn.trace(*args)
-      keys["reset"] = excache_lib.cache_key(
-          f"{self._cache_namespace}/reset_slot",
-          **excache_lib.key_components_from_traced(traced, args))
-      return keys
+      traces.append(("reset", reset_fn.trace(*args), args))
+      return traces
+
+  def rung_cache_keys(self) -> Dict[Any, str]:
+    """The graftcache key of every decode rung + the slot reset WITHOUT
+    compiling (trace-only via `rung_traces`; the graftforge --verify
+    seam — the BucketedEngine.rung_cache_keys contract)."""
+    from tensor2robot_tpu.obs import excache as excache_lib
+
+    return {
+        rung: excache_lib.cache_key(
+            f"{self._cache_namespace}/"
+            f"{'reset_slot' if rung == 'reset' else f'decode{rung}'}",
+            **excache_lib.key_components_from_traced(traced, args))
+        for rung, traced, args in self.rung_traces()}
 
   # -- lifecycle ------------------------------------------------------------
 

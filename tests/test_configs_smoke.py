@@ -307,3 +307,39 @@ app.run(run_graftloop.main)
   assert summary["staleness_bound_held"]
   assert summary["worker_escalations"] == 0
   assert os.path.isdir(os.path.join(model_dir, "checkpoints"))
+
+
+@pytest.mark.parametrize(
+    "config_name,extra_args",
+    [("serve_fleet.gin", ["--model", "flagship"]),
+     ("loop_qtopt.gin", [])],
+    ids=["serve_fleet", "loop_qtopt"])
+def test_shipped_configs_audit_clean(config_name, extra_args):
+  """ISSUE 16: `graftscope audit` traces every jit entry point the
+  shipped deployment configs build (fleet bucket rungs across placed
+  replicas; the loop's serve rungs AND its gated train step) and must
+  report ZERO jaxpr-audit findings — the same permanently-clean
+  contract test_repo_clean pins for file rules.
+
+  The parent runs under the poisoned JAX_PLATFORMS (any backend init in
+  the enumeration/report half raises); tracing happens in the audit
+  worker subprocess, which self-pins CPU (GRAFTAUDIT_PLATFORM) — over
+  the real env that discipline is what keeps the audit off the axon
+  tunnel entirely."""
+  import subprocess
+  import sys
+
+  config_path = os.path.join(REPO_ROOT, "tensor2robot_tpu", "configs",
+                             config_name)
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "graftlint_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run(
+      [sys.executable, "-m", "tensor2robot_tpu.bin.graftscope", "audit",
+       config_path] + extra_args,
+      capture_output=True, text=True, timeout=600, cwd=REPO_ROOT, env=env)
+  # rc 0 == no findings AND no per-target trace errors (1 = findings/
+  # errors, 2 = enumeration failure).
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "0 finding(s) after suppressions" in result.stdout

@@ -1314,10 +1314,10 @@ class TestFleetLintRule:
     assert not self._check(source)
 
   def test_rule_in_catalog_and_wired(self):
-    from tensor2robot_tpu.analysis import lint
+    from tensor2robot_tpu.analysis import engine
 
-    catalog = lint._RULE_CATALOG
-    assert "fleet-replica-unjoined" in catalog
+    engine.load_builtin_rules()
+    assert "fleet-replica-unjoined" in engine.catalog_text()
 
 
 # ---------------------------------------------------------------------------

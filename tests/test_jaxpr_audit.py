@@ -1,0 +1,324 @@
+"""Tests for graftaudit (analysis/jaxpr_audit.py): jaxpr-level semantic
+auditing of jit entry points.
+
+Contracts:
+
+* each of the four audit rules FIRES on a seeded violating fixture and
+  stays silent on the matching clean control (semantic, not shape:
+  thresholds, donation flags, loop nesting, and hash semantics are each
+  exercised via `audit_callable` — the same code path the config worker
+  runs per traced executable);
+* findings anchor on the audited config file with the shared
+  `# graftlint: disable=` suppression model;
+* the audit rules live in the engine catalog (severity `warning`) but
+  never run in the file walk — `graftscope audit` is their only entry;
+* the shipped-config audits and the poisoned-platform trap live in
+  tests/test_configs_smoke.py (they need the full worker subprocess).
+
+Tracing happens in-process here: tests/conftest.py pins a virtual
+8-device CPU mesh, and `jitted.trace(...)` never compiles or dispatches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensor2robot_tpu.analysis import engine as engine_lib
+from tensor2robot_tpu.analysis import jaxpr_audit
+
+
+def _rules(entries):
+  return {e["rule"] for e in entries}
+
+
+# ---------------------------------------------------------------------------
+# Rule 1: audit-baked-constant.
+# ---------------------------------------------------------------------------
+
+
+def test_baked_constant_fires():
+  table = jnp.zeros((512, 512), jnp.float32)  # exactly 1 MiB
+
+  def fwd(x):
+    return x @ table
+
+  entries = jaxpr_audit.audit_callable("fixture", fwd,
+                                       [jnp.ones((4, 512), jnp.float32)])
+  assert _rules(entries) == {"audit-baked-constant"}
+  assert "(512, 512)" in entries[0]["message"]
+  assert "1.0 MiB" in entries[0]["message"]
+  assert entries[0]["executable"] == "fixture"
+
+
+def test_baked_constant_small_const_clean():
+  small = jnp.zeros((8, 8), jnp.float32)
+
+  def fwd(x):
+    return x @ small
+
+  assert not jaxpr_audit.audit_callable(
+      "fixture", fwd, [jnp.ones((4, 8), jnp.float32)])
+
+
+def test_baked_constant_argument_clean():
+  """The fix the rule prescribes — pass the array as an argument — must
+  itself audit clean."""
+  def fwd(x, table):
+    return x @ table
+
+  assert not jaxpr_audit.audit_callable(
+      "fixture", fwd, [jnp.ones((4, 512), jnp.float32),
+                       jnp.zeros((512, 512), jnp.float32)])
+
+
+def test_baked_constant_threshold_parameterized():
+  small = jnp.zeros((8, 8), jnp.float32)
+
+  def fwd(x):
+    return x @ small
+
+  traced = jax.jit(fwd).trace(jnp.ones((4, 8), jnp.float32))
+  entries = jaxpr_audit.audit_traced("fixture", traced, const_bytes=64)
+  assert _rules(entries) == {"audit-baked-constant"}
+
+
+# ---------------------------------------------------------------------------
+# Rule 2: audit-undonated-state.
+# ---------------------------------------------------------------------------
+
+
+def _train_like_step(state, batch):
+  new_state = state + batch.sum()
+  loss = (state * state).sum()
+  return new_state, loss
+
+
+_STATE = jnp.ones((256, 256), jnp.float32)  # 256 KiB, well over 64 KiB
+_BATCH = jnp.ones((4, 8), jnp.float32)
+
+
+def test_undonated_state_fires():
+  entries = jaxpr_audit.audit_callable("fixture", _train_like_step,
+                                       [_STATE, _BATCH])
+  assert _rules(entries) == {"audit-undonated-state"}
+  assert "0.2 MiB" in entries[0]["message"]
+
+
+def test_donated_state_clean():
+  assert not jaxpr_audit.audit_callable("fixture", _train_like_step,
+                                        [_STATE, _BATCH],
+                                        donate_argnums=(0,))
+
+
+def test_small_undonated_carry_clean():
+  """Sub-threshold round-tripping values (a scalar step counter) are
+  not 'state' worth donating."""
+  def step(counter, x):
+    return counter + 1, (x * counter).sum()
+
+  assert not jaxpr_audit.audit_callable(
+      "fixture", step, [jnp.zeros((), jnp.int32), _BATCH])
+
+
+def test_large_input_not_in_outputs_clean():
+  """A big input whose shape never reappears in the outputs (a frozen
+  embedding table) is not donation-eligible state."""
+  def fwd(table, x):
+    return (x @ table).sum()
+
+  assert not jaxpr_audit.audit_callable(
+      "fixture", fwd, [jnp.zeros((256, 256), jnp.float32),
+                       jnp.ones((4, 256), jnp.float32)])
+
+
+# ---------------------------------------------------------------------------
+# Rule 3: audit-host-callback-in-loop.
+# ---------------------------------------------------------------------------
+
+
+def _host_probe(v):
+  return np.asarray(v, dtype=np.float32)
+
+
+def test_host_callback_in_scan_fires():
+  def tick(carry, _):
+    y = jax.pure_callback(_host_probe,
+                          jax.ShapeDtypeStruct((), jnp.float32), carry)
+    return carry + y, None
+
+  def loopy(x):
+    out, _ = jax.lax.scan(tick, x, None, length=4)
+    return out
+
+  entries = jaxpr_audit.audit_callable("fixture", loopy,
+                                       [jnp.float32(0.0)])
+  assert _rules(entries) == {"audit-host-callback-in-loop"}
+  assert "'scan'" in entries[0]["message"]
+
+
+def test_host_callback_in_while_fires():
+  def loopy(x):
+    def cond(v):
+      return v < 4.0
+
+    def body(v):
+      return v + jax.pure_callback(
+          _host_probe, jax.ShapeDtypeStruct((), jnp.float32), v)
+
+    return jax.lax.while_loop(cond, body, x)
+
+  entries = jaxpr_audit.audit_callable("fixture", loopy,
+                                       [jnp.float32(0.0)])
+  assert _rules(entries) == {"audit-host-callback-in-loop"}
+  assert "'while'" in entries[0]["message"]
+
+
+def test_host_callback_outside_loop_clean():
+  """A top-level callback costs one round-trip total, not one per
+  iteration — not this rule's business."""
+  def fwd(x):
+    y = jax.pure_callback(_host_probe,
+                          jax.ShapeDtypeStruct((), jnp.float32), x)
+    return y + 1.0
+
+  assert not jaxpr_audit.audit_callable("fixture", fwd,
+                                        [jnp.float32(0.0)])
+
+
+def test_callback_free_scan_clean():
+  def tick(carry, _):
+    return carry * 1.5, None
+
+  def loopy(x):
+    out, _ = jax.lax.scan(tick, x, None, length=4)
+    return out
+
+  assert not jaxpr_audit.audit_callable("fixture", loopy,
+                                        [jnp.float32(1.0)])
+
+
+# ---------------------------------------------------------------------------
+# Rule 4: audit-unhashable-static.
+# ---------------------------------------------------------------------------
+
+
+class _IdentityHashed:
+  pass
+
+
+def test_unhashable_static_fires():
+  entries = jaxpr_audit._audit_static_args("fixture", {"cfg": [1, 2]})
+  assert _rules(entries) == {"audit-unhashable-static"}
+  assert "unhashable" in entries[0]["message"]
+  assert "'cfg'" in entries[0]["message"]
+
+
+def test_identity_hash_static_fires():
+  entries = jaxpr_audit._audit_static_args("fixture",
+                                           {"cfg": _IdentityHashed()})
+  assert _rules(entries) == {"audit-unhashable-static"}
+  assert "object identity" in entries[0]["message"]
+
+
+def test_hashable_statics_clean():
+  # Value-hashed types and callables (function identity IS the cache
+  # key you want) are the accepted shapes.
+  assert not jaxpr_audit._audit_static_args(
+      "fixture", {"n": 4, "dims": (1, 2), "act": jnp.tanh,
+                  "mode": "train"})
+
+
+def test_unhashable_static_through_audit_callable():
+  """The seam the worker uses: statics are audited WITHOUT entering the
+  trace (an unhashable static would abort `jax.jit` at call time)."""
+  def fwd(x):
+    return x + 1.0
+
+  entries = jaxpr_audit.audit_callable(
+      "fixture", fwd, [jnp.float32(0.0)],
+      static_args={"bad": [1], "good": (1,)})
+  assert [e["rule"] for e in entries] == ["audit-unhashable-static"]
+
+
+# ---------------------------------------------------------------------------
+# Findings: anchoring, suppression, catalog.
+# ---------------------------------------------------------------------------
+
+
+def _fake_results():
+  return [{"name": "train_step", "family": "train", "status": "ok",
+           "findings": [jaxpr_audit._entry(
+               "train_step", "audit-undonated-state", "2 leaves")]}]
+
+
+def test_report_findings_anchor_on_config(tmp_path):
+  gin = tmp_path / "fixture.gin"
+  gin.write_text("a = 1\nb = 2\nc = 3\n")
+  plan = {"config_files": [str(gin)]}
+  findings = jaxpr_audit.report_findings(plan, _fake_results())
+  assert len(findings) == 1
+  f = findings[0]
+  # end_line spans the whole file (3 lines + the trailing newline's
+  # empty last physical line) so a disable comment anywhere suppresses.
+  assert f.path == str(gin) and f.line == 1 and f.end_line == 4
+  assert f.rule == "audit-undonated-state"
+  assert f.message == "train_step: 2 leaves"
+
+
+def test_report_findings_config_suppression(tmp_path):
+  gin = tmp_path / "fixture.gin"
+  gin.write_text("a = 1\n"
+                 "b = 2  # graftlint: disable=audit-undonated-state\n")
+  plan = {"config_files": [str(gin)]}
+  assert not jaxpr_audit.report_findings(plan, _fake_results())
+  # ...but the comment only eats ITS rule.
+  gin.write_text("a = 1  # graftlint: disable=audit-baked-constant\n")
+  assert len(jaxpr_audit.report_findings(plan, _fake_results())) == 1
+
+
+def test_audit_rules_catalogued_as_warnings():
+  engine_lib.load_builtin_rules()
+  ids = {info.id: info for info in engine_lib.rule_infos()}
+  for rule in ("audit-baked-constant", "audit-undonated-state",
+               "audit-host-callback-in-loop", "audit-unhashable-static"):
+    assert rule in ids, rule
+    assert ids[rule].severity == "warning"
+    assert engine_lib.severity_of(rule) == "warning"
+  assert engine_lib.registered_rules()["audit"].kind == "jaxpr"
+
+
+def test_audit_rules_never_run_in_file_walk(tmp_path):
+  """kind='jaxpr' rules are catalog-only: a file walk over python that
+  LOOKS like a violation (closure-captured jnp constant) must not fire
+  them — only `graftscope audit` traces jaxprs."""
+  (tmp_path / "looks_bad.py").write_text(
+      "import jax.numpy as jnp\n"
+      "def fwd(x, t):\n"
+      "  return x @ t\n")
+  result = engine_lib.run_engine([str(tmp_path)])
+  assert not result.findings
+
+
+def test_default_device_count():
+  assert jaxpr_audit._default_device_count({"targets": []}) == 1
+  assert jaxpr_audit._default_device_count({"targets": [
+      {"placed": True, "num_replicas": 2}]}) == 2
+  assert jaxpr_audit._default_device_count({"targets": [
+      {"mesh_shape": [2, 2, 1]}]}) == 4
+  assert jaxpr_audit._default_device_count({"targets": [
+      {"mesh_shape": "default"}]}) == 8
+  assert jaxpr_audit._default_device_count({"targets": [
+      {"placed": True, "num_replicas": 2}, {"mesh_shape": [2, 4]},
+      {"mesh_shape": "default"}]}) == 8
+
+
+def test_worker_cli_usage_error():
+  import subprocess
+  import sys
+
+  result = subprocess.run(
+      [sys.executable, "-m", "tensor2robot_tpu.analysis.jaxpr_audit"],
+      capture_output=True, text=True, timeout=120)
+  assert result.returncode == 2
+  assert "usage" in (result.stderr + result.stdout).lower()

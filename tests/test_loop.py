@@ -724,9 +724,10 @@ class TestUnsupervisedLoopWorkerRule:
     assert not self._check(source)
 
   def test_rule_in_catalog_wired_and_repo_pinned_clean(self):
-    from tensor2robot_tpu.analysis import lint, loop_check
+    from tensor2robot_tpu.analysis import engine, loop_check
 
-    assert "unsupervised-loop-worker" in lint._RULE_CATALOG
+    engine.load_builtin_rules()
+    assert "unsupervised-loop-worker" in engine.catalog_text()
     # The shipped loop package itself must be clean: every worker
     # thread goes through Supervisor.spawn (supervisor.py's monitor and
     # worker threads are the exempt machinery).

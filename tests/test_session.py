@@ -823,9 +823,10 @@ class TestSessionStateLeakLint:
     assert findings == []
 
   def test_rule_in_catalog_and_repo_pinned_clean(self):
-    from tensor2robot_tpu.analysis import lint
+    from tensor2robot_tpu.analysis import engine, lint
 
-    assert "session-state-leak" in lint._RULE_CATALOG
+    engine.load_builtin_rules()
+    assert "session-state-leak" in engine.catalog_text()
     package = os.path.join(REPO_ROOT, "tensor2robot_tpu")
     findings = [f for f in lint.run([package])
                 if f.rule == "session-state-leak"]

@@ -12,6 +12,7 @@ Three contracts:
   would instead risk wedging TPU hardware).
 """
 
+import json
 import os
 import subprocess
 import sys
@@ -20,8 +21,13 @@ import numpy as np
 import pytest
 
 from tensor2robot_tpu import specs
-from tensor2robot_tpu.analysis import (config_check, findings as findings_lib,
-                                       lint, spec_check, tracer_check)
+from tensor2robot_tpu.analysis import (cache_check, config_check,
+                                       engine as engine_lib,
+                                       findings as findings_lib, fleet_check,
+                                       forge_check, lint, loop_check,
+                                       native_check, pp_check, retry_check,
+                                       session_check, spec_check,
+                                       thread_check, tracer_check)
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import mocks  # registers MockT2RModel  # noqa: F401
 
@@ -587,6 +593,258 @@ def test_grasp2vec_quadrant_centers_is_host_constant():
   from tensor2robot_tpu.research.grasp2vec import losses
 
   assert type(losses._QUADRANT_CENTERS) is np.ndarray
+
+
+# ---------------------------------------------------------------------------
+# The rule engine (analysis/engine.py): parity, catalog, JSON, baseline,
+# incremental cache.
+# ---------------------------------------------------------------------------
+
+
+def _seed_engine_fixtures(tmp_path):
+  """A fixture tree dense enough that any ordering, filtering, or
+  suppression drift between the engine and the per-checker pipeline
+  shows up: several rule families, a multi-finding file, a syntax
+  error, a suppressed finding, and a broken config."""
+  (tmp_path / "bad_tracer.py").write_text(
+      "import time\n"
+      "import jax\n"
+      "import numpy as np\n"
+      "_D = jax.devices()\n"
+      "@jax.jit\n"
+      "def step(x):\n"
+      "  t = time.time()\n"
+      "  return float(x)\n")
+  (tmp_path / "bad_spec.py").write_text(
+      "from tensor2robot_tpu import specs\n"
+      "A = specs.TensorSpec(shape=(4,), sharding=('nope',))\n"
+      "B = specs.TensorSpec(shape=(4, 4), sharding=('model', 'model'))\n")
+  (tmp_path / "bad_syntax.py").write_text("def broken(:\n")
+  (tmp_path / "suppressed.py").write_text(
+      "import jax\n"
+      "_D = jax.devices()  # graftlint: disable=import-time-backend\n")
+  (tmp_path / "bad_config.gin").write_text(
+      "NopeNotAThing.x = 1\n"
+      "train_eval_model.max_train_steps = 'lots'\n")
+
+
+def _per_checker_pipeline(paths):
+  """The pre-engine `lint.run` replicated verbatim (one parse per
+  checker per file; the checkers' standalone entry points are
+  unchanged). The engine must match it finding-for-finding."""
+  py_files, gin_files = engine_lib.discover(list(paths))
+  package_dir = os.path.dirname(os.path.abspath(lint.__file__))
+  _, repo_gin = engine_lib.discover([os.path.dirname(package_dir)])
+  mesh_axes = spec_check.known_mesh_axes(
+      sorted(set(gin_files) | set(repo_gin)))
+  findings = []
+  for path in gin_files:
+    findings.extend(config_check.check_config_file(path))
+  for path in py_files:
+    findings.extend(tracer_check.check_python_file(path))
+    findings.extend(spec_check.check_python_file(path, mesh_axes))
+    findings.extend(cache_check.check_python_file(path))
+    findings.extend(pp_check.check_python_file(path))
+    findings.extend(session_check.check_python_file(path))
+    findings.extend(fleet_check.check_python_file(path))
+    findings.extend(forge_check.check_python_file(path))
+    findings.extend(retry_check.check_python_file(path))
+    findings.extend(thread_check.check_python_file(path))
+    findings.extend(loop_check.check_python_file(path))
+    if (os.path.basename(path) == "__init__.py"
+        and os.path.basename(os.path.dirname(path)) == "native"):
+      findings.extend(native_check.check_native_bindings(
+          os.path.dirname(path)))
+  return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def test_engine_parity_on_seeded_fixtures(tmp_path):
+  """Tentpole acceptance: the single-parse engine's findings are
+  byte-identical to the per-checker pipeline's."""
+  _seed_engine_fixtures(tmp_path)
+  old = _per_checker_pipeline([str(tmp_path)])
+  result = engine_lib.run_engine([str(tmp_path)])
+  assert [str(f) for f in result.findings] == [str(f) for f in old]
+  # The fixtures seed a dense report — an empty==empty pass proves
+  # nothing. parse-error, 4 tracer, 2 spec, 2 config findings; the
+  # suppressed one appears on neither side.
+  assert len(old) >= 8
+  assert "parse-error" in _rules(old)
+  assert not any("suppressed.py" in f.path for f in old)
+  # One `ast.parse` per .py file (incl. the failed one) — not one per
+  # checker per file; .gin goes through the config statement parser.
+  assert result.stats["parses"] == 4
+
+
+def test_engine_parity_on_repo():
+  """And over the real tree (both sides empty — test_repo_clean pins
+  that — but this pins that the engine discovers the same file set)."""
+  old = _per_checker_pipeline(LINT_PATHS)
+  result = engine_lib.run_engine(LINT_PATHS)
+  assert [str(f) for f in result.findings] == [str(f) for f in old]
+  assert result.stats["files"] == (result.stats["py_files"]
+                                   + result.stats["gin_files"])
+  assert result.stats["parses"] <= result.stats["files"]
+
+
+def test_engine_suppression_provenance(tmp_path):
+  _seed_engine_fixtures(tmp_path)
+  result = engine_lib.run_engine([str(tmp_path)])
+  supp = [(f, line) for f, line in result.suppressed
+          if f.path.endswith("suppressed.py")]
+  assert len(supp) == 1
+  finding, at_line = supp[0]
+  assert finding.rule == "import-time-backend"
+  assert at_line == 2
+
+
+def test_json_output_enriched(tmp_path, capsys):
+  _seed_engine_fixtures(tmp_path)
+  rc = lint.main(["--json", str(tmp_path)])
+  assert rc == 1
+  records = [json.loads(line)
+             for line in capsys.readouterr().out.splitlines()]
+  for record in records:
+    assert set(record) >= {"path", "line", "rule", "severity", "message",
+                           "suppressed"}
+    assert record["severity"] in ("error", "warning")
+  suppressed = [r for r in records if r["suppressed"]]
+  assert len(suppressed) == 1
+  assert suppressed[0]["rule"] == "import-time-backend"
+  assert suppressed[0]["suppressed_by"] == 2
+  live = [r for r in records if not r["suppressed"]]
+  assert live and all("suppressed_by" not in r for r in live)
+
+
+def test_plain_output_byte_stable(tmp_path, capsys):
+  """Existing scripts parse `path:line: [rule] message`; the plain
+  printer must not grow fields."""
+  _seed_engine_fixtures(tmp_path)
+  lint.main([str(tmp_path)])
+  out = capsys.readouterr().out
+  assert out
+  for line in out.splitlines():
+    assert ": [" in line, line
+    assert line.split(":")[1].isdigit(), line
+    assert str(findings_lib.Finding(
+        line.split(":")[0], int(line.split(":")[1]),
+        line.split("[")[1].split("]")[0],
+        line.split("] ", 1)[1])) == line
+
+
+def test_baseline_round_trip(tmp_path, capsys):
+  _seed_engine_fixtures(tmp_path)
+  baseline = tmp_path / "baseline.json"
+  assert lint.main(["--write-baseline", str(baseline), str(tmp_path)]) == 0
+  capsys.readouterr()
+  # Everything baselined: clean.
+  assert lint.main(["--baseline", str(baseline), str(tmp_path)]) == 0
+  assert capsys.readouterr().out == ""
+  # A NEW violation still gates.
+  (tmp_path / "new_bad.py").write_text("import jax\n_D = jax.devices()\n")
+  assert lint.main(["--baseline", str(baseline), str(tmp_path)]) == 1
+  out = capsys.readouterr().out
+  assert "new_bad.py" in out and "bad_tracer.py" not in out
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+  _seed_engine_fixtures(tmp_path)
+  findings = engine_lib.run_engine([str(tmp_path)]).findings
+  fingerprints = {engine_lib.finding_fingerprint(f) for f in findings}
+  # Shift bad_tracer.py down two lines; fingerprints must not move.
+  bad = tmp_path / "bad_tracer.py"
+  bad.write_text("\n\n" + bad.read_text())
+  shifted = engine_lib.run_engine([str(tmp_path)]).findings
+  assert {engine_lib.finding_fingerprint(f) for f in shifted} == fingerprints
+
+
+def test_incremental_cache_and_changed_only(tmp_path, capsys):
+  _seed_engine_fixtures(tmp_path)
+  cache = tmp_path / "cache.json"
+  first = engine_lib.run_engine([str(tmp_path)], cache_path=str(cache))
+  assert first.stats["cache_hits"] == 0
+  # Warm: every .py served from cache, findings identical.
+  second = engine_lib.run_engine([str(tmp_path)], cache_path=str(cache))
+  assert second.stats["cache_hits"] >= 4
+  assert ([str(f) for f in second.findings]
+          == [str(f) for f in first.findings])
+  # --changed-only: nothing moved -> nothing reported, exit 0.
+  rc = lint.main(["--cache-file", str(cache), "--changed-only",
+                  str(tmp_path)])
+  assert rc == 0
+  capsys.readouterr()
+  # Touch ONE file -> only its findings come back.
+  bad = tmp_path / "bad_spec.py"
+  bad.write_text(bad.read_text() + "\n# touched\n")
+  rc = lint.main(["--cache-file", str(cache), "--changed-only",
+                  str(tmp_path)])
+  assert rc == 1
+  out = capsys.readouterr().out
+  assert "bad_spec.py" in out and "bad_tracer.py" not in out
+
+
+def test_changed_only_requires_cache_file(tmp_path):
+  assert lint.main(["--changed-only", str(tmp_path)]) == 2
+
+
+def test_cache_invalidated_by_vocab_change(tmp_path):
+  """The cache stamp includes the mesh-axis vocabulary: a config
+  declaring a new axis must re-validate cached spec findings."""
+  (tmp_path / "model.py").write_text(
+      "from tensor2robot_tpu import specs\n"
+      "S = specs.TensorSpec(shape=(4, 4), sharding=('zz', None))\n")
+  cache = tmp_path / "cache.json"
+  first = engine_lib.run_engine([str(tmp_path)], cache_path=str(cache))
+  assert _rules(first.findings) == {"unknown-mesh-axis"}
+  (tmp_path / "mesh.gin").write_text(
+      "train_eval_model.mesh_axis_names = ('data', 'zz')\n")
+  second = engine_lib.run_engine([str(tmp_path)], cache_path=str(cache))
+  assert second.stats["cache_hits"] == 0  # stamp moved, full re-run
+  assert not second.findings
+
+
+def test_stats_and_runs_telemetry(tmp_path):
+  from tensor2robot_tpu.obs import runlog
+
+  runs = tmp_path / "runs.jsonl"
+  (tmp_path / "clean.py").write_text("X = 1\n")
+  rc = lint.main(["--runs", str(runs), str(tmp_path / "clean.py")])
+  assert rc == 0
+  records = [json.loads(line) for line in
+             runs.read_text().splitlines()]
+  assert len(records) == 1
+  bench = records[0]["bench"]
+  assert bench["name"] == "lint"
+  assert bench["lint_parse_ms"] >= 0 and bench["lint_rules_ms"] >= 0
+  assert records[0]["extra"]["lint"]["files"] == 1
+  # The diff gate knows these metrics.
+  assert "lint_parse_ms" in runlog.DEFAULT_THRESHOLDS
+  assert "lint_rules_ms" in runlog.DEFAULT_THRESHOLDS
+  metrics = runlog.key_metrics(records[0])
+  assert set(metrics) == {"lint_parse_ms", "lint_rules_ms"}
+
+
+def test_catalog_single_source_of_truth(capsys):
+  """--list-rules, docs/ARCHITECTURE.md, and the registry agree. The
+  docs table is generated (see the marker comments) — regenerate with
+  engine.catalog_markdown() after touching any RuleInfo."""
+  engine_lib.load_builtin_rules()
+  assert lint.main(["--list-rules"]) == 0
+  listed = capsys.readouterr().out
+  for info in engine_lib.rule_infos():
+    assert info.id in listed, info.id
+  doc = open(os.path.join(REPO_ROOT, "docs", "ARCHITECTURE.md")).read()
+  begin = doc.index("<!-- graftlint-catalog:begin -->")
+  end = doc.index("<!-- graftlint-catalog:end -->")
+  table = doc[begin + len("<!-- graftlint-catalog:begin -->"):end].strip()
+  assert table == engine_lib.catalog_markdown().strip()
+
+
+def test_parse_error_is_unsuppressible(tmp_path):
+  (tmp_path / "bad.py").write_text(
+      "def broken(:  # graftlint: disable=parse-error\n")
+  findings = engine_lib.run_engine([str(tmp_path)]).findings
+  assert _rules(findings) == {"parse-error"}
 
 
 @pytest.fixture(autouse=True)
