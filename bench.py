@@ -118,7 +118,9 @@ import sys
 import tempfile
 import time
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import backend as backend_lib
 
 BASELINE_PER_CHIP = 400.0  # est. V100-class grasps/sec/device (see docstring)
@@ -1799,6 +1801,7 @@ def session_main() -> None:
   per_t: dict = {}
   engine = None
   churn_block = None
+  stage_block = None
   for seq_len in SESSION_PREFIX_LENGTHS:
     # hidden 128: big enough that model compute (not per-call dispatch
     # overhead, ~0.1 ms on this host) dominates the stateless tick, so
@@ -1893,6 +1896,30 @@ def session_main() -> None:
               "counter/serve/session/exec_fallbacks", 0.0),
       }
 
+      # graftrace stage decomposition at the headline T, measured
+      # through the continuous-batching front (the paired arms above
+      # drive the raw engine, so nothing queues there): concurrent
+      # episodes stepping through one SessionBatcher, queue_wait +
+      # dispatch recorded per tick.
+      import threading
+
+      with obs_metrics.isolated():
+        with serving.SessionBatcher(engine=engine,
+                                    max_delay_ms=1.0) as front:
+          def episode() -> None:
+            sid = front.open()
+            for t in range(8):
+              front.step(sid, {"observation": obs_seq[0, t]})
+            front.close_session(sid)
+
+          clients = [threading.Thread(target=episode)
+                     for _ in range(4)]
+          for c in clients:
+            c.start()
+          for c in clients:
+            c.join()
+        stage_block = graftrace.stage_breakdown()
+
   t_lo, t_hi = SESSION_PREFIX_LENGTHS[0], SESSION_PREFIX_LENGTHS[-1]
   decode_hi = per_t[t_hi]["decode_tick_ms"]
   decode_lo = per_t[t_lo]["decode_tick_ms"]
@@ -1918,6 +1945,7 @@ def session_main() -> None:
       "warmup_ms": (round(engine.warmup_ms, 2)
                     if engine.warmup_ms is not None else None),
       "session_cache_bytes": engine.cache_bytes,
+      "stage_breakdown": stage_block,
       "churn": churn_block,
       "device_kind": device.device_kind,
       "platform": device.platform,
@@ -1992,6 +2020,7 @@ def serve_main(requests_per_thread: int = 150) -> None:
   sweep = []
   latency = {}
   batch_stats: dict = {}
+  stage_block = None
   with serving.MicroBatcher(backend=engine,
                             max_batch_size=SERVE_MAX_BATCH,
                             max_delay_ms=2.0) as batcher:
@@ -2003,6 +2032,10 @@ def serve_main(requests_per_thread: int = 150) -> None:
                                   requests_per_thread=requests_per_thread)
         if concurrency == SERVE_CONCURRENCY:
           latency = loadgen.latency_percentiles()
+          # Where the request time went (graftrace stage decomposition:
+          # queue_wait/batch_form/dispatch/split sum to ~request_ms;
+          # pad/device are informational sub-spans of dispatch).
+          stage_block = graftrace.stage_breakdown()
           snap = obs_metrics.snapshot(prefix="serve/")
           batch_stats = {
               "batches": snap.get("counter/serve/batcher/batches"),
@@ -2045,6 +2078,7 @@ def serve_main(requests_per_thread: int = 150) -> None:
       "warmup_ms": (round(engine.warmup_ms, 2)
                     if engine.warmup_ms is not None else None),
       "latency_ms": {k: round(v, 3) for k, v in latency.items()},
+      "stage_breakdown": stage_block,
       "batcher": batch_stats,
       "sweep": sweep,
       "device_kind": device.device_kind,
@@ -2077,6 +2111,14 @@ FLEET_ARRIVALS = 1000
 FLEET_CLIENTS = 96
 FLEET_ROLLOUT_RATE_HZ = 250.0
 FLEET_ROLLOUT_ARRIVALS = 500
+# Traced-vs-untraced A/B pairs (ISSUE 18): the per-event ring-append
+# cost of graftrace, priced as a paired goodput ratio on the fleet arm
+# (stage histograms run in BOTH arms — they are always-on telemetry —
+# so the ratio isolates exactly the optional trace-event recording).
+FLEET_TRACE_PAIRS = 3  # odd: the median is a real middle pair, not the
+                       # upper of two (single pairs swing ±8% with host
+                       # load; the clipped-at-zero lower tail would
+                       # otherwise bias the even-count median up)
 # Recorded for this exact config on this host at first landing
 # (ISSUE 12). Like every absolute wall-clock on the 1-core VM it swings
 # with load — the load-invariant number is fleet_vs_single_replica
@@ -2308,6 +2350,56 @@ def fleet_main() -> None:
     fleet_qps = _median([p["fleet_qps"] for p in pairs])
     single_qps = _median([p["single_qps"] for p in pairs])
 
+    # Tracing-overhead A/B (acceptance: <= 3% on the CPU smoke;
+    # diff-gated up-bad as trace_overhead_ratio): back-to-back duo arms
+    # with the trace ring recording vs not, alternating order. The
+    # traced arm also yields the headline stage decomposition and
+    # serve_queue_wait_p99_ms (both from its isolated metrics window).
+    tracer = obs_trace.get_tracer()
+    trace_pairs = []
+    stage_block = None
+    queue_wait_p99 = None
+
+    def run_overhead_arm(traced: bool, seed: int) -> float:
+      nonlocal stage_block, queue_wait_p99
+      tracer.clear()
+      (obs_trace.enable if traced else obs_trace.disable)()
+      try:
+        with obs_metrics.isolated():
+          res = loadgen.run_trace_load(
+              predict=duo.predict, make_request=make_request,
+              num_arrivals=FLEET_ARRIVALS, rate_hz=FLEET_RATE_HZ,
+              profile="poisson", seed=seed,
+              max_client_threads=FLEET_CLIENTS)
+          if traced:
+            stage_block = graftrace.stage_breakdown()
+            qw = (stage_block or {}).get("stages", {}).get("queue_wait")
+            if qw is not None:
+              queue_wait_p99 = qw["p99_ms"]
+        return res["ok_requests"] / res["wall_sec"]
+      finally:
+        obs_trace.disable()
+        tracer.clear()
+
+    for pair in range(FLEET_TRACE_PAIRS):
+      order = (True, False) if pair % 2 == 0 else (False, True)
+      qps_by_arm = {}
+      for traced in order:
+        qps_by_arm[traced] = run_overhead_arm(traced, seed=100 + pair)
+      trace_pairs.append({
+          "traced_qps": round(qps_by_arm[True], 1),
+          "untraced_qps": round(qps_by_arm[False], 1),
+          "overhead": round(
+              max(0.0, 1.0 - (qps_by_arm[True] / qps_by_arm[False]
+                              if qps_by_arm[False] else 1.0)), 4),
+      })
+      print(f"bench-fleet: trace pair {pair}: traced "
+            f"{qps_by_arm[True]:.0f} req/s, untraced "
+            f"{qps_by_arm[False]:.0f} req/s "
+            f"(overhead {trace_pairs[-1]['overhead']:.3f})",
+            file=sys.stderr)
+    trace_overhead = _median([p["overhead"] for p in trace_pairs])
+
     # Zero-downtime rollout window: continuous open-loop load at a rate
     # ONE replica can absorb (the pin is no failures while capacity is
     # halved replica-by-replica), rollout mid-window.
@@ -2376,6 +2468,15 @@ def fleet_main() -> None:
         "pairs": pairs,
         "emulated_device_wait_ms": FLEET_DEVICE_WAIT_MS,
         "replica_dispatch_cpu_ms": round(dispatch_cpu_ms, 2),
+        # ISSUE 18 observability economics: where the request time goes
+        # (graftrace stage decomposition, summed stages reconciling
+        # against serve/request_ms within 5%), what the worst queueing
+        # tail costs (diff-gated up-bad), and what recording it all
+        # costs (paired A/B, <= 3% acceptance, diff-gated up-bad).
+        "stage_breakdown": stage_block,
+        "serve_queue_wait_p99_ms": queue_wait_p99,
+        "trace_overhead_ratio": trace_overhead,
+        "trace_overhead_pairs": trace_pairs,
         "open_loop": {"profile": "poisson", "rate_hz": FLEET_RATE_HZ,
                       "arrivals_per_arm": FLEET_ARRIVALS},
         "buckets": single.replica(0).buckets,
@@ -2880,6 +2981,21 @@ def loop_main() -> None:
                              at=tuple(range(40, 46)), count=6),
       ], seed=LOOP_SEED)
     with obs_metrics.isolated() as registry:
+      # Arm the graftrace shard exporter into this arm's model_dir:
+      # every loop worker (actors, learner, publisher, supervisor)
+      # shares this process, so one pid's ring covers the whole loop;
+      # the publisher worker flushes periodically and close() drains
+      # the tail. The merged timeline is the ISSUE 18 acceptance
+      # artifact (episode -> shard -> round -> publish -> first_action
+      # as a walkable chain).
+      # max_gens: the 5 s publisher flush cadence writes ~12 gens over
+      # a bench arm; the production default (8) would prune the early
+      # generations that hold round 1's causal spine (episode ->
+      # shard -> round -> publish) and the merged chain check would
+      # fail on ring rotation, not on a real causality break.
+      graftrace.configure(os.path.join(root, "trace"),
+                          role="loop-chaos" if faulted else "loop-clean",
+                          max_gens=64)
       graft_loop = loop_lib.GraftLoop(
           model_factory=lambda: pose_models.PoseEnvContinuousMCModel(
               device_type="cpu"),
@@ -2905,6 +3021,8 @@ def loop_main() -> None:
       finally:
         if plan is not None:
           faultlab.deactivate()
+        graftrace.flush()
+        obs_trace.disable()
       snap = registry.snapshot()
     summary["injected"] = plan.summary() if plan is not None else None
     summary["learner_rewinds"] = snap.get(
@@ -2934,6 +3052,32 @@ def loop_main() -> None:
           f"{chaos['publishes']} publishes, "
           f"{chaos['publish_rejected']:.0f} rejected, "
           f"{chaos['worker_restarts']:.0f} restarts", file=sys.stderr)
+
+    # The merged clean-arm timeline must carry ONE walkable causal
+    # chain from an episode's collect span through its replay shard,
+    # the learner round that consumed it, the publish of the trained
+    # version, and the first served action of that version — the
+    # graftrace acceptance artifact (each hop a parent/links edge, so
+    # `graftscope timeline` renders it as Perfetto flow arrows).
+    from tensor2robot_tpu.obs import aggregate as aggregate_lib
+    merged = aggregate_lib.merge_timeline(
+        os.path.join(loop_root, "clean", "trace"))
+    events = merged["payload"]["traceEvents"]
+    trace_block = {
+        "shards": merged["stats"]["shards"],
+        "events": merged["stats"]["events"],
+        "flow_links": merged["stats"]["flow_links"],
+        "episode_chain": aggregate_lib.has_causal_chain(
+            events, ("loop/episode", "loop/replay/shard",
+                     "loop/learner/round", "loop/publish",
+                     "loop/first_action")),
+        "publish_chain": aggregate_lib.has_causal_chain(
+            events, ("loop/publish", "loop/first_action")),
+    }
+    print(f"bench-loop: timeline {trace_block['shards']} shards, "
+          f"{trace_block['events']} events, "
+          f"{trace_block['flow_links']} flow links, episode chain "
+          f"{trace_block['episode_chain']}", file=sys.stderr)
 
     # The torn step must be provably the one the manifest walk refused:
     # its verdict re-checked from disk is False, and it never appears in
@@ -2986,6 +3130,7 @@ def loop_main() -> None:
         "recovered": recovered,
         "goodput_floor": LOOP_GOODPUT_FLOOR,
         "seed": LOOP_SEED,
+        "graftrace": trace_block,
         "clean": clean,
         "chaos": chaos,
         "device_kind": device.device_kind,

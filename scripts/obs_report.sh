@@ -13,15 +13,19 @@
 #        scripts/obs_report.sh --history <model_dir|runs.jsonl>
 #        scripts/obs_report.sh --diff <runA> <runB> [--threshold m=rel]
 #        scripts/obs_report.sh --postmortem <dir> [--index I] [--list]
+#        scripts/obs_report.sh --timeline <dir> [--out timeline.json]
 #   (run references: model_dir / runs.jsonl, optional #run_id or #index;
 #    --postmortem renders the latest flight-recorder bundle: last steps,
-#    incident timeline, tunnel-heartbeat transitions)
+#    incident timeline, tunnel-heartbeat transitions; --timeline merges
+#    graftrace trace-*.json shards under <dir> into one clock-aligned
+#    Perfetto JSON)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 case "${1:-}" in
   --diff) shift; set -- diff "$@" ;;
   --history) shift; set -- history "$@" ;;
   --postmortem) shift; set -- postmortem "$@" ;;
+  --timeline) shift; set -- timeline "$@" ;;
 esac
 exec python -c '
 import sys

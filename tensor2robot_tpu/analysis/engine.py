@@ -65,14 +65,14 @@ SEVERITIES = ("error", "warning")
 # applied (tie-order inside one (path, line, rule) sort key depends on
 # it, so it is part of the byte-parity contract, not a style choice).
 CHECK_ORDER = ("tracer", "spec", "cache", "pp", "session", "fleet",
-               "forge", "retry", "thread", "loop", "native")
+               "forge", "retry", "thread", "loop", "native", "tracectx")
 
 # Catalog presentation order — the family order `--list-rules` has
 # always printed (config first, spec last) with the jaxpr-audit family
 # appended after it.
-CATALOG_ORDER = ("config", "tracer", "cache", "pp", "session", "retry",
-                 "fleet", "forge", "loop", "thread", "native", "spec",
-                 "audit")
+CATALOG_ORDER = ("config", "tracer", "tracectx", "cache", "pp",
+                 "session", "retry", "fleet", "forge", "loop", "thread",
+                 "native", "spec", "audit")
 
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
 
@@ -175,7 +175,7 @@ def load_builtin_rules() -> None:
                                          native_check, pp_check,
                                          retry_check, session_check,
                                          spec_check, thread_check,
-                                         tracer_check)
+                                         trace_check, tracer_check)
   _BUILTINS_LOADED = True
 
 

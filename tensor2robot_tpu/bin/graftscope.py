@@ -43,6 +43,13 @@ docs/ARCHITECTURE.md "Observability"); this is the read side:
       checks an existing cache against the plan without compiling;
       exit codes match `cache` (0 ok / 1 bad or missing / 2 usage).
       The parent process stays backend-free — jax lives in workers.
+  python -m tensor2robot_tpu.bin.graftscope timeline <dir>
+      graftrace merge (obs.aggregate): fold every per-process
+      trace-<pid>-<gen>.json shard under <dir> into one clock-aligned
+      Perfetto JSON with flow arrows along the causal edges (request ->
+      batch dispatch; episode -> replay shard -> learner round ->
+      publish -> first served action). Skewed wall clocks get the
+      happened-before repair; corrupt shards are counted + skipped.
 
 Robustness contract: a torn tail line of a live run, a truncated trace
 JSON, or binary garbage in any telemetry file is skipped with a warning
@@ -917,10 +924,52 @@ def _main_audit(argv: List[str]) -> int:
   return 1 if (findings or errors) else 0
 
 
+def _main_timeline(argv: List[str]) -> int:
+  parser = argparse.ArgumentParser(
+      prog="python -m tensor2robot_tpu.bin.graftscope timeline",
+      description="graftrace merge (obs.aggregate): collect every "
+                  "trace-<pid>-<gen>.json shard under a directory into "
+                  "ONE clock-aligned Perfetto/chrome://tracing JSON "
+                  "with synthesized flow arrows along the causal edges "
+                  "(request -> batch dispatch; episode -> replay shard "
+                  "-> learner round -> publish -> first action). "
+                  "Tolerant: corrupt shards are counted and skipped. "
+                  "Exit codes: 0 merged events, 1 no usable shards, "
+                  "2 usage.")
+  parser.add_argument("root",
+                      help="directory to search recursively for "
+                           "graftrace shards (a model_dir or a "
+                           "GRAFTRACE_DIR)")
+  parser.add_argument("--out", default=None,
+                      help="output path (default: "
+                           "<root>/timeline.json)")
+  args = parser.parse_args(argv)
+  if not os.path.isdir(args.root):
+    print(f"graftscope timeline: no such directory: {args.root}",
+          file=sys.stderr)
+    return 2
+  from tensor2robot_tpu.obs import aggregate as aggregate_lib
+
+  out = args.out or os.path.join(args.root, "timeline.json")
+  stats = aggregate_lib.write_timeline(args.root, out)
+  print(f"graftscope timeline: {stats['shards']} shard(s) over "
+        f"{stats['processes']} process(es) -> {stats['events']} events, "
+        f"{stats['flow_links']} flow link(s)"
+        + (f", {stats['skipped']} unreadable shard(s) skipped"
+           if stats["skipped"] else ""))
+  if stats["skew_corrected_pids"]:
+    shifts = ", ".join(f"pid {p}: +{ms}ms" for p, ms
+                       in sorted(stats["skew_corrected_pids"].items()))
+    print(f"  clock-skew repair (happened-before): {shifts}")
+  print(f"  wrote {out} (load in https://ui.perfetto.dev or "
+        "chrome://tracing)")
+  return 0 if stats["events"] else 1
+
+
 _SUBCOMMANDS = {"report": _main_report, "history": _main_history,
                 "diff": _main_diff, "postmortem": _main_postmortem,
                 "cache": _main_cache, "forge": _main_forge,
-                "audit": _main_audit}
+                "audit": _main_audit, "timeline": _main_timeline}
 
 
 def main(argv: Optional[List[str]] = None) -> int:

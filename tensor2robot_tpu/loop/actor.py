@@ -44,7 +44,9 @@ from typing import Any, Callable, Dict, Optional
 from absl import logging
 
 from tensor2robot_tpu.obs import faultlab as faultlab_lib
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 
 __all__ = ["EpisodeActor"]
 
@@ -138,16 +140,26 @@ class EpisodeActor:
         if self._note_version is not None:
           self._note_version(step, staleness)
         try:
-          self.last_stats = run_env_lib.run_env(
-              env=env, policy=policy,
-              num_episodes=self._episodes_per_iteration,
-              explore_schedule=self._explore_schedule,
-              global_step=int(step or 0), tag=self._tag,
-              episode_to_transitions_fn=self._episode_to_transitions_fn,
-              replay_writer=(self._sink if self._episode_to_transitions_fn
-                             is not None else None),
-              max_episode_steps=self._max_episode_steps,
-              log_stats=False)
+          # One trace context per collection burst: the replay sink
+          # reads it off the thread (graftrace.current()) when the
+          # episode's transitions land, which is how a collect span
+          # becomes walkable into its replay shard -> learner round ->
+          # publish -> first served action (the graftrace loop chain).
+          episode_ctx = graftrace.mint()
+          with graftrace.activate(episode_ctx), \
+              obs_trace.span("loop/episode", cat="loop",
+                             actor=self._index,
+                             serving_step=int(step or 0)):
+            self.last_stats = run_env_lib.run_env(
+                env=env, policy=policy,
+                num_episodes=self._episodes_per_iteration,
+                explore_schedule=self._explore_schedule,
+                global_step=int(step or 0), tag=self._tag,
+                episode_to_transitions_fn=self._episode_to_transitions_fn,
+                replay_writer=(self._sink if self._episode_to_transitions_fn
+                               is not None else None),
+                max_episode_steps=self._max_episode_steps,
+                log_stats=False)
         except (batcher_lib.ShedError, session_lib.SessionError):
           # Transient serving-side refusal — queue-bound shed, every
           # replica mid-swap during a rollout, a session slot-capacity

@@ -53,8 +53,10 @@ from tensor2robot_tpu.loop import actor as actor_lib
 from tensor2robot_tpu.loop import publish as publish_lib
 from tensor2robot_tpu.loop import replay as replay_lib
 from tensor2robot_tpu.loop import supervisor as supervisor_lib
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import retry as retry_lib
 
@@ -281,6 +283,14 @@ class GraftLoop:
     if first and published is not None:
       obs_metrics.histogram("loop/publish_to_first_action_ms").record(
           (now - published) * 1e3)
+      # The chain's terminal event: an instant parented on the publish
+      # span that made this version servable — the scalar above becomes
+      # a walkable edge in the merged timeline.
+      first_ctx = graftrace.mint()
+      obs_trace.instant(
+          "loop/first_action", cat="loop", step=int(step),
+          trace_id=first_ctx.trace_id, span_id=first_ctx.span_id,
+          parent_id=self.publisher.publish_span_id(int(step)))
 
   def _request_repair(self) -> None:
     """Staleness repair: re-roll the current published version (rollout
@@ -313,12 +323,20 @@ class GraftLoop:
       self.supervisor.spawn(f"actor-{index}", episode_actor.run)
 
   def _publisher_worker(self, worker) -> None:
+    last_flush = time.monotonic()
     while not worker.should_stop.is_set():
       worker.beat()
       try:
         self.publisher.drain_pending(timeout_s=0.2)
       except Exception:  # noqa: BLE001 - a failed publish must not kill
         logging.exception("graftloop: publish failed")  # the worker
+      # Periodic shard flush (no-op unless graftrace.configure armed
+      # the exporter): an always-on loop exports its trace/metrics
+      # windows continuously, not only at teardown.
+      now = time.monotonic()
+      if now - last_flush >= 5.0:
+        last_flush = now
+        graftrace.flush()
 
   def _make_input_generator(self):
     if self._input_generator_factory is not None:
@@ -388,11 +406,24 @@ class GraftLoop:
       kwargs["hook_builders"] = (
           list(kwargs.get("hook_builders") or [])
           + [_LoopHookBuilder(self.publisher, worker)])
-      train_eval.train_eval_model(
-          model=self._model_factory(),
-          model_dir=self._model_dir,
-          input_generator_train=self._make_input_generator(),
-          **kwargs)
+      # One trace context per round, LINKED to the replay shards the
+      # round's input glob can see: the causal edge shard -> round. The
+      # activation makes `after_checkpoint` -> `request_publish` capture
+      # this context, so the eventual publish parents on the round.
+      round_ctx = graftrace.mint()
+      shard_links = sorted(set(self.sink.shard_spans().values()))
+      round_ns = time.perf_counter_ns()
+      with graftrace.activate(round_ctx):
+        train_eval.train_eval_model(
+            model=self._model_factory(),
+            model_dir=self._model_dir,
+            input_generator_train=self._make_input_generator(),
+            **kwargs)
+      obs_trace.add_complete(
+          "loop/learner/round", round_ns,
+          time.perf_counter_ns() - round_ns, cat="loop",
+          args={**round_ctx.args(), "target_step": target,
+                "links": shard_links})
       obs_metrics.counter("loop/learner_rounds").inc()
 
   # -- lifecycle ------------------------------------------------------------
@@ -432,6 +463,7 @@ class GraftLoop:
     self.sink.close()
     if self.fleet is not None:
       self.fleet.close()
+    graftrace.flush()
 
   # -- accounting -----------------------------------------------------------
 

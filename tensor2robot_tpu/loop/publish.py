@@ -52,9 +52,11 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import sentinel as sentinel_lib
+from tensor2robot_tpu.obs import trace as obs_trace
 
 __all__ = ["CheckpointPublisher"]
 
@@ -102,6 +104,12 @@ class CheckpointPublisher:
     self._ever_published: set = set()
     self._publish_time_s: Dict[int, float] = {}
     self._history: List[Dict[str, Any]] = []
+    # Causality (graftrace): the learner-round context captured at
+    # `request_publish(step)` and, per SERVED step, the span_id of its
+    # `loop/publish` event — `_note_version` parents the first-action
+    # instant on it, closing the episode->...->first_action chain.
+    self._request_ctx: Dict[int, Any] = {}
+    self._publish_span_ids: Dict[int, str] = {}
 
   # -- introspection --------------------------------------------------------
 
@@ -165,6 +173,15 @@ class CheckpointPublisher:
     with self._state_lock:
       return self._publish_time_s.get(int(step))
 
+  def publish_span_id(self, step: Optional[int]) -> Optional[str]:
+    """Span id of the `loop/publish` event that made `step` servable
+    (None for unpublished steps) — the parent of the first-action
+    instant."""
+    if step is None:
+      return None
+    with self._state_lock:
+      return self._publish_span_ids.get(int(step))
+
   def history(self) -> List[Dict[str, Any]]:
     with self._state_lock:
       return [dict(h) for h in self._history]
@@ -192,8 +209,13 @@ class CheckpointPublisher:
 
     step = int(step)
     report: Dict[str, Any] = {"step": step, "published": False}
+    with self._state_lock:
+      request_ctx = self._request_ctx.pop(step, None)
+    publish_ctx = (request_ctx.child() if request_ctx is not None
+                   else graftrace.mint())
     with self._rollout_lock:
       t0 = time.perf_counter()
+      t0_ns = time.perf_counter_ns()
       # The learner's orbax saves are ASYNC and the manifest is written
       # only once the step dir COMMITS — `after_checkpoint` (and so this
       # publish) legitimately races both. Wait bounded for a manifest
@@ -257,6 +279,12 @@ class CheckpointPublisher:
           self._published_ordinal[served] = self._ordinal_counter
           self._ever_published.add(served)
           self._publish_time_s[served] = time.monotonic()
+        ordinal = self._published_ordinal[served]
+        self._publish_span_ids[served] = publish_ctx.span_id
+      obs_trace.add_complete(
+          "loop/publish", t0_ns, time.perf_counter_ns() - t0_ns,
+          cat="loop", args={**publish_ctx.args(), "step": step,
+                            "served": served, "ordinal": ordinal})
       obs_metrics.counter("loop/publishes").inc()
       obs_metrics.histogram("loop/publish_to_serve_ms").record(elapsed_ms)
       obs_metrics.gauge("loop/published_version").set(float(served))
@@ -273,10 +301,16 @@ class CheckpointPublisher:
 
   def request_publish(self, step: int) -> None:
     """Non-blocking: notes that `step` wants publication. Latest wins —
-    the learner must never block on a rollout."""
+    the learner must never block on a rollout. The caller's active
+    trace context (the learner round's, via the `after_checkpoint`
+    hook) is captured so the eventual `loop/publish` span parents on
+    it."""
+    ctx = graftrace.current()
     with self._state_lock:
       if self._pending is None or step > self._pending:
         self._pending = int(step)
+      if ctx is not None:
+        self._request_ctx[int(step)] = ctx
     self._pending_event.set()
 
   def note_rewind(self, target_step: int) -> None:

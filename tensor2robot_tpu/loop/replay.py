@@ -41,7 +41,9 @@ import os
 import threading
 from typing import Any, Dict, List, Optional, Sequence
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
+from tensor2robot_tpu.obs import trace as obs_trace
 
 __all__ = ["ReplayRecordSink"]
 
@@ -92,6 +94,12 @@ class ReplayRecordSink:
     self._shard_records = 0
     self._finished_records = 0
     self._record_counts: Dict[str, int] = {}
+    # Causality bookkeeping (graftrace): the episode spans written into
+    # the CURRENT shard, and per finished shard the span_id of its
+    # `loop/replay/shard` rotation event — the edge the learner's round
+    # links to (episode -> shard -> round is walkable in the timeline).
+    self._episode_spans: List[str] = []
+    self._shard_span_ids: Dict[str, str] = {}
     # Resume an existing directory (a restarted loop keeps its replay):
     # finished shards are inventoried; a torn `.tmp` from a crashed
     # writer is removed — it was never visible to the learner.
@@ -138,6 +146,12 @@ class ReplayRecordSink:
   def finished_shards(self) -> List[str]:
     with self._lock:
       return list(self._finished)
+
+  def shard_spans(self) -> Dict[str, str]:
+    """{finished shard path: span_id of its rotation event} — the
+    learner links its training round to the shards it consumed."""
+    with self._lock:
+      return dict(self._shard_span_ids)
 
   def finished_records(self) -> int:
     """Records inside FINISHED shards (what a learner's glob can read).
@@ -201,6 +215,16 @@ class ReplayRecordSink:
     self._finished_bytes += self._sizes[final]
     self._record_counts[final] = self._shard_records
     self._finished_records += self._shard_records
+    # Rotation is the shard's causal birth: one instant event whose
+    # `links` are the episode spans that fed it — the timeline edge
+    # from each actor's collect to this shard.
+    shard_ctx = graftrace.mint()
+    self._shard_span_ids[final] = shard_ctx.span_id
+    obs_trace.instant(
+        "loop/replay/shard", cat="loop",
+        shard=os.path.basename(final), records=self._shard_records,
+        links=list(self._episode_spans), **shard_ctx.args())
+    self._episode_spans = []
     self._writer = None
     self._shard_path = None
     self._shard_index += 1
@@ -222,6 +246,7 @@ class ReplayRecordSink:
       oldest = self._finished.pop(0)
       self._finished_bytes -= self._sizes.pop(oldest, 0)
       self._finished_records -= self._record_counts.pop(oldest, 0)
+      self._shard_span_ids.pop(oldest, None)
       try:
         os.remove(oldest)
       except OSError:
@@ -229,14 +254,22 @@ class ReplayRecordSink:
       obs_metrics.counter("loop/replay/dropped_shards").inc()
     return True
 
-  def append_episode(self, transitions: Sequence[Any]) -> bool:
+  def append_episode(self, transitions: Sequence[Any],
+                     trace_ctx=None) -> bool:
     """Appends one episode's transitions (mappings for
     `codec.encode_example`, or pre-serialized bytes). Returns False
-    when the episode was SHED under the byte cap (`on_full='shed'`)."""
+    when the episode was SHED under the byte cap (`on_full='shed'`).
+
+    `trace_ctx` (a `graftrace.TraceContext`, default: the thread's
+    active context — `run_env` streams through `write()` inside the
+    actor's `loop/episode` activation) attributes the episode to its
+    collect span; the shard rotation event links them."""
     from tensor2robot_tpu.data import codec
 
     if not transitions:
       return True
+    if trace_ctx is None:
+      trace_ctx = graftrace.current()
     payloads = [t if isinstance(t, bytes)
                 else codec.encode_example(t, self._spec_structure)
                 for t in transitions]
@@ -247,6 +280,8 @@ class ReplayRecordSink:
         return False
       if self._writer is None:
         self._open_shard_locked()
+      if trace_ctx is not None:
+        self._episode_spans.append(trace_ctx.span_id)
       for payload in payloads:
         self._writer.write(payload)
         # TFRecord framing: u64 length + 2x masked crc32 = 16 bytes.

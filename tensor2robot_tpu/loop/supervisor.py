@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Mapping, Optional
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import sentinel as sentinel_lib
@@ -302,6 +303,10 @@ class Supervisor:
         self._abandoned.append(handle.thread)
       handle.thread = None
       obs_metrics.counter("loop/worker_hangs").inc()
+      # Abandonment is a teardown path: export what the hung worker's
+      # window recorded before its events age out of the ring (no-op
+      # unless the exporter is armed; flush never raises).
+      graftrace.flush()
       reason = (f"heartbeat stalled > {self._heartbeat_timeout_s}s "
                 f"(generation {handle.generation} abandoned)")
     else:
@@ -372,6 +377,7 @@ class Supervisor:
     for thread in abandoned:
       if thread.is_alive():
         thread.join(timeout=max(deadline - time.monotonic(), 0.1))
+    graftrace.flush()
 
   def __enter__(self) -> "Supervisor":
     return self

@@ -73,6 +73,7 @@ import tempfile
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.utils import config
 
 __all__ = ["FORGE_SCHEMA", "plan_from_config", "run_forge", "verify_plan",
@@ -378,6 +379,14 @@ def _worker_env(device_count: Optional[int]) -> Dict[str, str]:
     env["XLA_FLAGS"] = (
         f"{flags} --xla_force_host_platform_device_count="
         f"{int(device_count)}").strip()
+  # Cross-process tracing: when the parent armed graftrace, workers
+  # export their own trace/metrics shards into the same directory
+  # (`graftrace.init_from_env` in `_worker_main`), so `graftscope
+  # timeline` merges the farm's compile windows with everything else.
+  trace_dir = graftrace.export_dir()
+  if trace_dir:
+    env["GRAFTRACE_DIR"] = trace_dir
+    env.setdefault("GRAFTRACE_ROLE", "forge-worker")
   return env
 
 
@@ -809,12 +818,14 @@ def _worker_main(spec_path: str, result_path: str) -> int:
     from tensor2robot_tpu.utils import backend
 
     backend.pin_cpu()
+  graftrace.init_from_env()  # arm shard export when the parent did
   config.clear_config()
   config.parse_config_files_and_bindings(list(spec["config_files"]),
                                          list(spec["bindings"]))
   results = [_forge_target(spec, target) for target in spec["targets"]]
   with open(result_path, "w") as f:
     json.dump(results, f)
+  graftrace.flush()
   return 0 if all(r["status"] == "ok" for r in results) else 1
 
 

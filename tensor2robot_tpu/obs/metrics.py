@@ -117,11 +117,21 @@ class Histogram:
     self._total = 0.0
     self._min = float("inf")
     self._max = float("-inf")
+    # Exemplar: the label (a graftrace trace_id) of the WORST sample
+    # seen since the last `clear_exemplar()` — the link from a p99
+    # regression in runs.jsonl back to its timeline entry. Kept out of
+    # `snapshot()` (whose contract is numeric-only values); read via
+    # `exemplar()` / `Registry.exemplars()`.
+    self._ex_value = float("-inf")
+    self._ex_label: Optional[str] = None
 
-  def record(self, value: float) -> None:
+  def record(self, value: float, exemplar: Optional[str] = None) -> None:
     value = float(value)
     with self._lock:
       self._record_locked(value)
+      if exemplar is not None and value >= self._ex_value:
+        self._ex_value = value
+        self._ex_label = str(exemplar)
 
   def record_many(self, values: Iterable[float]) -> None:
     """Records a batch of observations under ONE lock acquisition.
@@ -185,6 +195,20 @@ class Histogram:
               "max": self._max if self._count else float("nan"),
               "p50": p50, "p90": p90, "p99": p99}
 
+  def exemplar(self) -> Optional[Dict[str, object]]:
+    """The worst-sample exemplar since the last clear, or None."""
+    with self._lock:
+      if self._ex_label is None:
+        return None
+      return {"value": self._ex_value, "trace_id": self._ex_label}
+
+  def clear_exemplar(self) -> None:
+    """Starts a fresh exemplar window (called by the shard-snapshot
+    writer so each metrics shard carries its own window's worst)."""
+    with self._lock:
+      self._ex_value = float("-inf")
+      self._ex_label = None
+
 
 class Registry:
   """Get-or-create metric store; one per process (see `get_registry`)."""
@@ -236,6 +260,26 @@ class Registry:
       if (prefix is None or h.name.startswith(prefix)) and h.count:
         for stat, value in h.stats().items():
           out[f"hist/{h.name}/{stat}"] = value
+    return out
+
+  def exemplars(self, prefix: Optional[str] = None,
+                clear: bool = False) -> Dict[str, Dict[str, object]]:
+    """{name: {"value", "trace_id"}} for every histogram holding an
+    exemplar. Separate from `snapshot()` on purpose: snapshot values
+    are plain floats consumed by scalar writers; trace ids are not.
+    With `clear`, each returned exemplar's window is reset (the
+    per-snapshot-window semantics the shard writer wants)."""
+    with self._lock:
+      hists = list(self._histograms.values())
+    out: Dict[str, Dict[str, object]] = {}
+    for h in hists:
+      if prefix is not None and not h.name.startswith(prefix):
+        continue
+      ex = h.exemplar()
+      if ex is not None:
+        out[h.name] = ex
+        if clear:
+          h.clear_exemplar()
     return out
 
   def reset(self) -> None:

@@ -167,6 +167,18 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # host noise.
     "lint_parse_ms": ("up", 0.50),
     "lint_rules_ms": ("up", 0.50),
+    # graftrace gates (bench.py --fleet / PERFORMANCE.md "Reading a
+    # timeline"): serve_queue_wait_p99_ms is the p99 of the queue_wait
+    # stage in the traced fleet arm — growth means admission is
+    # outpacing dispatch (wall-clock on the 1-core host, loose band).
+    # trace_overhead_ratio is the PAIRED traced-vs-untraced goodput
+    # cost (1 - qps_traced/qps_untraced, back-to-back arms =>
+    # load-invariant; clamped at 0): the ISSUE 18 acceptance says <= 3%
+    # on the CPU smoke, so any 0 -> above-noise growth flags (absolute
+    # floor 0.05 via the rel=inf rule on a 0 baseline, then the 50%
+    # band on a nonzero one).
+    "serve_queue_wait_p99_ms": ("up", 0.50),
+    "trace_overhead_ratio": ("up", 0.50),
 }
 
 
@@ -460,6 +472,14 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["lint_parse_ms"] = float(bench["lint_parse_ms"])
   if bench.get("lint_rules_ms") is not None:
     out["lint_rules_ms"] = float(bench["lint_rules_ms"])
+  # graftrace telemetry (bench.py --fleet): traced-arm queue-wait p99
+  # and the paired tracing-overhead ratio, diff-gated like every other
+  # bench family.
+  if bench.get("serve_queue_wait_p99_ms") is not None:
+    out["serve_queue_wait_p99_ms"] = float(
+        bench["serve_queue_wait_p99_ms"])
+  if bench.get("trace_overhead_ratio") is not None:
+    out["trace_overhead_ratio"] = float(bench["trace_overhead_ratio"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)

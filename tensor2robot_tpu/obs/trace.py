@@ -26,7 +26,22 @@ import time
 from typing import Any, Dict, List, Optional
 
 __all__ = ["Tracer", "Span", "get_tracer", "enable", "disable", "span",
-           "traced", "instant", "add_complete", "save", "clear"]
+           "traced", "instant", "add_complete", "save", "clear",
+           "set_context_provider"]
+
+# Optional trace-context hook (obs.graftrace installs it): a zero-arg
+# callable returning the active request/causality ids as an args dict
+# (or None). Every recorded event gets those ids merged into its args —
+# explicit per-event args win on key collision — which is how the whole
+# existing span surface becomes causally linkable without changing any
+# call site. Module-level (not per-Tracer): the context is a property
+# of the running thread, not of the buffer it lands in.
+_CONTEXT_PROVIDER = None
+
+
+def set_context_provider(provider) -> None:
+  global _CONTEXT_PROVIDER
+  _CONTEXT_PROVIDER = provider
 
 # Chrome trace events use microsecond timestamps; perf_counter_ns is the
 # monotonic source (wall clocks can step backwards mid-span).
@@ -66,20 +81,62 @@ class Span:
 _NULL_SPAN = Span(None, "", "", None)
 
 
-class Tracer:
-  """Bounded in-memory event buffer with Chrome-trace JSON export."""
+def _event_size(event: Dict[str, Any]) -> int:
+  """Cheap per-event byte estimate for the ring's byte bound: fixed
+  framing + name/cat + per-arg framing + string payload lengths.
+  Deliberately NOT json.dumps or str(args) (either would dominate the
+  cost of every append — str(args) alone was ~40% of the traced-arm
+  fleet-bench overhead); non-string values count a flat 8, so the
+  estimate only needs to be proportional, the bound is approximate."""
+  size = 96 + len(event.get("name", "")) + len(event.get("cat", ""))
+  args = event.get("args")
+  if args:
+    size += 16 * len(args)
+    for key, value in args.items():
+      size += len(key) + (len(value) if type(value) is str else 8)
+  return size
 
-  def __init__(self, max_events: int = 200_000):
-    self._events: "collections.deque" = collections.deque(maxlen=max_events)
+
+class Tracer:
+  """Bounded in-memory event buffer with Chrome-trace JSON export.
+
+  Bounded BOTH by event count and by estimated bytes (`max_bytes`):
+  a count-only ring lets a few arg-heavy spans (rung traces, fat
+  request args) hold megabytes hostage in an always-on worker. Oldest
+  events are dropped first; `dropped_events` counts them.
+  """
+
+  def __init__(self, max_events: int = 200_000,
+               max_bytes: int = 64 << 20):
+    self._events: "collections.deque" = collections.deque()
+    self._sizes: "collections.deque" = collections.deque()
+    self._bytes = 0
+    self._max_events = max_events
+    self._max_bytes = max_bytes
+    self._dropped = 0
     self._lock = threading.Lock()
     self._thread_names: Dict[int, str] = {}
     self._enabled = False
+    # Cached: one getpid() syscall per EVENT is measurable on the
+    # serving hot path. Refreshed after fork (register_at_fork below).
+    self._pid = os.getpid()
+
+  def _refresh_pid(self) -> None:
+    self._pid = os.getpid()
 
   # -- lifecycle ------------------------------------------------------------
 
   @property
   def enabled(self) -> bool:
     return self._enabled
+
+  @property
+  def dropped_events(self) -> int:
+    return self._dropped
+
+  @property
+  def buffered_bytes(self) -> int:
+    return self._bytes
 
   def enable(self) -> None:
     self._enabled = True
@@ -90,6 +147,9 @@ class Tracer:
   def clear(self) -> None:
     with self._lock:
       self._events.clear()
+      self._sizes.clear()
+      self._bytes = 0
+      self._dropped = 0
       self._thread_names.clear()
 
   # -- recording ------------------------------------------------------------
@@ -122,7 +182,7 @@ class Tracer:
     now = time.perf_counter_ns()
     self._append({"name": name, "cat": cat, "ph": "i",
                   "ts": now / _NS_PER_US, "s": "t",
-                  "pid": os.getpid(), "tid": threading.get_ident(),
+                  "pid": self._pid, "tid": threading.get_ident(),
                   **({"args": args} if args else {})})
 
   def add_complete(self, name: str, start_ns: int, dur_ns: int,
@@ -139,15 +199,33 @@ class Tracer:
     self._append({"name": name, "cat": cat, "ph": "X",
                   "ts": start_ns / _NS_PER_US,
                   "dur": max(dur_ns, 0) / _NS_PER_US,
-                  "pid": os.getpid(), "tid": threading.get_ident(),
+                  "pid": self._pid, "tid": threading.get_ident(),
                   **({"args": args} if args else {})})
 
   def _append(self, event: Dict[str, Any]) -> None:
+    provider = _CONTEXT_PROVIDER
+    if provider is not None:
+      try:
+        ctx_args = provider()
+      except Exception:  # noqa: BLE001 - a hook must not break recording
+        ctx_args = None
+      if ctx_args:
+        merged = dict(ctx_args)
+        merged.update(event.get("args") or {})
+        event["args"] = merged
+    size = _event_size(event)
     tid = event["tid"]
-    if tid not in self._thread_names:
-      with self._lock:
+    with self._lock:
+      if tid not in self._thread_names:
         self._thread_names[tid] = threading.current_thread().name
-    self._events.append(event)  # deque.append is atomic under the GIL
+      self._events.append(event)
+      self._sizes.append(size)
+      self._bytes += size
+      while self._events and (len(self._events) > self._max_events
+                              or self._bytes > self._max_bytes):
+        self._events.popleft()
+        self._bytes -= self._sizes.popleft()
+        self._dropped += 1
 
   # -- export ---------------------------------------------------------------
 
@@ -178,6 +256,10 @@ class Tracer:
 
 
 _GLOBAL = Tracer()
+# The cached pid must not survive a fork (events would carry the
+# parent's pid and the aggregator would fold two processes into one
+# timeline row).
+os.register_at_fork(after_in_child=lambda: _GLOBAL._refresh_pid())
 
 
 def get_tracer() -> Tracer:

@@ -47,6 +47,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional
 
 import numpy as np
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import sentinel as obs_sentinel
 from tensor2robot_tpu.obs import trace as obs_trace
@@ -68,13 +69,22 @@ class ShutdownError(ShedError):
 
 
 class _Request:
-  """One in-flight predict: features, result slot, completion event."""
+  """One in-flight predict: features, result slot, completion event.
+
+  Carries its graftrace context (minted at admission) and the
+  perf-clock stamps (`enq_ns` at enqueue, `pop_ns` when `_gather` pops
+  it) the per-request stage decomposition is computed from — the
+  context rides the request object across the client->worker thread
+  boundary, which is how one trace follows one request through the
+  queue.
+  """
 
   __slots__ = ("features", "rows", "deadline", "enqueued_s", "event",
-               "result", "error")
+               "result", "error", "ctx", "enq_ns", "pop_ns")
 
   def __init__(self, features: Dict[str, np.ndarray], rows: int,
-               deadline: Optional[float], enqueued_s: float):
+               deadline: Optional[float], enqueued_s: float,
+               ctx: Optional[graftrace.TraceContext] = None):
     self.features = features
     self.rows = rows
     self.deadline = deadline  # absolute monotonic seconds, or None
@@ -82,6 +92,9 @@ class _Request:
     self.event = threading.Event()
     self.result: Optional[Dict[str, np.ndarray]] = None
     self.error: Optional[BaseException] = None
+    self.ctx = ctx
+    self.enq_ns = time.perf_counter_ns()
+    self.pop_ns = 0
 
   def complete(self, result=None, error=None) -> None:
     self.result = result
@@ -208,6 +221,9 @@ class MicroBatcher:
     # traffic-derived bucket ladder (`engine.traffic_bucket_ladder` /
     # `engine.observed_request_rows`).
     obs_metrics.histogram("serve/request_rows").record(float(rows))
+    # Trace admission: a child of the router's context when the fleet
+    # minted one upstream (thread-local), a fresh root otherwise.
+    ctx = graftrace.request_context()
     if rows > self._max_batch_size:
       # Already a full batch (e.g. a CEM candidate sweep): coalescing
       # cannot help, dispatch directly — but never after close(): the
@@ -217,13 +233,21 @@ class MicroBatcher:
           obs_metrics.counter("serve/batcher/shed_shutdown").inc()
           raise ShutdownError("batcher is closed")
       obs_metrics.counter("serve/batcher/bypass").inc()
-      with obs_trace.span("serve/batcher/bypass", cat="serve"):
-        result = dict(self._predict_backend(features))
-      self._observe(start)
+      t0_ns = time.perf_counter_ns()
+      with graftrace.activate(ctx):
+        with obs_trace.span("serve/batcher/bypass", cat="serve"):
+          result = dict(self._predict_backend(features))
+      # The whole bypass window IS its dispatch stage — recorded so the
+      # stage sums still reconcile with serve/request_ms when traffic
+      # mixes bypass and coalesced requests.
+      graftrace.record_stage(
+          "dispatch", (time.perf_counter_ns() - t0_ns) / 1e6, ctx=ctx,
+          start_ns=t0_ns)
+      self._observe(start, ctx)
       return result
     request = _Request(features, rows,
                        None if not deadline_ms
-                       else start + deadline_ms / 1e3, start)
+                       else start + deadline_ms / 1e3, start, ctx=ctx)
     with self._have_work:
       if self._closed:
         obs_metrics.counter("serve/batcher/shed_shutdown").inc()
@@ -247,12 +271,23 @@ class MicroBatcher:
     request.event.wait()
     if request.error is not None:
       raise request.error
-    self._observe(start)
+    if obs_trace.get_tracer().enabled:
+      # The client-visible request window: the parent span every stage
+      # event nests under in the merged timeline.
+      end_ns = time.perf_counter_ns()
+      obs_trace.add_complete("serve/request", request.enq_ns,
+                             end_ns - request.enq_ns, cat="serve",
+                             args={**ctx.args(), "rows": rows})
+    self._observe(start, ctx)
     return request.result
 
-  def _observe(self, start: float) -> None:
+  def _observe(self, start: float,
+               ctx: Optional[graftrace.TraceContext] = None) -> None:
+    # The exemplar ties the window's WORST request to its trace id —
+    # the link from a p99 regression in runs.jsonl to the timeline.
     obs_metrics.histogram("serve/request_ms").record(
-        (time.monotonic() - start) * 1e3)
+        (time.monotonic() - start) * 1e3,
+        exemplar=ctx.trace_id if ctx is not None else None)
 
   # -- worker side ----------------------------------------------------------
 
@@ -300,6 +335,9 @@ class MicroBatcher:
         batch.append(request)
         rows += request.rows
       self._pending_rows -= rows
+      pop_ns = time.perf_counter_ns()
+      for request in batch:
+        request.pop_ns = pop_ns  # queue_wait ends at flush-time pop
       return batch
 
   def _serve_batch(self, batch: List[_Request]) -> None:
@@ -321,12 +359,24 @@ class MicroBatcher:
     if not live:
       return
     self._phase[0] = "dispatch"
+    # The dispatch runs under a fresh batch-level context whose span
+    # `links` name every coalesced request — the aggregator draws one
+    # flow arrow per request into the shared dispatch, and everything
+    # the engine records inside (pad/device sub-stages, engine spans)
+    # auto-attaches the batch context via the thread-local.
+    batch_ctx = graftrace.mint()
     try:
-      with obs_trace.span("serve/batcher/dispatch", cat="serve",
-                          requests=len(live),
-                          rows=sum(r.rows for r in live)):
-        outputs = self._predict_backend(_concat_requests(live))
+      dispatch_ns = time.perf_counter_ns()
+      with graftrace.activate(batch_ctx):
+        with obs_trace.span("serve/batcher/dispatch", cat="serve",
+                            requests=len(live),
+                            rows=sum(r.rows for r in live),
+                            links=[r.ctx.span_id for r in live
+                                   if r.ctx is not None]):
+          outputs = self._predict_backend(_concat_requests(live))
+      split_ns = time.perf_counter_ns()
       splits = _split_outputs(outputs, live)
+      end_ns = time.perf_counter_ns()
     finally:
       self._phase[0] = "gather"
     # Record batch telemetry BEFORE completing: a caller woken by
@@ -335,11 +385,47 @@ class MicroBatcher:
     # counters incremented after the wake would race out of the
     # snapshot. A telemetry failure here cannot orphan a request: the
     # `_run` handler fails every not-yet-completed request in the batch.
+    self._record_stages(live, dispatch_ns, split_ns, end_ns)
     obs_metrics.counter("serve/batcher/batches").inc()
     obs_metrics.histogram("serve/batch_rows").record(
         float(sum(r.rows for r in live)))
     for request, split in zip(live, splits):
       request.complete(result=split)
+
+  def _record_stages(self, live: List[_Request], dispatch_ns: int,
+                     split_ns: int, end_ns: int) -> None:
+    """Per-request latency decomposition (graftrace stage contract):
+    queue_wait (enqueue -> gather pop) + batch_form (pop -> dispatch
+    start) + dispatch (backend call wall) + split (output split +
+    completion bookkeeping) sums to the client's serve/request_ms
+    window minus its wakeup latency. Histograms are batch-amortized;
+    per-request trace events only when the tracer is on."""
+    dispatch_ms = (split_ns - dispatch_ns) / 1e6
+    split_ms = (end_ns - split_ns) / 1e6
+    graftrace.record_stage_many(
+        "queue_wait", [(r.pop_ns - r.enq_ns) / 1e6 for r in live])
+    graftrace.record_stage_many(
+        "batch_form", [(dispatch_ns - r.pop_ns) / 1e6 for r in live])
+    graftrace.record_stage_many("dispatch", [dispatch_ms] * len(live))
+    graftrace.record_stage_many("split", [split_ms] * len(live))
+    if obs_trace.get_tracer().enabled:
+      for r in live:
+        obs_trace.add_complete(graftrace.STAGE_PREFIX + "queue_wait",
+                               r.enq_ns, r.pop_ns - r.enq_ns,
+                               cat="stage",
+                               args=r.ctx.args() if r.ctx else None)
+        obs_trace.add_complete(graftrace.STAGE_PREFIX + "batch_form",
+                               r.pop_ns, dispatch_ns - r.pop_ns,
+                               cat="stage",
+                               args=r.ctx.args() if r.ctx else None)
+        obs_trace.add_complete(graftrace.STAGE_PREFIX + "dispatch",
+                               dispatch_ns, split_ns - dispatch_ns,
+                               cat="stage",
+                               args=r.ctx.args() if r.ctx else None)
+        obs_trace.add_complete(graftrace.STAGE_PREFIX + "split",
+                               split_ns, end_ns - split_ns,
+                               cat="stage",
+                               args=r.ctx.args() if r.ctx else None)
 
   def _run(self) -> None:
     try:
@@ -373,6 +459,10 @@ class MicroBatcher:
       for request in pending:
         obs_metrics.counter("serve/batcher/shed_shutdown").inc()
         request.complete(error=ShutdownError("batcher worker exited"))
+      # Worker teardown drains buffered spans to the shard exporter
+      # (no-op unless graftrace is configured): a worker that dies
+      # outside close() must not silently drop its trace window.
+      graftrace.flush()
 
   # -- lifecycle ------------------------------------------------------------
 
@@ -396,6 +486,7 @@ class MicroBatcher:
     while True:
       self._worker.join(timeout=1.0)
       if not self._worker.is_alive():
+        graftrace.flush()  # teardown drain (no-op unless configured)
         return
       if self._phase[0] == "dispatch":
         deadline = None  # device op in flight: wait it out, full stop

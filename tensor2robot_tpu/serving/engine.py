@@ -61,6 +61,7 @@ from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
 
 import numpy as np
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
@@ -539,13 +540,22 @@ class BucketedEngine:
     if bucket != rows:
       import jax
 
+      # `pad` is an informational sub-stage of the batcher's dispatch
+      # window (graftrace.INFO_STAGES) — reported in the breakdown but
+      # excluded from the reconciliation sum, which would otherwise
+      # double-count it inside `dispatch`.
+      pad_ns = time.perf_counter_ns()
       obs_metrics.counter("serve/engine/padded_rows").inc(bucket - rows)
       model_features = jax.tree_util.tree_map(
           lambda a: _pad_rows(np.asarray(a), bucket)
           if getattr(a, "ndim", 0) and np.asarray(a).shape[0] == rows
           else a, model_features)
+      graftrace.record_stage(
+          "pad", (time.perf_counter_ns() - pad_ns) / 1e6,
+          ctx=graftrace.current(), start_ns=pad_ns)
     state = bundle.get_state()
     compiled = self._compiled.get(bucket)
+    device_ns = time.perf_counter_ns()
     try:
       if compiled is not None:
         outputs = compiled(state, model_features)
@@ -569,6 +579,11 @@ class BucketedEngine:
       if v.ndim and v.shape[0] == bucket:
         v = v[:rows]
       out[k] = v
+    # `device` = executable call + host fetch (the real barrier): the
+    # other dispatch-internal sub-stage, same exclusion rule as `pad`.
+    graftrace.record_stage(
+        "device", (time.perf_counter_ns() - device_ns) / 1e6,
+        ctx=graftrace.current(), start_ns=device_ns)
     return out
 
   # -- predictor duck-type passthroughs -------------------------------------

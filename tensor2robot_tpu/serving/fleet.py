@@ -117,9 +117,11 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 import numpy as np
 
 from tensor2robot_tpu.obs import faultlab as faultlab_lib
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import sentinel as sentinel_lib
+from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.serving import batcher as batcher_lib
 from tensor2robot_tpu.serving import session as session_lib
 from tensor2robot_tpu.utils import config
@@ -667,6 +669,14 @@ class ServingFleet:
     chosen replica and its failover alternative failed.
     """
     obs_metrics.counter("serve/fleet/requests").inc()
+    # Router admission is where a request's trace context is born: the
+    # batcher below it mints a CHILD at its own admission, so the
+    # fleet-level span parents the queue/dispatch decomposition.
+    ctx = graftrace.request_context()
+    return self._predict_routed(features, deadline_ms, ctx)
+
+  def _predict_routed(self, features, deadline_ms, ctx
+                      ) -> Dict[str, np.ndarray]:
     first_error: Optional[BaseException] = None
     exclude = None
     for attempt in range(2):
@@ -692,10 +702,14 @@ class ServingFleet:
           raise faultlab_lib.InjectedDispatchError(
               f"faultlab: injected dispatch failure on replica "
               f"{replica.index}")
-        if deadline_ms is not None:
-          result = replica.front.predict(features, deadline_ms=deadline_ms)
-        else:
-          result = replica.front.predict(features)
+        with graftrace.activate(ctx), \
+            obs_trace.span("serve/fleet/request", cat="serve",
+                           replica=replica.index, attempt=attempt):
+          if deadline_ms is not None:
+            result = replica.front.predict(features,
+                                           deadline_ms=deadline_ms)
+          else:
+            result = replica.front.predict(features)
         ok = True
         return result
       except batcher_lib.DeadlineError:
@@ -821,8 +835,10 @@ class ServingFleet:
       self._load_requests += 1
       self._sample_load_locked(time.monotonic())
     ok = False
+    ctx = graftrace.request_context()
     try:
-      result = replica.session_front.step(entry.inner_sid, features)
+      with graftrace.activate(ctx):
+        result = replica.session_front.step(entry.inner_sid, features)
       ok = True
       return result
     except session_lib.SessionError as e:
@@ -1102,6 +1118,7 @@ class ServingFleet:
           close()
         except Exception:  # noqa: BLE001 - teardown must not mask errors
           pass
+    graftrace.flush()
 
   def __enter__(self) -> "ServingFleet":
     return self

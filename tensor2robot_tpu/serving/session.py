@@ -81,6 +81,7 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.serving import engine as engine_lib
@@ -827,7 +828,8 @@ class SessionBatcher:
 
   def step(self, session_id: int, features: Mapping[str, Any]
            ) -> Dict[str, np.ndarray]:
-    request = _TickRequest(session_id, dict(features))
+    request = _TickRequest(session_id, dict(features),
+                           ctx=graftrace.request_context())
     with self._have_work:
       if self._closed:
         raise self._shutdown_error("session batcher is closed")
@@ -878,6 +880,7 @@ class SessionBatcher:
           kept.append(request)  # affinity: serialize same-session ticks
           continue
         seen.add(request.session_id)
+        request.pop_ns = time.perf_counter_ns()
         batch.append(request)
       for request in reversed(kept):
         self._pending.appendleft(request)
@@ -887,8 +890,14 @@ class SessionBatcher:
     self._phase[0] = "dispatch"
     try:
       items = [(r.session_id, r.features) for r in batch]
+      dispatch_ns = time.perf_counter_ns()
+      batch_ctx = graftrace.mint()
       try:
-        results = self._engine.step_many(items)
+        with graftrace.activate(batch_ctx):
+          with obs_trace.span(
+              "serve/session/batch", cat="serve", ticks=len(batch),
+              links=[r.ctx.span_id for r in batch if r.ctx is not None]):
+            results = self._engine.step_many(items)
       except SessionError as e:
         # A lifecycle error names ONE session: fail that tick, retry
         # the rest once as a batch (they were validated together, but a
@@ -902,6 +911,23 @@ class SessionBatcher:
         if rest:
           self._serve_batch(rest)
         return
+      end_ns = time.perf_counter_ns()
+      graftrace.record_stage_many(
+          "queue_wait",
+          [(r.pop_ns - r.enq_ns) / 1e6 for r in batch if r.pop_ns])
+      graftrace.record_stage_many(
+          "dispatch", [(end_ns - dispatch_ns) / 1e6] * len(batch))
+      if obs_trace.get_tracer().enabled:
+        for r in batch:
+          if r.ctx is None:
+            continue
+          if r.pop_ns:
+            obs_trace.add_complete(
+                "serve/stage/queue_wait", r.enq_ns, r.pop_ns - r.enq_ns,
+                cat="serve", args=r.ctx.args())
+          obs_trace.add_complete(
+              "serve/stage/dispatch", dispatch_ns, end_ns - dispatch_ns,
+              cat="serve", args=r.ctx.args())
       for request, result in zip(batch, results):
         request.complete(result=result)
     finally:
@@ -930,6 +956,7 @@ class SessionBatcher:
       for request in pending:
         request.complete(
             error=self._shutdown_error("session batcher worker exited"))
+      graftrace.flush()
 
   # -- lifecycle ------------------------------------------------------------
 
@@ -984,15 +1011,19 @@ class _TickRequest:
   """One queued session tick: features, result slot, completion event."""
 
   __slots__ = ("session_id", "features", "enqueued_s", "event", "result",
-               "error")
+               "error", "ctx", "enq_ns", "pop_ns")
 
-  def __init__(self, session_id: int, features: Dict[str, Any]):
+  def __init__(self, session_id: int, features: Dict[str, Any],
+               ctx=None):
     self.session_id = session_id
     self.features = features
     self.enqueued_s = time.monotonic()
     self.event = threading.Event()
     self.result: Optional[Dict[str, np.ndarray]] = None
     self.error: Optional[BaseException] = None
+    self.ctx = ctx
+    self.enq_ns = time.perf_counter_ns()
+    self.pop_ns = 0
 
   def complete(self, result=None, error=None) -> None:
     self.result = result

@@ -763,6 +763,7 @@ def train_eval_model(
     return (mesh_lib.place_batch(
         mesh, batch, batch_spec=loop_spec if k > 1 else batch_spec), k)
 
+  tracer_preenabled = trace_lib.get_tracer().enabled
   try:
     if step_stats.enabled:
       trace_lib.enable()
@@ -1020,7 +1021,12 @@ def train_eval_model(
     # StepStatsHook.end's save on the normal path).
     if flight_recorder is not None:
       flight_recorder.close()  # disarm watchdog + restore SIGTERM
-    if step_stats.enabled:
+    if step_stats.enabled and not tracer_preenabled:
+      # Only disarm a tracer THIS run armed: when a longer-lived owner
+      # enabled it before entry (the graftloop's graftrace exporter
+      # traces across rounds — its publish/first-action events come
+      # AFTER this return), disabling here would silently end the
+      # owner's trace at round 1.
       trace_lib.disable()
     if prefetcher is not None:
       prefetcher.close()  # also closes its _host_items producer

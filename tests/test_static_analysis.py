@@ -27,7 +27,8 @@ from tensor2robot_tpu.analysis import (cache_check, config_check,
                                        forge_check, lint, loop_check,
                                        native_check, pp_check, retry_check,
                                        session_check, spec_check,
-                                       thread_check, tracer_check)
+                                       thread_check, trace_check,
+                                       tracer_check)
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import mocks  # registers MockT2RModel  # noqa: F401
 
@@ -651,6 +652,7 @@ def _per_checker_pipeline(paths):
     findings.extend(retry_check.check_python_file(path))
     findings.extend(thread_check.check_python_file(path))
     findings.extend(loop_check.check_python_file(path))
+    findings.extend(trace_check.check_python_file(path))
     if (os.path.basename(path) == "__init__.py"
         and os.path.basename(os.path.dirname(path)) == "native"):
       findings.extend(native_check.check_native_bindings(
