@@ -2125,6 +2125,23 @@ FLEET_TRACE_PAIRS = 3  # odd: the median is a real middle pair, not the
 # (paired back-to-back arms). vs_baseline ~= 1.0 reads as "no fleet
 # serving regression vs the recorded baseline", nothing more.
 FLEET_CPU_ANCHOR = 900.0
+# graftwatch (ISSUE 19): the serving-latency SLO the bench fleets carry.
+# Deliberately generous (the smoke's queue tails under saturation are
+# hundreds of ms on this 1-core host) — a breach of a ONE-SECOND SLO in
+# the smoke is a real regression, not wall-clock noise, so the
+# slo_budget_burn gate stays quiet on healthy runs and loud on real ones.
+FLEET_SLO_MS = 1000.0
+# Burn windows shrunk to the smoke's timescale (the production defaults
+# are 60 s/300 s; a bench arm lasts ~1 s, which would never fill them).
+FLEET_SLO_FAST_WINDOW_S = 1.0
+FLEET_SLO_SLOW_WINDOW_S = 4.0
+# The smoke's open-loop Poisson rate deliberately oversubscribes the
+# duo fleet — ~30% of arrivals shed; shedding here is the backpressure
+# mechanism UNDER TEST, not an outage. Budget the shed SLO to that
+# intent (vs the 2% production default) so the headline reads healthy
+# on a normal run and `slo_budget_burn` gates on CHANGES in shed
+# pressure, not on the smoke's designed-in saturation.
+FLEET_SLO_SHED_BUDGET = 0.5
 
 
 class _HotSwapPredictor:
@@ -2280,11 +2297,12 @@ def fleet_main() -> None:
   single = serving.ServingFleet(
       replica_factory=lambda i, d: make_replica(i, groups[0]),
       num_replicas=1, max_batch_size=FLEET_MAX_BATCH, max_delay_ms=2.0,
-      max_queue=32, warmup=True)
+      max_queue=32, warmup=True, latency_slo_ms=FLEET_SLO_MS)
   duo = serving.ServingFleet(
       replica_factory=lambda i, d: make_replica(i, groups[i]),
       num_replicas=FLEET_REPLICAS, max_batch_size=FLEET_MAX_BATCH,
-      max_delay_ms=2.0, max_queue=32, warmup=True)
+      max_delay_ms=2.0, max_queue=32, warmup=True,
+      latency_slo_ms=FLEET_SLO_MS)
   try:
     request = dict(specs_lib.make_random_numpy(
         single.replica(0).get_feature_specification(), batch_size=1,
@@ -2452,6 +2470,59 @@ def fleet_main() -> None:
                                                          derived),
     }
 
+    # graftwatch (ISSUE 19): one dedicated SLO-evaluation window over
+    # the fleet arm — the stock serving objectives run through the
+    # multi-window burn-rate engine while open-loop load flows (the
+    # engine samples the live registry every 100 ms, exactly how the
+    # serving loop consumes it), then a point-in-time judgment of the
+    # window's final snapshot. `slo_budget_burn` (worst fast-window
+    # burn) and `fleet_utilization` (ledger busy / wall x devices) are
+    # the diff-gated scalars (up-bad / down-bad in
+    # obs.runlog.DEFAULT_THRESHOLDS).
+    from tensor2robot_tpu.obs import slo as slo_lib
+    slo_specs = slo_lib.default_serving_slos(
+        shed_budget=FLEET_SLO_SHED_BUDGET,
+        fast_window_s=FLEET_SLO_FAST_WINDOW_S,
+        slow_window_s=FLEET_SLO_SLOW_WINDOW_S)
+    slo_engine = slo_lib.SloEngine(slo_specs)
+    with obs_metrics.isolated() as slo_registry:
+      slo_window: list = []
+
+      def slo_load() -> None:
+        slo_window.append(loadgen.run_trace_load(
+            predict=duo.predict, make_request=make_request,
+            num_arrivals=FLEET_ARRIVALS, rate_hz=FLEET_RATE_HZ,
+            profile="poisson", seed=211,
+            max_client_threads=FLEET_CLIENTS))
+
+      slo_loader = threading.Thread(target=slo_load,
+                                    name="fleet-slo-load")
+      slo_loader.start()
+      while slo_loader.is_alive():
+        slo_engine.observe(slo_registry.snapshot(prefix="serve/"),
+                           now=time.monotonic())
+        time.sleep(0.1)
+      slo_loader.join()
+      slo_engine.observe(slo_registry.snapshot(prefix="serve/"),
+                         now=time.monotonic())
+      slo_point = slo_lib.evaluate_snapshot(
+          slo_specs, slo_registry.snapshot(prefix="serve/"))
+    slo_block = {
+        "specs": [spec.describe() for spec in slo_specs],
+        "state": slo_engine.state(),
+        "point": slo_point,
+        "window_requests": slo_window[0]["arrivals"],
+        "latency_slo_ms": FLEET_SLO_MS,
+        "healthy": slo_engine.healthy()
+                   and all(s["ok"] for s in slo_point.values()),
+    }
+    util_block = duo.utilization_summary()
+    print(f"bench-fleet: slo window {slo_window[0]['arrivals']} "
+          f"requests, worst burn {slo_engine.worst_burn():.2f}x, "
+          f"fleet utilization {util_block['utilization']:.3f} "
+          f"(busy {util_block['device_seconds_busy']:.2f}s over "
+          f"{util_block['devices']} device(s))", file=sys.stderr)
+
     compiles_after_all = [c for c in single.compile_counts()
                           + duo.compile_counts() if c is not None]
     headline = {
@@ -2490,6 +2561,13 @@ def fleet_main() -> None:
         "exec_fallbacks": exec_fallbacks,
         "rollout": rollout_block,
         "ladder_ab": ladder_ab,
+        # ISSUE 19 graftwatch: SLO + device-time economics. The two
+        # scalars are the diff-gated rows; the blocks carry the full
+        # burn/ledger state for `graftscope history`/`watch` readers.
+        "slo": slo_block,
+        "slo_budget_burn": round(slo_engine.worst_burn(), 4),
+        "utilization": util_block,
+        "fleet_utilization": round(util_block["utilization"], 4),
         "device_kind": device.device_kind,
         "platform": device.platform,
         "host_load": _host_load_block(),
@@ -3129,6 +3207,13 @@ def loop_main() -> None:
         "all_recovered": all_recovered,
         "recovered": recovered,
         "goodput_floor": LOOP_GOODPUT_FLOOR,
+        # ISSUE 19 graftwatch: the chaos arm's continuous-SLO state
+        # (loop staleness + publish-to-serve objectives, evaluated
+        # every publisher tick) and the fleet's device-time ledger —
+        # the storm must burn no loop budget and the ledger must still
+        # reconcile after evictions/readmits.
+        "slo": chaos.get("slo"),
+        "utilization": chaos.get("utilization"),
         "seed": LOOP_SEED,
         "graftrace": trace_block,
         "clean": clean,

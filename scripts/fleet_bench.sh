@@ -16,7 +16,17 @@
 #     fleet_rollout_shed      — failed/shed requests inside the rollout
 #                               window (up-bad at 0 tolerance: the
 #                               "no request fails during a rollout"
-#                               pin — ANY growth from 0 gates).
+#                               pin — ANY growth from 0 gates),
+#     slo_budget_burn         — worst fast-window burn rate of the
+#                               serving SLOs over the dedicated SLO
+#                               window (up-bad; a 1 s latency SLO on
+#                               this smoke should never burn, so any
+#                               sustained burn is a real regression),
+#     fleet_utilization       — usage-ledger busy / (wall x devices)
+#                               of the fleet arm (down-bad, opened wide
+#                               below: absolute utilization tracks host
+#                               load on the 1-core VM; the row exists
+#                               so collapse-to-zero still gates).
 #
 # A regression in either exits non-zero exactly like a training one.
 #
@@ -63,4 +73,5 @@ JAX_PLATFORMS=cpu python bench.py --fleet
 gate_family qtopt_fleet \
     --threshold examples_per_sec=10.0 --threshold compile_time_s=10.0 \
     --threshold flops_per_step=10.0 --threshold bytes_per_step=10.0 \
-    --threshold jaxpr_eqns=10.0 --threshold warmup_ms=10.0
+    --threshold jaxpr_eqns=10.0 --threshold warmup_ms=10.0 \
+    --threshold fleet_utilization=3.0 --threshold slo_budget_burn=5.0

@@ -65,14 +65,15 @@ SEVERITIES = ("error", "warning")
 # applied (tie-order inside one (path, line, rule) sort key depends on
 # it, so it is part of the byte-parity contract, not a style choice).
 CHECK_ORDER = ("tracer", "spec", "cache", "pp", "session", "fleet",
-               "forge", "retry", "thread", "loop", "native", "tracectx")
+               "forge", "retry", "thread", "loop", "native", "tracectx",
+               "slo")
 
 # Catalog presentation order — the family order `--list-rules` has
 # always printed (config first, spec last) with the jaxpr-audit family
 # appended after it.
 CATALOG_ORDER = ("config", "tracer", "tracectx", "cache", "pp",
                  "session", "retry", "fleet", "forge", "loop", "thread",
-                 "native", "spec", "audit")
+                 "native", "slo", "spec", "audit")
 
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
 
@@ -174,8 +175,9 @@ def load_builtin_rules() -> None:
                                          jaxpr_audit, loop_check,
                                          native_check, pp_check,
                                          retry_check, session_check,
-                                         spec_check, thread_check,
-                                         trace_check, tracer_check)
+                                         slo_check, spec_check,
+                                         thread_check, trace_check,
+                                         tracer_check)
   _BUILTINS_LOADED = True
 
 

@@ -56,6 +56,7 @@ from tensor2robot_tpu.loop import supervisor as supervisor_lib
 from tensor2robot_tpu.obs import graftrace
 from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
+from tensor2robot_tpu.obs import slo as slo_lib
 from tensor2robot_tpu.obs import trace as obs_trace
 from tensor2robot_tpu.utils import config
 from tensor2robot_tpu.utils import retry as retry_lib
@@ -177,6 +178,15 @@ class GraftLoop:
     self._first_action_s: Dict[int, float] = {}
     self._wall_start = None
     self._wall_s = 0.0
+    # graftwatch: continuous SLO evaluation over the loop's own
+    # telemetry (staleness bound, publish-to-serve latency), fanned to
+    # the same incident sink as sentinel/supervisor incidents. Built
+    # here (backend-free) so summary() can read it even if run() died
+    # before the fleet came up.
+    self._slo_engine = slo_lib.SloEngine(
+        slo_lib.default_loop_slos(
+            staleness_bound=float(self._max_staleness)),
+        sinks=[incident_sink])
 
   # -- incident fan-out -----------------------------------------------------
 
@@ -330,10 +340,19 @@ class GraftLoop:
         self.publisher.drain_pending(timeout_s=0.2)
       except Exception:  # noqa: BLE001 - a failed publish must not kill
         logging.exception("graftloop: publish failed")  # the worker
+      now = time.monotonic()
+      # Continuous SLO evaluation rides the publisher tick (~5 Hz): one
+      # registry snapshot of the loop's telemetry per drain, pure
+      # arithmetic per spec. A burning objective emits through the
+      # incident sink; the engine never raises.
+      try:
+        self._slo_engine.observe(obs_metrics.snapshot(prefix="loop/"),
+                                 now=now)
+      except Exception:  # noqa: BLE001 - telemetry must not kill the loop
+        logging.exception("graftloop: SLO evaluation failed")
       # Periodic shard flush (no-op unless graftrace.configure armed
       # the exporter): an always-on loop exports its trace/metrics
       # windows continuously, not only at teardown.
-      now = time.monotonic()
       if now - last_flush >= 5.0:
         last_flush = now
         graftrace.flush()
@@ -512,6 +531,11 @@ class GraftLoop:
         "replay": self.sink.stats(),
         "learner_rounds": snap.get("counter/loop/learner_rounds", 0.0),
         "worker_states": self.supervisor.states(),
+        # graftwatch blocks: per-objective budget state and the fleet's
+        # device-time ledger (None when run() never built the fleet).
+        "slo": self._slo_engine.state(),
+        "utilization": (self.fleet.utilization_summary()
+                        if self.fleet is not None else None),
     }
 
 

@@ -35,7 +35,9 @@ import os
 from typing import Any, Dict, List, Optional, Sequence
 
 __all__ = ["discover_shards", "load_shard", "merge_timeline",
-           "write_timeline", "has_causal_chain"]
+           "write_timeline", "has_causal_chain",
+           "discover_metrics_shards", "load_metrics_shard",
+           "latest_metrics_shards", "sum_snapshots"]
 
 _NS_PER_US = 1000.0
 
@@ -64,6 +66,73 @@ def load_shard(path: str) -> Optional[Dict[str, Any]]:
   if not isinstance(payload.get("traceEvents"), list):
     return None
   return payload
+
+
+def discover_metrics_shards(root: str) -> List[str]:
+  """Every graftrace METRICS shard under `root`, recursively (the
+  snapshot-carrying twin `graftrace.flush` writes beside each trace
+  shard — the data plane of `graftscope watch`)."""
+  return sorted(glob.glob(os.path.join(root, "**", "metrics-*.json"),
+                          recursive=True))
+
+
+def load_metrics_shard(path: str) -> Optional[Dict[str, Any]]:
+  """One parsed metrics shard, or None for anything that is not a
+  well-formed graftrace v1 metrics shard (tolerant-reader contract —
+  a half-written or foreign file is skipped, never raised; the watch
+  over a crashed run is exactly when this matters). The paired clock
+  stamp is optional: shards written before the stamp landed still
+  render, they just report staleness as unknown."""
+  try:
+    with open(path, "r") as f:
+      payload = json.load(f)
+  except (OSError, ValueError):
+    return None
+  if not isinstance(payload, dict) or payload.get("graftrace") != "v1":
+    return None
+  if not isinstance(payload.get("snapshot"), dict):
+    return None
+  return payload
+
+
+def latest_metrics_shards(root: str) -> Dict[str, Any]:
+  """{"shards": [payload...], "skipped": n}: the NEWEST generation per
+  worker pid (earlier generations are superseded windows of the same
+  registry — summing them would double-count every cumulative
+  counter), with unreadable files counted, not hidden."""
+  newest: Dict[Any, Dict[str, Any]] = {}
+  skipped = 0
+  for path in discover_metrics_shards(root):
+    shard = load_metrics_shard(path)
+    if shard is None:
+      skipped += 1
+      continue
+    pid = shard.get("pid")
+    held = newest.get(pid)
+    if held is None or shard.get("gen", 0) >= held.get("gen", 0):
+      newest[pid] = shard
+  shards = sorted(newest.values(),
+                  key=lambda s: (str(s.get("role")), s.get("pid") or 0))
+  return {"shards": shards, "skipped": skipped}
+
+
+def sum_snapshots(shards: Sequence[Dict[str, Any]]) -> Dict[str, float]:
+  """One fleet-wide flat snapshot from per-worker shards: counters SUM
+  across workers (cumulative event counts compose), gauges and
+  histogram stats take the per-key MAX (point-in-time levels don't sum;
+  max is the conservative read for every shipped gauge/stat — worst
+  staleness, worst p99, highest watermark)."""
+  out: Dict[str, float] = {}
+  for shard in shards:
+    for key, value in shard.get("snapshot", {}).items():
+      if not isinstance(value, (int, float)):
+        continue
+      value = float(value)
+      if key.startswith("counter/"):
+        out[key] = out.get(key, 0.0) + value
+      else:
+        out[key] = max(out.get(key, value), value)
+  return out
 
 
 def _event_args(event: Dict[str, Any]) -> Dict[str, Any]:

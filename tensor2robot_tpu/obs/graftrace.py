@@ -332,8 +332,14 @@ def flush() -> Optional[str]:
       json.dump(payload, f)
     os.replace(tmp, path)
     registry = obs_metrics.get_registry()
+    # The metrics shard carries the SAME paired clock stamp as the
+    # trace shard (one back-to-back read, above): `graftscope watch`
+    # computes metric staleness from it (now - epoch_ns) and skips a
+    # dead worker's final shard once it ages past the staleness bound.
     metrics_payload = {"graftrace": "v1", "role": role, "pid": pid,
                        "gen": gen, "epoch_ns": epoch_ns,
+                       "clock": {"perf_ns": perf_ns,
+                                 "epoch_ns": epoch_ns},
                        "snapshot": registry.snapshot(),
                        "exemplars": registry.exemplars(clear=True)}
     mpath = os.path.join(directory, f"metrics-{pid}-{gen:06d}.json")

@@ -262,6 +262,19 @@ class Registry:
           out[f"hist/{h.name}/{stat}"] = value
     return out
 
+  def stamped_snapshot(self, prefix: Optional[str] = None
+                       ) -> Dict[str, object]:
+    """`snapshot()` plus the paired monotonic/epoch clock stamp the
+    graftrace shards carry (one back-to-back read): consumers that hold
+    snapshots over time — the graftwatch SLO engine, staleness
+    reporting in `graftscope watch` — get "when was this true" without
+    changing the numeric-only `snapshot()` contract."""
+    return {
+        "clock": {"perf_ns": time.perf_counter_ns(),
+                  "epoch_ns": time.time_ns()},
+        "snapshot": self.snapshot(prefix),
+    }
+
   def exemplars(self, prefix: Optional[str] = None,
                 clear: bool = False) -> Dict[str, Dict[str, object]]:
     """{name: {"value", "trace_id"}} for every histogram holding an

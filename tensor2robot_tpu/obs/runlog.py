@@ -37,7 +37,8 @@ __all__ = ["SCHEMA", "SCHEMA_VERSION", "RUNS_FILENAME", "new_run_id",
            "make_record", "append_record", "read_jsonl", "load_records",
            "step_stats_summary", "overlap_summary", "key_metrics",
            "DEFAULT_THRESHOLDS",
-           "diff_records", "format_diff", "resolve_run", "history_lines",
+           "diff_records", "format_diff", "trend_records", "format_trend",
+           "resolve_run", "history_lines",
            "RunResolveError", "INCIDENT_SCHEMA", "INCIDENTS_FILENAME",
            "make_incident"]
 
@@ -179,6 +180,17 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # band on a nonzero one).
     "serve_queue_wait_p99_ms": ("up", 0.50),
     "trace_overhead_ratio": ("up", 0.50),
+    # graftwatch gates (bench.py --fleet / PERFORMANCE.md "Reading a
+    # watch/SLO report"): fleet_utilization is the duo arm's busy/wall
+    # device-second ratio from the obs.usage ledger — DOWN-bad (idle
+    # devices are paid for), wall-clock on the 1-core host so it gets
+    # the loose band. slo_budget_burn is the SLO engine's worst
+    # fast-window burn over the bench's dedicated evaluation window —
+    # UP-bad; a healthy arm measures ~0, so the 100% band plus the
+    # rel=inf rule on a 0 baseline means any 0 -> nonzero move flags
+    # while nonzero noise under 2x does not.
+    "fleet_utilization": ("down", 0.50),
+    "slo_budget_burn": ("up", 1.00),
 }
 
 
@@ -480,6 +492,12 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
         bench["serve_queue_wait_p99_ms"])
   if bench.get("trace_overhead_ratio") is not None:
     out["trace_overhead_ratio"] = float(bench["trace_overhead_ratio"])
+  # graftwatch telemetry (bench.py --fleet): the ledger's fleet-wide
+  # device utilization and the SLO engine's worst fast-window burn.
+  if bench.get("fleet_utilization") is not None:
+    out["fleet_utilization"] = float(bench["fleet_utilization"])
+  if bench.get("slo_budget_burn") is not None:
+    out["slo_budget_burn"] = float(bench["slo_budget_burn"])
   compiles = record.get("compile") or []
   if compiles:
     primary = _primary_compile_record(record)
@@ -643,6 +661,96 @@ def format_diff(a: Dict[str, Any], b: Dict[str, Any],
                  f"{rel}  {verdict}")
   lines.append(f"  {regressions} regression(s) beyond threshold"
                if regressions else "  no regressions beyond thresholds")
+  return "\n".join(lines) + "\n"
+
+
+def _median(values: Sequence[float]) -> float:
+  ordered = sorted(values)
+  mid = len(ordered) // 2
+  if len(ordered) % 2:
+    return float(ordered[mid])
+  return (ordered[mid - 1] + ordered[mid]) / 2.0
+
+
+def trend_records(records: Sequence[Dict[str, Any]], k: int = 3,
+                  thresholds: Optional[Dict[str, Tuple[str, float]]] = None,
+                  default_threshold: float = 0.10
+                  ) -> List[Dict[str, Any]]:
+  """N-record trend evaluation (`graftscope diff --trend`): per key
+  metric, the MEDIAN of the last `k` records against the median of the
+  `k` before them, judged by the same direction-aware thresholds
+  `diff_records` uses.
+
+  Pairwise diffing two wall-clock-noisy records flaps; the
+  median-of-K window is the same trick the bench's paired arms use,
+  applied along the history axis — a metric must move for several
+  consecutive runs before the trend flags. Metrics with fewer than
+  `k + 1` observations are skipped (no prior window to difference
+  against); the prior window is allowed to be short (down to one
+  record) so a freshly added metric starts trending as soon as it has
+  any history at all. Records whose `key_metrics` lack a metric simply
+  don't contribute to that metric's series (mixed-family histories —
+  one runs.jsonl holding train AND fleet records — trend per metric,
+  not per record).
+  """
+  if k < 1:
+    raise ValueError(f"k must be >= 1, got {k}")
+  series: Dict[str, List[float]] = {}
+  for record in records:
+    for name, value in key_metrics(record).items():
+      series.setdefault(name, []).append(float(value))
+  merged = dict(DEFAULT_THRESHOLDS)
+  merged.update(thresholds or {})
+  out: List[Dict[str, Any]] = []
+  for name in sorted(series):
+    values = series[name]
+    if len(values) < k + 1:
+      continue
+    recent = values[-k:]
+    prior = values[max(len(values) - 2 * k, 0):-k]
+    recent_med = _median(recent)
+    prior_med = _median(prior)
+    delta = recent_med - prior_med
+    rel = ((delta / abs(prior_med)) if prior_med
+           else (0.0 if recent_med == prior_med else float("inf")))
+    direction, threshold = merged.get(name, (None, default_threshold))
+    if direction == "up":
+      regressed = rel > threshold
+    elif direction == "down":
+      regressed = rel < -threshold
+    else:
+      regressed = abs(rel) > threshold
+    out.append({
+        "metric": name, "n": len(values),
+        "prior": prior_med, "recent": recent_med,
+        "delta": delta, "rel": rel,
+        "threshold": threshold, "regressed": regressed,
+    })
+  return out
+
+
+def format_trend(source: str, trends: Sequence[Dict[str, Any]],
+                 k: int = 3) -> str:
+  lines = [f"graftscope trend: {source} "
+           f"(median of last {k} vs prior {k})",
+           f"  {'metric':<22}{'prior':>16}{'recent':>16}{'Δ%':>9}"
+           "  verdict"]
+  regressions = 0
+  for t in trends:
+    rel = (f"{100.0 * t['rel']:>+8.1f}%" if t["rel"] != float("inf")
+           else f"{'+inf':>9}")
+    if t["regressed"]:
+      regressions += 1
+      verdict = f"REGRESSED (>{100.0 * t['threshold']:.0f}%)"
+    else:
+      verdict = "ok"
+    lines.append(f"  {t['metric']:<22}{t['prior']:>16.6g}"
+                 f"{t['recent']:>16.6g}{rel}  {verdict}")
+  if not trends:
+    lines.append("  (no metric has enough history to trend)")
+  lines.append(f"  {regressions} trend regression(s) beyond threshold"
+               if regressions else "  no trend regressions beyond "
+               "thresholds")
   return "\n".join(lines) + "\n"
 
 

@@ -90,6 +90,16 @@ LOOP_WORKER_LOST = "loop_worker_lost"
 # refused publication (warn — actors keep serving the last verified
 # version; the learner's own verified-restore walk quarantines it).
 LOOP_PUBLISH_REJECTED = "loop_publish_rejected"
+# Emitted by the graftwatch SLO engine (`obs/slo.py`) when an objective
+# is burning its error budget: a multi-window burn-rate edge (warn —
+# fast AND slow windows both past the spec's burn factor) or budget
+# exhaustion (fatal, latched once). detail carries {"slo": name,
+# "trigger": "burn_rate"|"budget_exhausted", "fast_burn", "slow_burn",
+# "budget_consumed", "spec": ...}; sinks/flightrec/postmortem consume
+# it through the standard incident fan-out. Sinks must reference THIS
+# constant, not the literal — the `slo-unbudgeted` lint rule flags
+# re-spelled kind strings outside this module.
+SLO_BURN = "serving_slo_burn"
 
 
 @dataclasses.dataclass(frozen=True)

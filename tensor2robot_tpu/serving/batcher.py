@@ -173,7 +173,8 @@ class MicroBatcher:
                max_batch_size: int = 8,
                max_delay_ms: float = 5.0,
                max_queue: int = 64,
-               default_deadline_ms: Optional[float] = None):
+               default_deadline_ms: Optional[float] = None,
+               usage: Optional[Callable[[float, int], None]] = None):
     if backend is None:
       raise ValueError("backend is required.")
     if max_batch_size < 1:
@@ -186,6 +187,11 @@ class MicroBatcher:
     self._max_delay_s = max_delay_ms / 1e3
     self._max_queue = max_queue
     self._default_deadline_ms = default_deadline_ms
+    # Device-time ledger hook (`obs.usage.UsageLedger.recorder(group)`):
+    # called `(busy_seconds, requests)` once per backend dispatch window
+    # — the busy side of the fleet's busy-vs-idle accounting. The
+    # batcher stays ledger-agnostic; the fleet binds the group.
+    self._usage = usage
     self._pending: "collections.deque[_Request]" = collections.deque()
     self._pending_rows = 0
     self._lock = threading.Lock()
@@ -240,9 +246,12 @@ class MicroBatcher:
       # The whole bypass window IS its dispatch stage — recorded so the
       # stage sums still reconcile with serve/request_ms when traffic
       # mixes bypass and coalesced requests.
+      end_ns = time.perf_counter_ns()
       graftrace.record_stage(
-          "dispatch", (time.perf_counter_ns() - t0_ns) / 1e6, ctx=ctx,
+          "dispatch", (end_ns - t0_ns) / 1e6, ctx=ctx,
           start_ns=t0_ns)
+      if self._usage is not None:
+        self._usage((end_ns - t0_ns) / 1e9, 1)
       self._observe(start, ctx)
       return result
     request = _Request(features, rows,
@@ -386,6 +395,10 @@ class MicroBatcher:
     # snapshot. A telemetry failure here cannot orphan a request: the
     # `_run` handler fails every not-yet-completed request in the batch.
     self._record_stages(live, dispatch_ns, split_ns, end_ns)
+    if self._usage is not None:
+      # The dispatch window (backend call wall) is the device-busy time
+      # this batch bought; split/bookkeeping is host work, not charged.
+      self._usage((split_ns - dispatch_ns) / 1e9, len(live))
     obs_metrics.counter("serve/batcher/batches").inc()
     obs_metrics.histogram("serve/batch_rows").record(
         float(sum(r.rows for r in live)))

@@ -581,9 +581,13 @@ class BucketedEngine:
       out[k] = v
     # `device` = executable call + host fetch (the real barrier): the
     # other dispatch-internal sub-stage, same exclusion rule as `pad`.
+    device_ms = (time.perf_counter_ns() - device_ns) / 1e6
     graftrace.record_stage(
-        "device", (time.perf_counter_ns() - device_ns) / 1e6,
-        ctx=graftrace.current(), start_ns=device_ns)
+        "device", device_ms, ctx=graftrace.current(), start_ns=device_ns)
+    # Cumulative device-occupancy counter: the engine-level busy signal
+    # the graftwatch ledger's per-group numbers cross-check against
+    # (stage histograms are reservoir-sampled; this is exact).
+    obs_metrics.counter("serve/engine/device_busy_ms").inc(device_ms)
     return out
 
   # -- predictor duck-type passthroughs -------------------------------------

@@ -98,6 +98,20 @@ graftscope telemetry (runs.jsonl via the standard registry snapshot):
                probation_readmits,probation_giveups} counters
   serve/fleet/readmit_ms                           histogram (eviction
                                                    -> readmission MTTR)
+  serve/fleet/device_seconds_{busy,idle}           gauges (graftwatch
+  serve/fleet/{utilization,window_utilization,      device-time ledger,
+               cost_per_request_usd}                obs/usage.py)
+  serve/fleet/busy_ms/<replica>                    counters (per-group
+  serve/fleet/busy_requests/<replica>               busy mirror)
+
+graftwatch (PR 19): `latency_slo_ms=` scores every routed predict's
+wall time against a latency objective through
+`obs.sentinel.observe_serving_latency` (feeding `serve/slo_breaches`,
+the bad-event counter `obs.slo.SloEngine` burn-rate windows read); the
+`obs.usage.UsageLedger` accounts per-replica busy-vs-idle device time
+from the batcher dispatch windows (`usage=` hooks) and gates advisory
+scale-in on sustained idleness (`recommended_replicas`); `graftscope
+watch` renders both from the graftrace metrics shards.
 
 Backend-free at import like the rest of `serving/` (jax only ever
 appears inside factories the CALLER provides; tests/test_fleet.py runs
@@ -122,6 +136,7 @@ from tensor2robot_tpu.obs import metrics as obs_metrics
 from tensor2robot_tpu.obs import runlog as runlog_lib
 from tensor2robot_tpu.obs import sentinel as sentinel_lib
 from tensor2robot_tpu.obs import trace as obs_trace
+from tensor2robot_tpu.obs import usage as usage_lib
 from tensor2robot_tpu.serving import batcher as batcher_lib
 from tensor2robot_tpu.serving import session as session_lib
 from tensor2robot_tpu.utils import config
@@ -228,7 +243,10 @@ class ServingFleet:
                probation_policy: Optional[retry_lib.RetryPolicy] = None,
                autoscale_window_s: float = 30.0,
                autoscale_sample_s: float = 0.25,
-               autoscale_target_utilization: float = 0.5):
+               autoscale_target_utilization: float = 0.5,
+               latency_slo_ms: Optional[float] = None,
+               cost_per_device_hour_usd: float =
+               usage_lib.COST_PER_DEVICE_HOUR_USD):
     if replica_factory is None:
       raise ValueError("replica_factory is required.")
     if num_replicas < 1:
@@ -243,6 +261,19 @@ class ServingFleet:
     self._session_reopen = session_reopen
     self._shed_outstanding = (shed_outstanding if shed_outstanding
                               is not None else max_queue)
+    # Router-level latency objective (graftwatch): when set, every
+    # routed predict's wall time feeds `serve/slo_breaches` through
+    # `obs.sentinel.observe_serving_latency` — the bad-event counter
+    # the SLO engine's burn-rate windows consume. None = not measured
+    # (the per-request deadline path still counts its own breaches).
+    self._latency_slo_ms = latency_slo_ms
+    # Device-time ledger (obs.usage): busy windows flow in through the
+    # batcher `usage=` hooks; wall windows open/close with replicas.
+    self._usage = usage_lib.UsageLedger(
+        name=name, cost_per_device_hour_usd=cost_per_device_hour_usd,
+        sample_window_s=max(autoscale_window_s, 1.0),
+        sample_interval_s=autoscale_sample_s)
+    self._opened_s = time.monotonic()
     self._lock = threading.Lock()
     self._closed = False
     # Replica probation (module docstring): probe factory + policy
@@ -283,14 +314,25 @@ class ServingFleet:
     self._replicas: List[_Replica] = []
     for index in range(num_replicas):
       engine = replica_factory(index, groups[index])
+      group_name = f"replica{index}"
+      group_devices = (len(groups[index])
+                       if groups[index] is not None else 1)
+      self._usage.open_group(group_name, devices=group_devices)
+      recorder = self._usage.recorder(group_name)
       front = None
       if hasattr(engine, "predict"):
         front = batcher_lib.MicroBatcher(
             backend=engine, max_batch_size=max_batch_size,
-            max_delay_ms=max_delay_ms, max_queue=max_queue)
+            max_delay_ms=max_delay_ms, max_queue=max_queue,
+            usage=recorder)
       session_front = None
       if hasattr(engine, "open") and hasattr(engine, "step"):
-        session_front = (session_lib.SessionBatcher(engine=engine)
+        # The SessionBatcher records its own dispatch windows; with
+        # direct engine routing the fleet's `step()` records instead
+        # (`_session_usage` non-None marks that case — exactly one
+        # recorder per tick, never both).
+        session_front = (session_lib.SessionBatcher(engine=engine,
+                                                    usage=recorder)
                          if session_batching else engine)
       if front is None and session_front is None:
         raise ValueError(
@@ -392,7 +434,15 @@ class ServingFleet:
     * queue-bound SHEDS in the window are a hard under-capacity signal:
       any shedding recommends at least one replica more than currently
       healthy (backpressure means the bound already fired — occupancy
-      alone underestimates demand that was refused).
+      alone underestimates demand that was refused);
+    * SCALE-IN (recommended < healthy) must additionally be backed by
+      the device-time ledger (graftwatch, `obs.usage.UsageLedger`): the
+      window's measured device utilization, PROJECTED onto the smaller
+      fleet (`util * healthy / recommended`), must stay at or under the
+      target — so a trough recommendation prices SUSTAINED idle
+      device-seconds, not one quiet outstanding-count sample, and a
+      recent busy burst inside the window blocks scale-in until the
+      window actually drains.
 
     Never recommends below 1 or below what an in-window shed proves is
     needed; with no traffic in the window it recommends the current
@@ -418,9 +468,26 @@ class ServingFleet:
             1)
         if sheds_delta > 0:
           recommended = max(recommended, healthy + 1)
+    if recommended < healthy:
+      # Sustained-idle gate (ledger-backed scale-in; advisory only).
+      util, _ = self._usage.window_utilization(window, now=now)
+      obs_metrics.gauge("serve/fleet/window_utilization").set(
+          round(util, 4))
+      projected = util * healthy / float(max(recommended, 1))
+      if projected > self._autoscale_target_util:
+        recommended = healthy
     obs_metrics.gauge("serve/fleet/recommended_replicas").set(
         float(recommended))
     return recommended
+
+  def utilization_summary(self) -> Dict[str, Any]:
+    """The fleet's device-time ledger block (`obs.usage.UsageLedger
+    .summary`): per-replica busy/idle device-seconds, utilization, and
+    cost-per-request — the `utilization` block `bench.py --fleet`
+    appends to runs.jsonl and `graftscope watch` renders. Also exports
+    the `serve/fleet/device_seconds_{busy,idle}` / `.../utilization` /
+    `.../cost_per_request_usd` gauges as a side effect."""
+    return self._usage.summary()
 
   # -- health ---------------------------------------------------------------
 
@@ -673,7 +740,18 @@ class ServingFleet:
     # batcher below it mints a CHILD at its own admission, so the
     # fleet-level span parents the queue/dispatch decomposition.
     ctx = graftrace.request_context()
-    return self._predict_routed(features, deadline_ms, ctx)
+    if self._latency_slo_ms is None:
+      return self._predict_routed(features, deadline_ms, ctx)
+    # Latency objective: the ROUTED wall time (queue + failover + retry
+    # included — what the caller experienced) scores against the SLO,
+    # breaches and all error outcomes alike; the SLO engine's burn-rate
+    # windows read the counters this feeds.
+    start = time.monotonic()
+    try:
+      return self._predict_routed(features, deadline_ms, ctx)
+    finally:
+      sentinel_lib.observe_serving_latency(
+          (time.monotonic() - start) * 1e3, self._latency_slo_ms)
 
   def _predict_routed(self, features, deadline_ms, ctx
                       ) -> Dict[str, np.ndarray]:
@@ -836,10 +914,19 @@ class ServingFleet:
       self._sample_load_locked(time.monotonic())
     ok = False
     ctx = graftrace.request_context()
+    # Direct engine routing has no SessionBatcher recording dispatch
+    # windows into the ledger — the fleet times the tick itself (a tick
+    # IS the dispatch in that topology).
+    direct = replica.session_front is replica.engine
+    tick_ns = time.perf_counter_ns() if direct else 0
     try:
       with graftrace.activate(ctx):
         result = replica.session_front.step(entry.inner_sid, features)
       ok = True
+      if direct:
+        self._usage.record_busy(
+            f"replica{replica.index}",
+            (time.perf_counter_ns() - tick_ns) / 1e9, 1)
       return result
     except session_lib.SessionError as e:
       # A session-lifecycle outcome (evicted under slot pressure,
@@ -894,6 +981,12 @@ class ServingFleet:
       warm = getattr(replica.engine, "warmup", None)
       if warm is not None:
         warm()
+        warm_ms = float(getattr(replica.engine, "warmup_ms", 0.0) or 0.0)
+        if warm_ms > 0.0:
+          # Warmup compiles/deserializes occupy the device group too —
+          # busy time in the ledger, zero requests served.
+          self._usage.record_busy(f"replica{replica.index}",
+                                  warm_ms / 1e3, 0)
     load_ms = sum(float(getattr(r.engine, "warmup_load_ms", 0.0) or 0.0)
                   for r in self._replicas)
     compile_ms = sum(
@@ -1118,6 +1211,9 @@ class ServingFleet:
           close()
         except Exception:  # noqa: BLE001 - teardown must not mask errors
           pass
+      # Freeze the ledger's wall window: idle stops accruing for a
+      # replica the moment it stops existing.
+      self._usage.close_group(f"replica{replica.index}")
     graftrace.flush()
 
   def __enter__(self) -> "ServingFleet":

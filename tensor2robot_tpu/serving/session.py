@@ -77,7 +77,8 @@ import collections
 import itertools
 import threading
 import time
-from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, List, Mapping, Optional, Sequence,
+                    Tuple)
 
 import numpy as np
 
@@ -799,7 +800,8 @@ class SessionBatcher:
 
   def __init__(self, engine: Optional[SessionEngine] = None,
                max_delay_ms: float = 2.0,
-               max_queue: int = 256):
+               max_queue: int = 256,
+               usage: Optional[Callable[[float, int], None]] = None):
     from tensor2robot_tpu.serving import batcher as batcher_lib
 
     if engine is None:
@@ -807,6 +809,9 @@ class SessionBatcher:
     self._engine = engine
     self._max_delay_s = max_delay_ms / 1e3
     self._max_queue = max_queue
+    # Device-time ledger hook (same `(busy_s, requests)` contract as
+    # `MicroBatcher`): one call per step_many dispatch window.
+    self._usage = usage
     self._shutdown_error = batcher_lib.ShutdownError
     self._shed_error = batcher_lib.ShedError
     self._pending: "collections.deque" = collections.deque()
@@ -917,6 +922,8 @@ class SessionBatcher:
           [(r.pop_ns - r.enq_ns) / 1e6 for r in batch if r.pop_ns])
       graftrace.record_stage_many(
           "dispatch", [(end_ns - dispatch_ns) / 1e6] * len(batch))
+      if self._usage is not None:
+        self._usage((end_ns - dispatch_ns) / 1e9, len(batch))
       if obs_trace.get_tracer().enabled:
         for r in batch:
           if r.ctx is None:

@@ -1069,7 +1069,12 @@ def _append_run_record(model_dir: str, run_memory: dict,
       pass
     memory["hbm_watermark_bytes"] = xray_lib.hbm_watermark_estimate(
         memory, compile_records)
-    summary = runlog_lib.step_stats_summary(metrics_registry_lib.snapshot())
+    # Stamped-snapshot discipline (graftwatch): the run record carries
+    # the same paired monotonic/epoch clock the graftrace shards do, so
+    # `graftscope watch`/`diff --trend` can reason about record age
+    # without trusting file mtimes.
+    stamped = metrics_registry_lib.get_registry().stamped_snapshot()
+    summary = runlog_lib.step_stats_summary(stamped["snapshot"])
     # runs.jsonl is strict JSON (allow_nan=False): a NaN loss must cost
     # that one scalar, not the whole record.
     finite_metrics = {}
@@ -1083,6 +1088,7 @@ def _append_run_record(model_dir: str, run_memory: dict,
     device = jax.devices()[0]
     extra = {"model_dir": model_dir, "final_step": int(final_step),
              "final_metrics": finite_metrics,
+             "clock": stamped["clock"],
              "tunnel_health": backend.tunnel_health(),
              # graftcache accounting (hits/misses/load_ms/bytes): a warm
              # restart is visible as hits>0 with compile_s≈0 in the

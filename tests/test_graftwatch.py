@@ -1,0 +1,859 @@
+"""graftwatch tests: SLO engine, device-time ledger, watch dashboard.
+
+Pins the ISSUE 19 semantics:
+* burn-rate math against hand-computed multi-window values (the
+  Google-SRE fast AND slow formulation: a fast-only spike must NOT
+  alert, sustained burn must — exactly once per episode, re-arming when
+  the fast window clears; budget exhaustion latches once, fatally);
+* the DETERMINISTIC storm pin: a seeded `obs.faultlab` serve.latency
+  storm against a real `ServingFleet` exhausts the error budget at a
+  PRECOMPUTED request count, the fatal `SLO_BURN` incident reaches the
+  sentinel sink chain (including `fleet.sentinel_sink()`, which must
+  NOT evict — no replica named), and an identical seed reproduces an
+  identical incident stream;
+* `UsageLedger` reconciliation: busy + idle == wall x devices by
+  construction, hand-computed windowed utilization with an injected
+  clock, and the same identity over a REAL fleet's dispatch windows;
+* the ledger-backed scale-in gate in `recommended_replicas()`: a
+  traffic trough scales in, a busy window inside the trough blocks it;
+* `graftscope watch --snapshot`: renders from metrics shards alone,
+  exit 0 healthy / 1 over-budget / 2 unusable, corrupt shards counted
+  not raised, stale workers excluded from the merge, newest generation
+  per pid wins;
+* `graftscope diff --trend`: direction-aware median-of-K drift over one
+  run history, exit 3 on a flagged trend;
+* the `slo-unbudgeted` graftlint rule matrix;
+* the whole reader/engine stack runs in a subprocess under a poisoned
+  JAX_PLATFORMS without ever initializing a backend.
+
+Reference contrast: the original stack's health signal was a human
+reading Estimator eval scalars after the fact
+(/root/reference/utils/train_eval.py:136-151); these tests pin the
+machine-checkable replacement.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tensor2robot_tpu import serving
+from tensor2robot_tpu.bin import graftscope
+from tensor2robot_tpu.obs import aggregate as aggregate_lib
+from tensor2robot_tpu.obs import faultlab as faultlab_lib
+from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import runlog as runlog_lib
+from tensor2robot_tpu.obs import sentinel as sentinel_lib
+from tensor2robot_tpu.obs import slo as slo_lib
+from tensor2robot_tpu.obs import usage as usage_lib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+X1 = {"x": np.ones((1, 2), np.float32)}
+
+
+class _FakeEngine:
+  """Backend-free replica (the test_fleet idiom, trimmed to what the
+  graftwatch paths touch)."""
+
+  def __init__(self, index):
+    self.index = index
+    self.version = 1
+
+  def predict(self, features):
+    return {"out": np.asarray(features["x"]) * float(self.version)}
+
+  def warmup(self):
+    pass
+
+  @property
+  def model_version(self):
+    return self.version
+
+  @property
+  def global_step(self):
+    return self.version
+
+  def close(self):
+    pass
+
+
+def _make_fleet(num_replicas=2, **kwargs):
+  kwargs.setdefault("max_delay_ms", 1.0)
+  return serving.ServingFleet(
+      replica_factory=lambda index, devices: _FakeEngine(index),
+      num_replicas=num_replicas, **kwargs)
+
+
+def _ratio_spec(**overrides):
+  base = dict(budget=0.5, fast_window_s=2.0, slow_window_s=8.0,
+              bad_key="counter/bad", total_key="counter/total",
+              burn_factor=3.0)
+  base.update(overrides)
+  return slo_lib.SloSpec("obj", **base)
+
+
+# ---------------------------------------------------------------------------
+# SloSpec declaration contract.
+# ---------------------------------------------------------------------------
+
+
+class TestSloSpec:
+
+  def test_exactly_one_family(self):
+    with pytest.raises(ValueError):
+      slo_lib.SloSpec("x", budget=0.1, fast_window_s=1.0,
+                      slow_window_s=2.0)  # neither family
+    with pytest.raises(ValueError):
+      slo_lib.SloSpec("x", budget=0.1, fast_window_s=1.0,
+                      slow_window_s=2.0, bad_key="a", total_key="b",
+                      value_key="c", ceiling=1.0)  # both
+    with pytest.raises(ValueError):
+      slo_lib.SloSpec("x", budget=0.1, fast_window_s=1.0,
+                      slow_window_s=2.0, bad_key="a")  # half a family
+
+  def test_budget_and_windows_validated(self):
+    with pytest.raises(ValueError):
+      _ratio_spec(budget=0.0)
+    with pytest.raises(ValueError):
+      _ratio_spec(budget=1.5)
+    with pytest.raises(ValueError):
+      _ratio_spec(fast_window_s=8.0, slow_window_s=2.0)  # inverted
+    with pytest.raises(ValueError):
+      _ratio_spec(burn_factor=1.0)
+
+  def test_describe_round_trips_the_family(self):
+    ratio = _ratio_spec()
+    assert ratio.describe()["kind"] == slo_lib.RATIO
+    assert ratio.describe()["bad_key"] == "counter/bad"
+    value = slo_lib.SloSpec("v", budget=0.1, fast_window_s=1.0,
+                            slow_window_s=2.0, value_key="gauge/x",
+                            ceiling=2.0)
+    assert value.describe()["kind"] == slo_lib.VALUE
+    assert value.describe()["ceiling"] == 2.0
+
+  def test_value_spec_counts_one_event_per_observation(self):
+    spec = slo_lib.SloSpec("v", budget=0.5, fast_window_s=1.0,
+                           slow_window_s=4.0, value_key="gauge/x",
+                           ceiling=2.0)
+    bad, total = spec.counts({"gauge/x": 1.0}, 0.0, 0.0)
+    assert (bad, total) == (0.0, 1.0)
+    bad, total = spec.counts({"gauge/x": 3.0}, bad, total)
+    assert (bad, total) == (1.0, 2.0)
+    # Key absent: not an observation — counts hold.
+    assert spec.counts({}, bad, total) == (1.0, 2.0)
+
+
+# ---------------------------------------------------------------------------
+# Burn-rate math, hand-computed.
+# ---------------------------------------------------------------------------
+
+
+class TestBurnMath:
+
+  def test_windowed_burns_match_hand_computed_values(self):
+    # budget 0.5, fast 2 s, slow 8 s. Stream (now, bad, total):
+    #   (0, 0, 0) -> all zero.
+    #   (1, 2, 10) -> window delta 2/10 = 0.2 ratio -> burn 0.4.
+    #   (2, 6, 20) -> baseline the t=0 sample: 6/20 = 0.3 -> burn 0.6.
+    #   (10, 6, 20) -> both windows see zero delta -> burn 0.
+    with metrics_lib.isolated():
+      engine = slo_lib.SloEngine([_ratio_spec()])
+      engine.observe({"counter/bad": 0.0, "counter/total": 0.0}, now=0.0)
+      st = engine.state(now=0.0)["obj"]
+      assert (st["fast_burn"], st["slow_burn"],
+              st["budget_consumed"]) == (0.0, 0.0, 0.0)
+      engine.observe({"counter/bad": 2.0, "counter/total": 10.0},
+                     now=1.0)
+      st = engine.state(now=1.0)["obj"]
+      assert st["fast_burn"] == pytest.approx(0.4)
+      assert st["slow_burn"] == pytest.approx(0.4)
+      assert st["budget_consumed"] == pytest.approx(0.4)
+      engine.observe({"counter/bad": 6.0, "counter/total": 20.0},
+                     now=2.0)
+      st = engine.state(now=2.0)["obj"]
+      assert st["fast_burn"] == pytest.approx(0.6)
+      assert st["slow_burn"] == pytest.approx(0.6)
+      assert st["budget_consumed"] == pytest.approx(0.6)
+      engine.observe({"counter/bad": 6.0, "counter/total": 20.0},
+                     now=10.0)
+      st = engine.state(now=10.0)["obj"]
+      assert st["fast_burn"] == 0.0
+      assert st["slow_burn"] == 0.0
+      # Consumed is cumulative-from-genesis: the quiet window does not
+      # refill the budget.
+      assert st["budget_consumed"] == pytest.approx(0.6)
+
+  def test_genesis_baseline_ignores_preexisting_counts(self):
+    # An engine attached mid-run must not charge history it never
+    # observed against the budget.
+    with metrics_lib.isolated():
+      engine = slo_lib.SloEngine([_ratio_spec(budget=0.5)])
+      engine.observe({"counter/bad": 5.0, "counter/total": 100.0},
+                     now=0.0)
+      assert engine.state()["obj"]["budget_consumed"] == 0.0
+      engine.observe({"counter/bad": 10.0, "counter/total": 110.0},
+                     now=1.0)
+      # Only the observed delta counts: (5/10) / 0.5 = 1.0.
+      assert engine.state()["obj"]["budget_consumed"] == pytest.approx(
+          1.0)
+
+  def test_burn_alert_needs_fast_and_slow_and_rearms(self):
+    # budget 0.2, factor 3, fast 2 s, slow 10 s. Quiet traffic is
+    # +100 total/s with 0 bad; a burst is +8 bad / +10 total per
+    # second. The burst ratio 0.8 -> burn 4.0 crosses the factor in
+    # BOTH windows only once the slow window fills with burst — one
+    # warn per episode, re-armed by the quiet phase, and the fast-only
+    # spike at the start of the burst must not alert on its own.
+    spec = _ratio_spec(budget=0.2, fast_window_s=2.0,
+                       slow_window_s=10.0, burn_factor=3.0)
+    incidents = []
+    with metrics_lib.isolated() as reg:
+      engine = slo_lib.SloEngine([spec], sinks=[incidents.append])
+      bad, total = 0.0, 0.0
+
+      def observe(now):
+        return engine.observe({"counter/bad": bad,
+                               "counter/total": total}, now=now)
+
+      observe(0.0)
+      for now in range(1, 6):  # quiet: slow window fills clean
+        total += 100.0
+        assert observe(float(now)) == []
+      first_burst = []
+      for now in range(6, 16):  # burst
+        bad += 8.0
+        total += 10.0
+        first_burst.extend(observe(float(now)))
+      assert len(first_burst) == 1  # rising edge: ONE warn, not ten
+      assert first_burst[0]["severity"] == "warn"
+      assert first_burst[0]["detail"]["trigger"] == "burn_rate"
+      assert first_burst[0]["kind"] == sentinel_lib.SLO_BURN
+      assert engine.state()["obj"]["burning"] is True
+      assert engine.healthy() is False
+      for now in range(16, 31):  # quiet again: fast clears, re-arm
+        total += 100.0
+        assert observe(float(now)) == []
+      assert engine.state()["obj"]["burning"] is False
+      assert engine.healthy() is True
+      second_burst = []
+      for now in range(31, 41):  # second episode
+        bad += 8.0
+        total += 10.0
+        second_burst.extend(observe(float(now)))
+      assert len(second_burst) == 1
+      assert second_burst[0]["detail"]["trigger"] == "burn_rate"
+      # Never exhausted: the quiet traffic diluted cumulative burn.
+      assert engine.state()["obj"]["exhausted"] is False
+      assert engine.state()["obj"]["budget_consumed"] < 1.0
+      snap = reg.snapshot()
+      assert snap[f"counter/sentinel/{sentinel_lib.SLO_BURN}"] == 2.0
+      assert snap["counter/sentinel/incidents"] == 2.0
+      assert snap["gauge/slo/obj/fast_burn"] >= 3.0
+
+  def test_budget_exhaustion_latches_once_and_is_fatal(self):
+    incidents = []
+    with metrics_lib.isolated():
+      engine = slo_lib.SloEngine(
+          [_ratio_spec(budget=0.05, fast_window_s=2.0,
+                       slow_window_s=8.0)],
+          sinks=[incidents.append])
+      engine.observe({"counter/bad": 0.0, "counter/total": 0.0},
+                     now=0.0)
+      engine.observe({"counter/bad": 1.0, "counter/total": 10.0},
+                     now=1.0, step=1)
+      assert len(incidents) == 1
+      assert incidents[0]["severity"] == "fatal"
+      assert incidents[0]["detail"]["trigger"] == "budget_exhausted"
+      assert incidents[0]["value"] == pytest.approx(2.0)  # (0.1)/0.05
+      # Keep burning hard: neither a second exhaustion nor a burn warn
+      # may append to the stream the postmortem reads.
+      for now in range(2, 8):
+        engine.observe({"counter/bad": float(now),
+                        "counter/total": float(10 * now)},
+                       now=float(now))
+      assert len(incidents) == 1
+      st = engine.state()["obj"]
+      assert st["exhausted"] is True
+      assert st["incidents"] == 1
+      assert engine.healthy() is False
+      assert engine.worst_burn() >= 1.0
+
+  def test_evaluate_snapshot_point_in_time(self):
+    specs = [
+        _ratio_spec(budget=0.1),
+        slo_lib.SloSpec("v", budget=0.5, fast_window_s=1.0,
+                        slow_window_s=4.0, value_key="gauge/x",
+                        ceiling=2.0),
+    ]
+    out = slo_lib.evaluate_snapshot(
+        specs, {"counter/bad": 3.0, "counter/total": 10.0,
+                "gauge/x": 5.0})
+    assert out["obj"]["ok"] is False  # 0.3 ratio vs 0.1 budget
+    assert out["obj"]["budget_consumed"] == pytest.approx(3.0)
+    assert out["v"]["ok"] is False  # 5.0 > ceiling 2.0
+    ok = slo_lib.evaluate_snapshot(
+        specs, {"counter/bad": 0.0, "counter/total": 10.0})
+    assert ok["obj"]["ok"] is True
+    assert ok["v"]["ok"] is True  # value absent: nothing breached
+
+
+# ---------------------------------------------------------------------------
+# The deterministic storm pin (acceptance criterion).
+# ---------------------------------------------------------------------------
+
+
+# Storm shape: every 4th routed predict on replica 0 holds the dispatch
+# open 600 ms against a 200 ms latency SLO -> breaches = floor(k/4)
+# after k requests. With budget 0.25 the budget consumption
+# (floor(k/4)/k)/0.25 first reaches 1.0 at k = 4: the PRECOMPUTED
+# exhaustion request count.
+_STORM_EVERY = 4
+_STORM_BUDGET = 0.25
+_STORM_REQUESTS = 8
+_STORM_EXHAUST_AT = next(
+    k for k in range(1, _STORM_REQUESTS + 1)
+    if (k // _STORM_EVERY) / k >= _STORM_BUDGET)
+
+
+def _run_storm(seed):
+  """One seeded latency storm against a real 1-replica fleet; returns
+  (incident stream, final registry snapshot, sink capture)."""
+  captured = []
+  with metrics_lib.isolated() as reg:
+    fleet = _make_fleet(num_replicas=1, latency_slo_ms=200.0)
+    spec = slo_lib.SloSpec(
+        "storm_latency", budget=_STORM_BUDGET, fast_window_s=4.0,
+        slow_window_s=16.0, bad_key="counter/serve/slo_breaches",
+        total_key="counter/serve/fleet/requests")
+    engine = slo_lib.SloEngine(
+        [spec], sinks=[captured.append, fleet.sentinel_sink()])
+    faultlab_lib.activate(faultlab_lib.FaultPlan(
+        [faultlab_lib.FaultSpec(point=faultlab_lib.SERVE_LATENCY,
+                                key=0, every=_STORM_EVERY, arg=600.0)],
+        seed=seed))
+    try:
+      stream = []
+      # Genesis observation BEFORE traffic: the engine's budget
+      # baseline is the empty fleet, so "total" below counts every
+      # storm request.
+      stream.extend(engine.observe(reg.snapshot(), now=0.0, step=0))
+      for i in range(1, _STORM_REQUESTS + 1):
+        fleet.predict(X1)
+        stream.extend(engine.observe(reg.snapshot(), now=float(i),
+                                     step=i))
+      # The fatal SLO_BURN names no replica: sentinel_sink must have
+      # passed it through WITHOUT evicting — the fleet still serves.
+      fleet.predict(X1)
+    finally:
+      faultlab_lib.deactivate()
+      fleet.close()
+    return stream, reg.snapshot(), captured
+
+
+class TestStormDeterminism:
+
+  def test_budget_exhausts_at_the_precomputed_request_count(self):
+    assert _STORM_EXHAUST_AT == 4  # the hand-derived pin itself
+    stream, snap, captured = _run_storm(seed=7)
+    assert snap["counter/serve/slo_breaches"] == float(
+        _STORM_REQUESTS // _STORM_EVERY)
+    assert len(stream) == 1
+    incident = stream[0]
+    assert incident["kind"] == sentinel_lib.SLO_BURN
+    assert incident["severity"] == "fatal"
+    assert incident["step"] == _STORM_EXHAUST_AT
+    assert incident["detail"]["trigger"] == "budget_exhausted"
+    assert incident["detail"]["slo"] == "storm_latency"
+    assert incident["detail"]["bad"] == 1.0
+    assert incident["detail"]["total"] == float(_STORM_EXHAUST_AT)
+    assert incident["value"] == pytest.approx(1.0)
+    assert incident["threshold"] == _STORM_BUDGET
+    # The sink chain saw exactly the emitted stream.
+    assert captured == stream
+    assert snap[f"counter/sentinel/{sentinel_lib.SLO_BURN}"] == 1.0
+    # Advisory, not evicting: no fleet eviction counter moved.
+    assert "counter/serve/fleet/evictions" not in snap
+
+  def test_identical_seed_reproduces_the_incident_stream(self):
+    stream_a, _, _ = _run_storm(seed=13)
+    stream_b, _, _ = _run_storm(seed=13)
+    # make_incident stamps wall time; everything else must match
+    # field-for-field.
+    for record in stream_a + stream_b:
+      record.pop("unix_time", None)
+    assert stream_a == stream_b
+    assert len(stream_a) == 1
+
+
+# ---------------------------------------------------------------------------
+# UsageLedger reconciliation.
+# ---------------------------------------------------------------------------
+
+
+class TestUsageLedger:
+
+  def test_busy_plus_idle_reconciles_with_wall_clock(self):
+    t = [0.0]
+    ledger = usage_lib.UsageLedger(
+        name="t/fleet", cost_per_device_hour_usd=3.6,
+        sample_window_s=10.0, sample_interval_s=0.0,
+        clock=lambda: t[0])
+    with metrics_lib.isolated():
+      ledger.open_group("g0", devices=4)
+      t[0] = 2.0
+      ledger.record_busy("g0", 1.5, requests=3)
+      t[0] = 10.0
+      out = ledger.summary(now=10.0)
+    # 4 devices x 10 s wall = 40 device-seconds; 1.5 s busy x 4
+    # devices = 6; idle is the complement BY CONSTRUCTION.
+    assert out["devices"] == 4
+    assert out["device_seconds_busy"] == pytest.approx(6.0)
+    assert out["device_seconds_idle"] == pytest.approx(34.0)
+    assert (out["device_seconds_busy"] + out["device_seconds_idle"]
+            == pytest.approx(40.0))
+    assert out["utilization"] == pytest.approx(0.15)
+    assert out["requests"] == 3
+    # Cost prices WALL seconds at $3.6/device-hour: 40/3600*3.6 = 0.04.
+    assert out["cost_usd"] == pytest.approx(0.04)
+    assert out["cost_per_request_usd"] == pytest.approx(0.04 / 3)
+    assert out["groups"]["g0"]["wall_s"] == pytest.approx(10.0)
+
+  def test_window_utilization_hand_computed(self):
+    t = [0.0]
+    ledger = usage_lib.UsageLedger(
+        name="t/fleet", sample_window_s=100.0, sample_interval_s=0.0,
+        clock=lambda: t[0])
+    with metrics_lib.isolated():
+      ledger.open_group("g0", devices=1)
+      for tick in range(1, 9):  # 0.5 s busy at t = 1..8
+        t[0] = float(tick)
+        ledger.record_busy("g0", 0.5)
+      # Trailing 4 s window at t=8: baseline is the cumulative at the
+      # t=4 sample (2.0), so busy inside the window is 4.0-2.0 = 2.0
+      # over 4 wall seconds -> 0.5 utilization, full coverage.
+      util, coverage = ledger.window_utilization(4.0, now=8.0)
+      assert util == pytest.approx(0.5)
+      assert coverage == pytest.approx(4.0)
+      # A window wider than the group's life covers only its age and
+      # uses the zero baseline: 4.0 busy / 8 wall.
+      util, coverage = ledger.window_utilization(100.0, now=8.0)
+      assert util == pytest.approx(0.5)
+      assert coverage == pytest.approx(8.0)
+      # Closed groups stop contributing to the windowed read entirely.
+      ledger.close_group("g0")
+      assert ledger.window_utilization(4.0, now=9.0) == (0.0, 0.0)
+
+  def test_close_freezes_the_wall_window(self):
+    t = [0.0]
+    ledger = usage_lib.UsageLedger(name="t/fleet",
+                                   clock=lambda: t[0])
+    with metrics_lib.isolated():
+      ledger.open_group("g0", devices=2)
+      t[0] = 3.0
+      ledger.record_busy("g0", 1.0)
+      t[0] = 5.0
+      ledger.close_group("g0")
+      t[0] = 20.0  # time after close must not accrue idle
+      out = ledger.summary()
+    assert out["groups"]["g0"]["wall_s"] == pytest.approx(5.0)
+    assert out["device_seconds_busy"] == pytest.approx(2.0)
+    assert out["device_seconds_idle"] == pytest.approx(8.0)
+
+  def test_record_busy_mirrors_registry_counters(self):
+    ledger = usage_lib.UsageLedger(name="t/fleet")
+    with metrics_lib.isolated() as reg:
+      ledger.record_busy("replica0", 0.25, requests=2)
+      snap = reg.snapshot()
+    assert snap["counter/t/fleet/busy_ms/replica0"] == pytest.approx(
+        250.0)
+    assert snap["counter/t/fleet/busy_requests/replica0"] == 2.0
+
+  def test_real_fleet_ledger_reconciles(self):
+    # The identity over REAL dispatch windows: run traffic through a
+    # 2-replica fleet, then busy + idle must equal wall x devices
+    # (within the block's 4-decimal rounding) and the batcher usage
+    # hooks must have attributed every request.
+    with metrics_lib.isolated():
+      fleet = _make_fleet(num_replicas=2)
+      try:
+        for _ in range(8):
+          fleet.predict(X1)
+      finally:
+        fleet.close()
+      out = fleet.utilization_summary()
+    assert out["requests"] == 8
+    assert out["device_seconds_busy"] > 0.0
+    wall = sum(g["wall_s"] * g["devices"] for g in out["groups"].values())
+    assert (out["device_seconds_busy"] + out["device_seconds_idle"]
+            == pytest.approx(wall, abs=2e-3))
+    assert set(out["groups"]) == {"replica0", "replica1"}
+    assert out["cost_per_request_usd"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Ledger-backed scale-in gate.
+# ---------------------------------------------------------------------------
+
+
+class TestScaleInGate:
+
+  def test_trough_traffic_scales_in(self):
+    # Quick stateless traffic: the outstanding window reads ~0, the
+    # ledger agrees (dispatches are microseconds) -> advisory 1.
+    with metrics_lib.isolated():
+      fleet = _make_fleet(num_replicas=2, autoscale_sample_s=0.0)
+      try:
+        for _ in range(6):
+          fleet.predict(X1)
+        assert fleet.recommended_replicas() == 1
+      finally:
+        fleet.close()
+
+  def test_busy_window_blocks_scale_in(self):
+    # Same trough by the outstanding signal — but the device-time
+    # ledger holds a recent busy burst, so the projected utilization on
+    # the smaller fleet exceeds the target and the gate holds at 2.
+    with metrics_lib.isolated() as reg:
+      fleet = _make_fleet(num_replicas=2, autoscale_sample_s=0.0)
+      try:
+        for _ in range(6):
+          fleet.predict(X1)
+        fleet._usage.record_busy("replica0", 5.0)
+        assert fleet.recommended_replicas() == 2
+        snap = reg.snapshot()
+      finally:
+        fleet.close()
+    # The gate exported what it measured (clamped busy >> wall).
+    assert snap["gauge/serve/fleet/window_utilization"] == 1.0
+    assert snap["gauge/serve/fleet/recommended_replicas"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# graftscope watch over shard files.
+# ---------------------------------------------------------------------------
+
+
+def _write_shard(root, pid, gen, snapshot, role="worker", age_s=0.0):
+  payload = {
+      "graftrace": "v1", "pid": pid, "gen": gen, "role": role,
+      "clock": {"perf_ns": time.perf_counter_ns(),
+                "epoch_ns": time.time_ns() - int(age_s * 1e9)},
+      "snapshot": snapshot,
+  }
+  path = os.path.join(root, f"metrics-{pid}-{gen:06d}.json")
+  with open(path, "w") as f:
+    json.dump(payload, f)
+  return path
+
+
+_HEALTHY_SNAPSHOT = {
+    "counter/serve/fleet/requests": 100.0,
+    "counter/serve/fleet/shed": 0.0,
+    "counter/serve/slo_breaches": 0.0,
+    "counter/serve/fleet/busy_ms/replica0": 1500.0,
+    "hist/serve/request_ms/p50": 3.0,
+    "hist/serve/request_ms/p99": 9.0,
+    "gauge/serve/fleet/utilization": 0.4,
+    "gauge/serve/fleet/device_seconds_busy": 12.0,
+    "gauge/serve/fleet/device_seconds_idle": 18.0,
+    "gauge/serve/fleet/cost_per_request_usd": 0.0001,
+}
+
+
+class TestWatch:
+
+  def test_snapshot_json_healthy_exit0(self, tmp_path, capsys):
+    _write_shard(str(tmp_path), 11, 1, _HEALTHY_SNAPSHOT)
+    _write_shard(str(tmp_path), 22, 3,
+                 {"counter/serve/fleet/requests": 50.0,
+                  "counter/serve/fleet/busy_ms/replica1": 800.0},
+                 role="server")
+    code = graftscope.main(
+        ["watch", str(tmp_path), "--snapshot", "--json"])
+    view = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert view["healthy"] is True
+    assert view["live_workers"] == 2
+    # Counters SUM across workers; gauges take the max.
+    assert view["fleet"]["requests"] == 150.0
+    assert view["utilization"]["utilization"] == 0.4
+    assert view["utilization"]["busy_s_by_group"] == {
+        "replica0": 1.5, "replica1": 0.8}
+    assert all(s["ok"] for s in view["slo"].values())
+
+  def test_over_budget_exits_1(self, tmp_path, capsys):
+    bad = dict(_HEALTHY_SNAPSHOT)
+    bad["counter/serve/slo_breaches"] = 50.0  # 50% vs the 1% budget
+    _write_shard(str(tmp_path), 11, 1, bad)
+    code = graftscope.main(["watch", str(tmp_path), "--snapshot"])
+    out = capsys.readouterr().out
+    assert code == 1
+    assert "BURNING" in out
+    assert "OVER BUDGET" in out
+    assert "serve_latency" in out
+
+  def test_stale_worker_excluded_from_the_merge(self, tmp_path, capsys):
+    _write_shard(str(tmp_path), 11, 1, _HEALTHY_SNAPSHOT)
+    # A dead worker's FINAL flush holds catastrophic counters forever;
+    # its age must take it out of the SLO read.
+    dead = {"counter/serve/fleet/requests": 1000.0,
+            "counter/serve/slo_breaches": 1000.0}
+    _write_shard(str(tmp_path), 22, 9, dead, age_s=120.0)
+    code = graftscope.main(
+        ["watch", str(tmp_path), "--snapshot", "--json"])
+    view = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert view["healthy"] is True
+    assert view["live_workers"] == 1
+    stale = [w for w in view["workers"] if w["pid"] == 22]
+    assert stale[0]["stale"] is True
+    assert stale[0]["age_s"] >= 119.0
+    assert view["fleet"]["requests"] == 100.0  # dead worker excluded
+    # With a stale window wide enough it merges back in — and burns.
+    code = graftscope.main(["watch", str(tmp_path), "--snapshot",
+                            "--json", "--stale-s", "3600"])
+    view = json.loads(capsys.readouterr().out)
+    assert code == 1
+    assert view["fleet"]["requests"] == 1100.0
+
+  def test_corrupt_and_foreign_shards_are_counted_not_raised(
+      self, tmp_path, capsys):
+    _write_shard(str(tmp_path), 11, 1, _HEALTHY_SNAPSHOT)
+    with open(tmp_path / "metrics-99-000001.json", "w") as f:
+      f.write("{torn mid-write")
+    with open(tmp_path / "metrics-98-000001.json", "w") as f:
+      json.dump({"some": "foreign file"}, f)
+    code = graftscope.main(
+        ["watch", str(tmp_path), "--snapshot", "--json"])
+    view = json.loads(capsys.readouterr().out)
+    assert code == 0
+    assert view["skipped"] == 2
+    assert view["live_workers"] == 1
+
+  def test_newest_generation_per_pid_wins(self, tmp_path, capsys):
+    _write_shard(str(tmp_path), 11, 1,
+                 {"counter/serve/fleet/requests": 10.0})
+    _write_shard(str(tmp_path), 11, 2,
+                 {"counter/serve/fleet/requests": 30.0})
+    graftscope.main(["watch", str(tmp_path), "--snapshot", "--json"])
+    view = json.loads(capsys.readouterr().out)
+    # Generations are windows of ONE registry: summing would
+    # double-count; the newest wins.
+    assert view["fleet"]["requests"] == 30.0
+    assert len(view["workers"]) == 1
+
+  def test_unusable_directories_exit_2(self, tmp_path, capsys):
+    assert graftscope.main(
+        ["watch", str(tmp_path), "--snapshot"]) == 2  # empty
+    assert graftscope.main(
+        ["watch", str(tmp_path / "missing"), "--snapshot"]) == 2
+    capsys.readouterr()
+
+  def test_stamped_snapshot_carries_the_paired_clock(self):
+    reg = metrics_lib.Registry()
+    reg.counter("a").inc(2)
+    stamped = reg.stamped_snapshot()
+    assert stamped["clock"]["perf_ns"] > 0
+    assert stamped["clock"]["epoch_ns"] > 0
+    assert stamped["snapshot"]["counter/a"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# graftscope diff --trend.
+# ---------------------------------------------------------------------------
+
+
+def _trend_record(eps, util=0.8, burn=0.0):
+  return {"bench": {"metric": "qtopt_fleet_qps_cpu_smoke", "value": eps,
+                    "unit": "examples/sec", "fleet_utilization": util,
+                    "slo_budget_burn": burn}}
+
+
+class TestTrend:
+
+  def test_direction_aware_medians(self):
+    records = [_trend_record(100.0)] * 4 + [_trend_record(60.0, 0.3)] * 4
+    trends = runlog_lib.trend_records(records, k=3)
+    by_name = {t["metric"]: t for t in trends}
+    assert by_name["examples_per_sec"]["regressed"] is True  # down-bad
+    assert by_name["fleet_utilization"]["regressed"] is True  # down-bad
+    assert by_name["slo_budget_burn"]["regressed"] is False  # flat 0
+
+  def test_burn_growth_from_zero_flags(self):
+    records = [_trend_record(100.0)] * 4 + [
+        _trend_record(100.0, burn=3.0)] * 4
+    trends = runlog_lib.trend_records(records, k=3)
+    by_name = {t["metric"]: t for t in trends}
+    assert by_name["slo_budget_burn"]["regressed"] is True  # up-bad
+    assert by_name["examples_per_sec"]["regressed"] is False
+
+  def test_short_history_is_skipped(self):
+    trends = runlog_lib.trend_records([_trend_record(100.0)] * 3, k=3)
+    assert trends == []  # < k+1 observations: no prior window
+
+  def test_cli_exit_codes(self, tmp_path, capsys):
+    runs = tmp_path / runlog_lib.RUNS_FILENAME
+    with open(runs, "w") as f:
+      for record in ([_trend_record(100.0)] * 4
+                     + [_trend_record(60.0, 0.3)] * 4):
+        f.write(json.dumps(record) + "\n")
+    assert graftscope.main(["diff", "--trend", str(tmp_path)]) == 3
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out
+    # A flat history passes.
+    flat = tmp_path / "flat"
+    flat.mkdir()
+    with open(flat / runlog_lib.RUNS_FILENAME, "w") as f:
+      for _ in range(8):
+        f.write(json.dumps(_trend_record(100.0)) + "\n")
+    assert graftscope.main(["diff", "--trend", str(flat)]) == 0
+    # Usage errors: --trend takes ONE source; plain diff needs two.
+    assert graftscope.main(
+        ["diff", "--trend", str(tmp_path), str(flat)]) == 2
+    assert graftscope.main(["diff", str(tmp_path)]) == 2
+    assert graftscope.main(
+        ["diff", "--trend", str(tmp_path / "nope")]) == 2
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# graftlint slo-unbudgeted.
+# ---------------------------------------------------------------------------
+
+
+class TestSloLintRule:
+
+  def test_missing_budget_keywords_flagged(self):
+    from tensor2robot_tpu.analysis import slo_check
+
+    findings = slo_check.check_python_source(
+        "m.py", "from tensor2robot_tpu.obs.slo import SloSpec\n"
+                "s = SloSpec('a', bad_key='b', total_key='c')\n")
+    assert len(findings) == 1
+    assert findings[0].rule == "slo-unbudgeted"
+    assert "budget" in findings[0].message
+    # Attribute form too.
+    findings = slo_check.check_python_source(
+        "m.py", "s = slo.SloSpec('a', budget=0.1, bad_key='b',\n"
+                "                total_key='c')\n")
+    assert len(findings) == 1
+    assert "fast_window_s" in findings[0].message
+
+  def test_complete_construction_and_splat_pass(self):
+    from tensor2robot_tpu.analysis import slo_check
+
+    assert not slo_check.check_python_source(
+        "m.py", "s = SloSpec('a', budget=0.1, fast_window_s=1.0,\n"
+                "            slow_window_s=2.0, bad_key='b',\n"
+                "            total_key='c')\n")
+    # A **kwargs splat is not statically verifiable: skipped.
+    assert not slo_check.check_python_source(
+        "m.py", "s = SloSpec('a', **kw)\n")
+
+  def test_respelled_incident_kind_flagged_outside_sentinel(self):
+    from tensor2robot_tpu.analysis import slo_check
+
+    literal = "serving_" + "slo_burn"  # keep THIS file lint-clean too
+    source = f'KIND = "{literal}"\n'
+    findings = slo_check.check_python_source(
+        "tensor2robot_tpu/serving/custom_sink.py", source)
+    assert len(findings) == 1
+    assert "SLO_BURN" in findings[0].message
+    # The defining module spells it out legitimately.
+    assert not slo_check.check_python_source(
+        "tensor2robot_tpu/obs/sentinel.py", source)
+
+  def test_suppression_honored(self):
+    from tensor2robot_tpu.analysis import findings as findings_lib
+    from tensor2robot_tpu.analysis import slo_check
+
+    source = ("s = SloSpec('a', bad_key='b', total_key='c')"
+              "  # graftlint: disable=slo-unbudgeted\n")
+    raw = slo_check.check_python_source("m.py", source)
+    assert raw  # found, then filtered by the suppression
+    assert not findings_lib.filter_findings(
+        raw, findings_lib.load_suppressions(source))
+
+  def test_rule_is_catalogued(self):
+    from tensor2robot_tpu.analysis import engine as engine_lib
+
+    engine_lib.load_builtin_rules()
+    assert "slo-unbudgeted" in engine_lib.catalog_markdown()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1: the whole graftwatch stack, backend-free under a poisoned
+# platform.
+# ---------------------------------------------------------------------------
+
+
+_TRAP_CODE = """
+import json, os, sys, time
+root = sys.argv[1]
+
+from tensor2robot_tpu.obs import graftrace
+from tensor2robot_tpu.obs import metrics as metrics_lib
+from tensor2robot_tpu.obs import slo as slo_lib
+from tensor2robot_tpu.obs import usage as usage_lib
+from tensor2robot_tpu.bin import graftscope
+
+# Engine + ledger recording into the process registry...
+graftrace.configure(root, role="server")
+ledger = usage_lib.UsageLedger(name="serve/fleet")
+ledger.open_group("replica0", devices=1)
+ledger.record_busy("replica0", 0.05, requests=4)
+metrics_lib.counter("serve/fleet/requests").inc(4)
+engine = slo_lib.SloEngine(slo_lib.default_serving_slos())
+engine.observe(metrics_lib.get_registry().snapshot(), now=1.0)
+ledger.summary()
+path = graftrace.flush()
+assert path is not None, "flush produced no shard"
+
+# ...and every reader over the shard directory alone.
+rc_watch = graftscope.main(["watch", root, "--snapshot", "--json"])
+assert rc_watch == 0, f"watch exit {rc_watch}"
+runs = os.path.join(root, "runs.jsonl")
+with open(runs, "w") as f:
+  for _ in range(8):
+    f.write(json.dumps({"bench": {"value": 10.0, "unit": "ex/sec",
+                                  "fleet_utilization": 0.5,
+                                  "slo_budget_burn": 0.0}}) + "\\n")
+rc_trend = graftscope.main(["diff", "--trend", root])
+assert rc_trend == 0, f"trend exit {rc_trend}"
+
+from jax._src import xla_bridge
+assert not getattr(xla_bridge, "_backends", None), "backend initialized"
+print("GRAFTWATCH_TRAP_OK")
+"""
+
+
+def test_graftwatch_stack_is_backend_free(tmp_path):
+  """SLO engine, usage ledger, shard flush, `watch --snapshot` and
+  `diff --trend` in a REAL subprocess whose JAX platform is poisoned:
+  any backend init dies loudly. The watch acceptance pin — the
+  dashboard renders from shard files alone."""
+  env = {**os.environ, "PYTHONPATH": REPO_ROOT,
+         "JAX_PLATFORMS": "graftrace_trap"}
+  env.pop("XLA_FLAGS", None)
+  result = subprocess.run(
+      [sys.executable, "-c", _TRAP_CODE, str(tmp_path)],
+      capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+      env=env)
+  assert result.returncode == 0, (result.stdout[-2000:],
+                                  result.stderr[-2000:])
+  assert "GRAFTWATCH_TRAP_OK" in result.stdout
+  # Satellite pin: the shard the child flushed carries the paired
+  # monotonic/epoch stamp watch staleness reads (and its counters).
+  shards = aggregate_lib.latest_metrics_shards(str(tmp_path))["shards"]
+  assert len(shards) == 1
+  clock = shards[0]["clock"]
+  assert clock["perf_ns"] > 0 and clock["epoch_ns"] > 0
+  snap = shards[0]["snapshot"]
+  assert snap["counter/serve/fleet/busy_ms/replica0"] == pytest.approx(
+      50.0)
+  assert snap["counter/serve/fleet/requests"] == 4.0
