@@ -1784,8 +1784,24 @@ def session_main() -> None:
   tick scales with T. A churn sweep (open/step/close under slot
   pressure, evictions included) pins zero recompiles after warmup
   (`engine_compiles` stays at the warmed ladder count, exec_fallbacks
-  0). Appended to runs.jsonl; `scripts/session_bench.sh` diff-gates
-  `session_vs_stateless` (down-bad) and `decode_tick_ms` (up-bad).
+  0).
+
+  ISSUE 20 adds a graftkern A/B at the headline T: the same predictor
+  behind two fresh engines, `use_decode_kernel=True` (forced — on CPU
+  this runs the fused Pallas kernels under the interpreter, so the
+  real kernel body is exercised every bench run) vs `=False` (the
+  jitted gather/decode/scatter reference). `decode_kernel_vs_xla` is
+  the pair-median xla/kernel per-tick ratio (>1 = kernel faster; on
+  CPU it reads BELOW 1 — interpreter tax — and the gate tracks drift,
+  not absolute speed; the hardware win only shows on TPU, see
+  PERFORMANCE.md "Reading a decode-kernel bench"). The kernel arm must
+  be compile-quiet after its warm episode (`kernel_compiles_stable`).
+  The default (auto) engine stays on the jitted path off-TPU, so the
+  pre-existing gates measure what they always measured.
+
+  Appended to runs.jsonl; `scripts/session_bench.sh` diff-gates
+  `session_vs_stateless` + `decode_kernel_vs_xla` (down-bad) and
+  `decode_tick_ms` (up-bad).
   """
   backend_lib.pin_cpu()
   backend_lib.assert_cpu_backend()
@@ -1802,6 +1818,7 @@ def session_main() -> None:
   engine = None
   churn_block = None
   stage_block = None
+  kernel_block = None
   for seq_len in SESSION_PREFIX_LENGTHS:
     # hidden 128: big enough that model compute (not per-call dispatch
     # overhead, ~0.1 ms on this host) dominates the stateless tick, so
@@ -1864,6 +1881,68 @@ def session_main() -> None:
     }
 
     if seq_len == SESSION_PREFIX_LENGTHS[-1]:
+      # graftkern A/B at the headline T (ISSUE 20): same predictor, two
+      # fresh engines with the kernel tier forced to opposite sides.
+      # Distinct names => distinct graftcache namespaces, so kernel-arm
+      # rungs never collide with xla-arm rungs. Paired alternating-order
+      # episodes, exactly like the session_vs_stateless pairing above.
+      kern_engine = serving.SessionEngine(
+          predictor=predictor, max_sessions=SESSION_MAX_SESSIONS,
+          buckets=SESSION_BUCKETS, name="serve/session/kern",
+          use_decode_kernel=True)
+      xla_engine = serving.SessionEngine(
+          predictor=predictor, max_sessions=SESSION_MAX_SESSIONS,
+          buckets=SESSION_BUCKETS, name="serve/session/xla",
+          use_decode_kernel=False)
+
+      def arm_episode_ms(arm) -> float:
+        t0 = time.perf_counter()
+        sid = arm.open()
+        for t in range(seq_len):
+          arm.step(sid, {"observation": obs_seq[0, t]})
+        arm.close_session(sid)
+        return (time.perf_counter() - t0) * 1e3 / seq_len
+
+      for arm in (kern_engine, xla_engine):
+        arm.warmup()
+        arm_episode_ms(arm)  # warm episode, out of the timed window
+      kern_compiles_warm = kern_engine.compile_count
+      kern_ms_samples: list = []
+      xla_ms_samples: list = []
+      ab_ratios: list = []
+      for pair in range(SESSION_PAIRS):
+        if pair % 2 == 0:
+          k_ms, x_ms = (arm_episode_ms(kern_engine),
+                        arm_episode_ms(xla_engine))
+        else:
+          x_ms, k_ms = (arm_episode_ms(xla_engine),
+                        arm_episode_ms(kern_engine))
+        kern_ms_samples.append(k_ms)
+        xla_ms_samples.append(x_ms)
+        ab_ratios.append(x_ms / k_ms if k_ms else float("inf"))
+        print(f"bench-session: T={seq_len} kernel-A/B pair {pair}: "
+              f"kernel {k_ms:.2f} ms/tick, xla {x_ms:.2f} ms/tick "
+              f"({ab_ratios[-1]:.2f}x)", file=sys.stderr)
+      kernel_block = {
+          # >1 = kernel arm faster. On CPU the kernel arm runs the
+          # Pallas INTERPRETER (interpret_mode below), so this reads
+          # below 1 and the diff gate tracks drift, not absolute wins.
+          "decode_kernel_vs_xla": round(_median(ab_ratios), 3),
+          "kernel_tick_ms": round(_median(kern_ms_samples), 3),
+          "xla_tick_ms": round(_median(xla_ms_samples), 3),
+          "kernel_active": kern_engine.decode_kernel_active,
+          "kernel_reason": kern_engine.decode_kernel_reason,
+          "xla_reason": xla_engine.decode_kernel_reason,
+          # The acceptance pin: zero fresh compiles in the kernel arm
+          # across the measured episodes (warm ladder + warm episode
+          # already paid every trace).
+          "kernel_compiles_stable":
+              kern_engine.compile_count == kern_compiles_warm,
+          "kernel_compiles": kern_engine.compile_count,
+          "interpret_mode": device.platform != "tpu",
+          "pairs": SESSION_PAIRS,
+      }
+
       # Churn sweep at the headline T: opens/steps under slot pressure
       # (forced evictions) + multi-session step_many across every
       # bucket — compile_count must not move and nothing may fall back.
@@ -1937,6 +2016,13 @@ def session_main() -> None:
       # the prefix quadruples.
       "decode_tick_flat_32_vs_8": round(decode_hi / decode_lo, 3)
       if decode_lo else None,
+      # graftkern A/B (ISSUE 20): pair-median xla/kernel tick ratio at
+      # the headline T, diff-gated down-bad (drift detector — on CPU
+      # the kernel arm is interpreter-mode, so the absolute value is
+      # not a win claim; the `decode_kernel` block carries the detail).
+      "decode_kernel_vs_xla":
+          kernel_block["decode_kernel_vs_xla"] if kernel_block else None,
+      "decode_kernel": kernel_block,
       "by_prefix": {str(t): per_t[t] for t in SESSION_PREFIX_LENGTHS},
       "buckets": engine.buckets,
       "max_sessions": SESSION_MAX_SESSIONS,

@@ -15,7 +15,12 @@
 #     decode_tick_ms       — absolute cached tick cost (up-bad 50%;
 #                            wall-clock on the 1-core host, loose band
 #                            — host_load in the headline attributes
-#                            noise).
+#                            noise),
+#     decode_kernel_vs_xla — the graftkern A/B (ISSUE 20): paired
+#                            xla/kernel per-tick ratio at T=32, kernel
+#                            arm forced on (Pallas interpreter on CPU —
+#                            drift gate, down-bad 15%; PERFORMANCE.md
+#                            "Reading a decode-kernel bench").
 #
 # A regression in either exits non-zero exactly like a training one.
 #

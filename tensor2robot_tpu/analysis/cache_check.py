@@ -14,9 +14,10 @@ first takes the path:
 * `cache-key-missing-component` — a `cache_key(...)` /
   `excache.cache_key(...)` call site that does not pass every required
   component keyword (`jaxpr_fingerprint`, `avals`, `mesh`,
-  `backend_version`, `donation`, `static_args`). A literal `**kwargs`
-  splat at the call site is accepted (not statically analyzable); the
-  idiomatic `**key_components_from_traced(...)` splat is exactly that.
+  `backend_version`, `donation`, `static_args`, `pallas`). A literal
+  `**kwargs` splat at the call site is accepted (not statically
+  analyzable); the idiomatic `**key_components_from_traced(...)` splat
+  is exactly that.
 
 Pure AST analysis, backend-free like every graftlint rule. Suppress
 with a trailing `# graftlint: disable=cache-key-missing-component`.
@@ -39,7 +40,8 @@ __all__ = ["REQUIRED_COMPONENTS", "check_python_source",
 # the wrong topology/dtype/compiler (tests/test_excache.py pins the two
 # lists against each other so they cannot drift).
 REQUIRED_COMPONENTS = ("jaxpr_fingerprint", "avals", "mesh",
-                       "backend_version", "donation", "static_args")
+                       "backend_version", "donation", "static_args",
+                       "pallas")
 
 _RULE = "cache-key-missing-component"
 
@@ -100,8 +102,9 @@ engine_lib.register(engine_lib.Rule(
              "of the mandatory executable-cache key\n"
              "components (jaxpr fingerprint, aval shapes/\n"
              "dtypes, mesh topology, backend version,\n"
-             "donation layout, static args) — an under-keyed\n"
-             "cache can serve a mismatched executable;\n"
+             "donation layout, static args, pallas kernel\n"
+             "lowerings) — an under-keyed cache can serve\n"
+             "a mismatched executable;\n"
              "a `**splat` call site is accepted"),
         meaning=("a `cache_key(...)` call site omits a mandatory key "
                  "component (`**splat` accepted)")),),

@@ -66,14 +66,14 @@ SEVERITIES = ("error", "warning")
 # it, so it is part of the byte-parity contract, not a style choice).
 CHECK_ORDER = ("tracer", "spec", "cache", "pp", "session", "fleet",
                "forge", "retry", "thread", "loop", "native", "tracectx",
-               "slo")
+               "slo", "pallas")
 
 # Catalog presentation order — the family order `--list-rules` has
 # always printed (config first, spec last) with the jaxpr-audit family
 # appended after it.
 CATALOG_ORDER = ("config", "tracer", "tracectx", "cache", "pp",
                  "session", "retry", "fleet", "forge", "loop", "thread",
-                 "native", "slo", "spec", "audit")
+                 "native", "pallas", "slo", "spec", "audit")
 
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".ipynb_checkpoints"}
 
@@ -173,11 +173,11 @@ def load_builtin_rules() -> None:
   from tensor2robot_tpu.analysis import (cache_check, config_check,  # noqa: F401
                                          fleet_check, forge_check,
                                          jaxpr_audit, loop_check,
-                                         native_check, pp_check,
-                                         retry_check, session_check,
-                                         slo_check, spec_check,
-                                         thread_check, trace_check,
-                                         tracer_check)
+                                         native_check, pallas_check,
+                                         pp_check, retry_check,
+                                         session_check, slo_check,
+                                         spec_check, thread_check,
+                                         trace_check, tracer_check)
   _BUILTINS_LOADED = True
 
 

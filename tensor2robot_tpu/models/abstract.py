@@ -249,6 +249,31 @@ class T2RModel(ModelInterface):
     raise NotImplementedError(
         f"{type(self).__name__} has no session-decode seam.")
 
+  @property
+  def supports_decode_kernel(self) -> bool:
+    """True when the model exposes `decode_arena_step_fn` below — the
+    graftkern fused-arena decode seam (ISSUE 20). False (the default)
+    auto-gates `SessionEngine(use_decode_kernel=None)` onto the plain
+    jitted `decode_step_fn` path: carry-based models (LSTM) have no KV
+    arena layout for the kernel to stream."""
+    return False
+
+  def decode_arena_step_fn(self):
+    """A PURE `fn(state, arena, slots, features, mask) -> (new_arena,
+    outputs)` advancing the masked lanes ONE tick directly against the
+    WHOLE session arena (leaves [max_sessions + 1, ...], slot 0 the
+    null slot) — the fused alternative to gather -> `decode_step_fn`
+    -> scatter: KV leaves ride `ops.decode_kernels.fused_decode_attention`
+    (one kernel launch per leaf family, O(index) HBM traffic, in-place
+    append), tiny leaves (the tick index) update via XLA scatters.
+    Must be tick-for-tick numerics-equivalent to the `decode_step_fn`
+    composition on live lanes — `SessionEngine` keeps that path as the
+    semantics-pinned fallback and tests pin parity at every T."""
+    raise NotImplementedError(
+        f"{type(self).__name__} has no fused-arena decode seam; set "
+        "supports_decode_kernel/decode_arena_step_fn to serve it "
+        "through the graftkern decode-kernel tier.")
+
   def create_optimizer(self) -> optax.GradientTransformation:
     """Optax chain; gin-injected factory wins (reference create_optimizer +
     MovingAverage wrapping, abstract_model.py:836-871). Subclasses may

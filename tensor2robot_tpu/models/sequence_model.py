@@ -286,6 +286,62 @@ class SequenceRegressionModel(abstract_model.T2RModel):
 
     return decode_step
 
+  # -- graftkern fused-arena decode seam (ISSUE 20) -------------------------
+
+  @property
+  def supports_decode_kernel(self) -> bool:
+    """The KV arena layout ([S, T, H, D] per block, T-major) is exactly
+    what `fused_decode_attention` streams — the kernel tier applies."""
+    return True
+
+  def decode_arena_step_fn(self):
+    """Pure per-tick forward AGAINST THE WHOLE ARENA: same math as
+    `decode_step_fn`, but each block's cached attention runs as ONE
+    fused Pallas launch over the arena leaves (gather + in-place append
+    + online softmax, `ops.decode_kernels`) instead of the gather ->
+    `.at[rows, index].set` -> `cached_attention` -> scatter composition.
+    The tick-index leaf advances via a masked XLA scatter-add (pad
+    lanes add 0 through the null slot). The kernel's `interpret=None`
+    default resolves from the process backend at trace time (the
+    serving engine compiles its dispatch for the backend it runs on):
+    CPU smoke/tier-1 runs the interpreter over the same kernel body
+    that Mosaic compiles on TPU."""
+    from tensor2robot_tpu.ops import decode_kernels as decode_kernels_ops
+
+    num_blocks = self._num_blocks
+    num_heads = self._num_heads
+    head_dim = self._hidden_size // self._num_heads
+
+    def decode_arena_step(state, arena, slots, features, mask):
+      params = state.eval_params()
+      obs = features["observation"]  # [B, obs]
+      b = obs.shape[0]
+      index = arena["index"][slots]  # [B] — each lane's tick position
+      x = _dense(params["embed"], obs)  # [B, hidden]
+      new_arena = {"index": arena["index"].at[slots].add(
+          jnp.where(mask, 1, 0).astype(arena["index"].dtype))}
+      for i in range(num_blocks):
+        y = _layernorm(params[f"ln_attn_{i}"], x)
+        attn = params[f"attn_{i}"]
+        q = _dense(attn["q_proj"], y).reshape(b, num_heads, head_dim)
+        k_t = _dense(attn["k_proj"], y).reshape(b, num_heads, head_dim)
+        v_t = _dense(attn["v_proj"], y).reshape(b, num_heads, head_dim)
+        out, k_arena, v_arena = decode_kernels_ops.fused_decode_attention(
+            q, k_t, v_t, arena[f"k_{i}"], arena[f"v_{i}"], slots, index,
+            mask)
+        new_arena[f"k_{i}"] = k_arena
+        new_arena[f"v_{i}"] = v_arena
+        y = _dense(attn["out_proj"], out.reshape(b, num_heads * head_dim))
+        x = x + y
+        y = _layernorm(params[f"ln_mlp_{i}"], x)
+        y = _dense(params[f"mlp_out_{i}"],
+                   nn.gelu(_dense(params[f"mlp_in_{i}"], y)))
+        x = x + y
+      action = _dense(params["head"], x)  # [B, act]
+      return new_arena, {"action": action, "inference_output": action}
+
+    return decode_arena_step
+
 
 class _LSTMTrunk(nn.Module):
   """obs [B, T, obs] -> LSTM over time -> Dense head -> [B, T, act].

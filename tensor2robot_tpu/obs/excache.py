@@ -75,7 +75,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from tensor2robot_tpu.obs import metrics as metrics_lib
 
 __all__ = ["CACHE_VERSION", "cache_key", "key_components_from_traced",
-           "jaxpr_fingerprint", "mesh_fingerprint", "backend_fingerprint",
+           "jaxpr_fingerprint", "pallas_fingerprint", "mesh_fingerprint",
+           "backend_fingerprint",
            "aot_cache_unsafe", "donating_mesh_cache_unsafe",
            "DONATING_MESH_SAFE_FROM", "ExecutableCache", "as_cache",
            "enable_xla_cache", "xla_cache_bypassed", "cache_stats"]
@@ -83,7 +84,9 @@ __all__ = ["CACHE_VERSION", "cache_key", "key_components_from_traced",
 # Bumped whenever the entry format (blob layout, meta schema, key
 # recipe) changes — part of every key, so an old-format entry can never
 # be deserialized by a new reader; it just misses and gets recompiled.
-CACHE_VERSION = 1
+# v2: the key grew the `pallas` component (ISSUE 20 — kernel-revision
+# invalidation for Pallas/Mosaic lowerings).
+CACHE_VERSION = 2
 
 # THE toolchain pin for the donating-mesh cache gate (ROADMAP item 5's
 # standing note, mechanized). On jax 0.4.37 a deserialized executable —
@@ -160,7 +163,8 @@ def cache_key(name: str, *,
               mesh: str,
               backend_version: str,
               donation: str,
-              static_args: str) -> str:
+              static_args: str,
+              pallas: str) -> str:
   """THE canonical graftcache key. Every keyword is mandatory on purpose.
 
   A cached executable is only valid for exactly the computation, input
@@ -182,7 +186,17 @@ def cache_key(name: str, *,
     buffer aliasing in the compiled artifact, not just the jaxpr;
   * `static_args` — repr of the non-array (static/config) arguments, a
     belt-and-braces over the jaxpr baking (a static value that steers
-    compile options without appearing in the jaxpr still invalidates).
+    compile options without appearing in the jaxpr still invalidates);
+  * `pallas` — the Pallas/Mosaic lowering component
+    (`pallas_fingerprint`): kernel-body hash + kernel count + the jax
+    (== pallas) version for every `pallas_call` in the computation, or
+    `"none"`. The kernel BODY rides inside the jaxpr fingerprint too,
+    but grid/BlockSpec/alias/compiler-params metadata lives in eqn
+    params whose rendering the jaxpr hash covers only incidentally —
+    this component pins kernel revisions explicitly, so editing a
+    kernel (or upgrading the pallas toolchain that compiles it)
+    invalidates cached executables even when the surrounding jaxpr
+    text is unchanged.
 
   Pure stdlib over pre-computed strings: key computation must work on
   the tunnel machine with no backend (poisoned-platform test). Callers
@@ -199,6 +213,7 @@ def cache_key(name: str, *,
       "backend": str(backend_version),
       "donation": str(donation),
       "static": str(static_args),
+      "pallas": str(pallas),
   }, sort_keys=True)
   digest = hashlib.sha256(payload.encode("utf-8")).hexdigest()[:32]
   return f"{_slug(name)}-{digest}"
@@ -257,6 +272,51 @@ def jaxpr_fingerprint(jaxpr) -> str:
   """sha256 of the jaxpr's address-normalized string form."""
   return hashlib.sha256(
       _ADDR_RE.sub("0x", str(jaxpr)).encode("utf-8")).hexdigest()
+
+
+def _param_jaxprs(val):
+  """Yields the jaxprs nested inside one eqn param value (ClosedJaxpr,
+  bare Jaxpr, or tuples/lists of either — the shapes cond/scan/pjit
+  and pallas_call actually use)."""
+  vals = val if isinstance(val, (tuple, list)) else (val,)
+  for v in vals:
+    inner = getattr(v, "jaxpr", v)  # ClosedJaxpr -> Jaxpr
+    if hasattr(inner, "eqns"):
+      yield inner
+
+
+def pallas_fingerprint(jaxpr) -> str:
+  """The Pallas/Mosaic lowering component of a cache key.
+
+  Walks the (closed) jaxpr recursively — through cond/scan/pjit/remat
+  sub-jaxprs — collecting every `pallas_call` equation, and hashes
+  their address-normalized string forms (the kernel BODY jaxpr plus
+  the grid/BlockSpec/alias/compiler-params metadata all render into
+  the eqn text). Returns `"none"` for kernel-free computations — the
+  overwhelmingly common key stays byte-stable and visibly
+  kernel-free — else `jax=<version>;n=<count>;<sha256[:32]>`: a kernel
+  revision OR a pallas toolchain bump (pallas ships inside jax, so the
+  jax version IS the pallas version) invalidates cached executables.
+  Pure jaxpr-walking — never touches a backend (poisoned-platform
+  safe)."""
+  found: List[str] = []
+
+  def walk(jx):
+    for eqn in getattr(jx, "eqns", ()):
+      if eqn.primitive.name == "pallas_call":
+        found.append(_ADDR_RE.sub("0x", str(eqn)))
+      for param_val in eqn.params.values():
+        for sub in _param_jaxprs(param_val):
+          walk(sub)
+
+  walk(getattr(jaxpr, "jaxpr", jaxpr))
+  if not found:
+    return "none"
+  import jax
+
+  digest = hashlib.sha256("||".join(found).encode("utf-8")).hexdigest()
+  return (f"jax={getattr(jax, '__version__', '?')};n={len(found)};"
+          f"{digest[:32]}")
 
 
 def aot_cache_unsafe(traced, args) -> bool:
@@ -333,6 +393,7 @@ def key_components_from_traced(traced, args) -> Dict[str, str]:
       "donation": ",".join("D" if getattr(i, "donated", False) else "-"
                            for i in infos),
       "static_args": ";".join(static),
+      "pallas": pallas_fingerprint(traced.jaxpr),
   }
 
 

@@ -113,6 +113,12 @@ DEFAULT_THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # warmup_ms has one).
     "session_vs_stateless": ("down", 0.15),
     "decode_tick_ms": ("up", 0.50),
+    # graftkern A/B (ISSUE 20): paired xla/kernel per-tick ratio at the
+    # headline T, kernel arm forced on (Pallas interpreter on CPU, so
+    # the absolute value is not a win claim there — the gate is a DRIFT
+    # detector over the kernel dispatch path; back-to-back pairs make
+    # it load-invariant like the other ratio gates).
+    "decode_kernel_vs_xla": ("down", 0.15),
     # Fleet-serving gates (bench.py --fleet / scripts/fleet_bench.sh,
     # PERFORMANCE.md "Reading a fleet bench"): fleet_vs_single_replica
     # is the paired 1-vs-2-replica goodput ratio under open-loop load
@@ -455,6 +461,8 @@ def key_metrics(record: Dict[str, Any]) -> Dict[str, float]:
     out["session_vs_stateless"] = float(bench["session_vs_stateless"])
   if bench.get("decode_tick_ms") is not None:
     out["decode_tick_ms"] = float(bench["decode_tick_ms"])
+  if bench.get("decode_kernel_vs_xla") is not None:
+    out["decode_kernel_vs_xla"] = float(bench["decode_kernel_vs_xla"])
   # Fleet-serving bench (bench.py --fleet): the load-invariant paired
   # replica-scaling ratio and the rollout-window shed/failure count.
   if bench.get("fleet_vs_single_replica") is not None:

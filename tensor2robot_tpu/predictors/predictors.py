@@ -71,6 +71,10 @@ class DecodeBundle(NamedTuple):
   observation_spec: Any        # per-TICK feature spec (warmup synthesis)
   max_ticks: Optional[int] = None  # decode horizon (KV capacity); None
                                    #   = unbounded (pure-carry models)
+  decode_arena_fn: Optional[Callable] = None  # graftkern fused-arena
+                               #   (state, arena, slots, features, mask)
+                               #   -> (new_arena, outputs); None = the
+                               #   model has no kernel-tier layout
 
 
 class AbstractPredictor(abc.ABC):
@@ -212,7 +216,10 @@ class _JaxPredictorBase(AbstractPredictor):
         init_session_state=model.init_session_state,
         get_state=lambda: self._state,
         observation_spec=model.decode_observation_spec,
-        max_ticks=getattr(model, "decode_max_ticks", None))
+        max_ticks=getattr(model, "decode_max_ticks", None),
+        decode_arena_fn=(
+            model.decode_arena_step_fn()
+            if getattr(model, "supports_decode_kernel", False) else None))
 
   def get_feature_specification(self) -> specs_lib.SpecStruct:
     self.assert_is_loaded()

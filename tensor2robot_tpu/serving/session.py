@@ -133,6 +133,37 @@ def _mask_like(mask, leaf):
   return mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
 
 
+def resolve_decode_kernel(requested: Optional[bool], pallas_ok: bool,
+                          pallas_reason: Optional[str],
+                          has_arena_fn: bool,
+                          backend_is_tpu=None) -> Tuple[bool, str]:
+  """The graftkern auto-gate (ISSUE 20), as a pure function: (active,
+  reason). `requested` is the engine's `use_decode_kernel` tri-state —
+  None auto-selects (on iff Pallas imports AND the model exposes the
+  fused-arena seam AND the process backend is a TPU), True/False force.
+  `backend_is_tpu` is a zero-arg thunk so the decision stays
+  backend-free on every forced/declined path (the poisoned-platform
+  trap pins that): it is invoked ONLY when `requested is None` and
+  every other precondition already holds. Auto declines off-TPU
+  because there the kernel tier runs the Pallas interpreter — a
+  parity/smoke vehicle, not a win; `use_decode_kernel=True` still
+  forces it (that is how CPU tier-1 and the bench A/B arm run the
+  real kernel body)."""
+  if requested is False:
+    return False, "disabled (use_decode_kernel=False)"
+  if not pallas_ok:
+    return False, f"pallas-unavailable: {pallas_reason or 'unknown'}"
+  if not has_arena_fn:
+    return False, ("model-unsupported: the decode bundle has no "
+                   "decode_arena_fn (no KV arena layout to stream)")
+  if requested is None and not (backend_is_tpu is not None
+                                and backend_is_tpu()):
+    return False, ("auto-off: non-TPU backend (interpreter-mode kernels "
+                   "are a smoke tier, not a win; use_decode_kernel=True "
+                   "forces them)")
+  return True, "on"
+
+
 # Terminal session ids (closed / evicted) remembered for precise error
 # messages. BOUNDED: a continuous-batching server runs for the
 # deployment lifetime, and an unbounded set would accrete one entry per
@@ -154,7 +185,8 @@ class SessionEngine:
                admission: str = "evict_lru",
                name: str = "serve/session",
                cache=None,
-               cache_namespace: Optional[str] = None):
+               cache_namespace: Optional[str] = None,
+               use_decode_kernel: Optional[bool] = None):
     if predictor is None:
       raise ValueError("predictor is required.")
     if max_sessions < 1:
@@ -186,6 +218,18 @@ class SessionEngine:
     # has the same seam; graftforge relies on it).
     self._cache = cache
     self._cache_namespace = cache_namespace or name
+    # graftkern decode-kernel tier (ISSUE 20): tri-state request,
+    # resolved ONCE at bundle bind (`resolve_decode_kernel`) and sticky
+    # for the engine's lifetime — the bucket ladder is compiled for one
+    # dispatch body, a mid-flight flip would recompile it. The
+    # native-stager discipline from PR 6 applies: an explicit True the
+    # toolchain cannot honor warns once and falls back to the jitted
+    # path; auto (None) degrades silently with a counter. Auto turns on
+    # only on a TPU backend — off-TPU the kernel runs the Pallas
+    # interpreter (parity vehicle, not a win) and must be forced.
+    self._use_decode_kernel = use_decode_kernel
+    self._decode_kernel_active: Optional[bool] = None
+    self._decode_kernel_reason: Optional[str] = None
     # Host bookkeeping (self._lock): slot table + LRU + in-flight set.
     self._lock = threading.Lock()
     self._idle = threading.Condition(self._lock)
@@ -280,13 +324,88 @@ class SessionEngine:
 
     return int(obs_xray.pytree_bytes(self._arena))
 
-  def _make_dispatch(self, decode_fn):
-    """The bucketed decode executable body: masked gather -> one decode
-    tick -> masked scatter. Pad lanes ride the null slot (0) with
-    mask=False, so their writes land masked-out old values on a slot no
-    session owns."""
+  @property
+  def decode_kernel_active(self) -> Optional[bool]:
+    """True/False once the graftkern gate is resolved (at bundle bind);
+    None on a cold engine that has not bound its bundle yet."""
+    return self._decode_kernel_active
+
+  @property
+  def decode_kernel_reason(self) -> Optional[str]:
+    """Why the gate resolved the way it did ('on' when active)."""
+    return self._decode_kernel_reason
+
+  def decode_kernel_mode(self) -> Tuple[bool, str]:
+    """Binds the decode bundle and resolves (and pins) the graftkern
+    gate WITHOUT building any device state — backend-free when the
+    predictor's bundle is (the poisoned-platform trap runs this)."""
+    with self._arena_lock:
+      if self._bundle is None:
+        self._bundle = self._predictor.decode_bundle()
+        self._max_ticks = getattr(self._bundle, "max_ticks", None)
+      self._resolve_decode_kernel_locked()
+      return bool(self._decode_kernel_active), self._decode_kernel_reason
+
+  def _resolve_decode_kernel_locked(self) -> None:
+    """Resolves `use_decode_kernel` against the bound bundle (caller
+    holds _arena_lock). Sticky: later restores/warmups keep the first
+    resolution — the compiled bucket ladder embodies it."""
+    if self._decode_kernel_active is not None:
+      return
+    from tensor2robot_tpu.ops import decode_kernels as decode_kernels_ops
+
+    def _backend_is_tpu():
+      # Thunked: only the fully-eligible auto path ever touches the
+      # backend (forced/declined resolutions stay backend-free, which
+      # the poisoned-platform trap pins).
+      import jax
+
+      return jax.default_backend() == "tpu"
+
+    active, reason = resolve_decode_kernel(
+        self._use_decode_kernel,
+        decode_kernels_ops.pallas_available(),
+        decode_kernels_ops.pallas_unavailable_reason(),
+        getattr(self._bundle, "decode_arena_fn", None) is not None,
+        backend_is_tpu=_backend_is_tpu)
+    self._decode_kernel_active = active
+    self._decode_kernel_reason = reason
+    obs_metrics.gauge("serve/session/decode_kernel").set(float(active))
+    if not active and self._use_decode_kernel is not False:
+      # The auto-gate (or a forced request) declined the kernel tier:
+      # count every degrade; WARN only for the explicit request (the
+      # use_native_stager discipline — auto stays silent).
+      obs_metrics.counter("serve/session/decode_kernel_off").inc()
+      if self._use_decode_kernel is True:
+        from absl import logging
+
+        logging.warning(
+            "%s: use_decode_kernel=True cannot be honored (%s); "
+            "falling back to the jitted decode path.", self._name, reason)
+
+  def _make_dispatch(self, bundle):
+    """The bucketed decode executable body. Kernel tier OFF: masked
+    gather -> one decode tick -> masked scatter (pad lanes ride the
+    null slot (0) with mask=False, so their writes land masked-out old
+    values on a slot no session owns). Kernel tier ON: the bundle's
+    fused-arena step (`decode_arena_fn`) consumes the arena directly —
+    the gather/scatter of the KV leaves happens INSIDE the Pallas
+    launch (slot-steered block maps + in-place append), with the same
+    (state, arena, slots, features, mask) -> (new_arena, outputs)
+    signature, so both tiers share one warmup/caching/fallback path
+    and graftforge forges identical keys for whichever is active."""
     import jax
     import jax.numpy as jnp
+
+    if self._decode_kernel_active:
+      arena_fn = bundle.decode_arena_fn
+
+      def decode_dispatch(state, arena, slots, features, mask):
+        return arena_fn(state, arena, slots, features, mask)
+
+      return jax.jit(decode_dispatch, donate_argnums=(1,))
+
+    decode_fn = bundle.decode_fn
 
     def decode_dispatch(state, arena, slots, features, mask):
       gathered = jax.tree_util.tree_map(lambda a: a[slots], arena)
@@ -327,6 +446,7 @@ class SessionEngine:
       if self._bundle is None:
         self._bundle = self._predictor.decode_bundle()
         self._max_ticks = getattr(self._bundle, "max_ticks", None)
+      self._resolve_decode_kernel_locked()
       bundle = self._bundle
       if self._arena is not None and self._compiled:
         return self
@@ -343,7 +463,7 @@ class SessionEngine:
         if bucket in self._compiled:
           continue
         fn = self._dispatch_jits.setdefault(
-            bucket, self._make_dispatch(bundle.decode_fn))
+            bucket, self._make_dispatch(bundle))
         wire = specs_lib.make_random_numpy(bundle.observation_spec,
                                            batch_size=bucket, seed=0)
         features = {k: np.asarray(v) for k, v in dict(wire).items()}
@@ -431,6 +551,7 @@ class SessionEngine:
       if self._bundle is None:
         self._bundle = self._predictor.decode_bundle()
         self._max_ticks = getattr(self._bundle, "max_ticks", None)
+      self._resolve_decode_kernel_locked()
       bundle = self._bundle
       arena = self._arena
       init_row = self._init_row
@@ -443,7 +564,7 @@ class SessionEngine:
       traces: List[Tuple[Any, Any, Tuple]] = []
       for bucket in self._buckets:
         fn = self._dispatch_jits.setdefault(
-            bucket, self._make_dispatch(bundle.decode_fn))
+            bucket, self._make_dispatch(bundle))
         wire = specs_lib.make_random_numpy(bundle.observation_spec,
                                            batch_size=bucket, seed=0)
         features = {k: np.asarray(v) for k, v in dict(wire).items()}
@@ -694,11 +815,11 @@ class SessionEngine:
               raise
             obs_metrics.counter("serve/session/exec_fallbacks").inc()
             fn = self._dispatch_jits.setdefault(
-                bucket, self._make_dispatch(bundle.decode_fn))
+                bucket, self._make_dispatch(bundle))
             self._arena, outputs = fn(*args)
         else:
           fn = self._dispatch_jits.setdefault(
-              bucket, self._make_dispatch(bundle.decode_fn))
+              bucket, self._make_dispatch(bundle))
           self._arena, outputs = fn(*args)
         # The arena rebind IS the tick: from here the sessions' device
         # state (KV rows, index leaves) has advanced, so the host

@@ -61,7 +61,8 @@ def _snap(name):
 
 
 _COMPONENTS = dict(jaxpr_fingerprint="fp", avals="f32[4,3]", mesh="n8:cpu",
-                   backend_version="jax=0", donation="D-", static_args="")
+                   backend_version="jax=0", donation="D-", static_args="",
+                   pallas="none")
 
 
 class TestCacheKey:
@@ -135,6 +136,63 @@ class TestCacheKey:
         "custom_jvp jvp=<function memoized at 0x7ea29e8745e0> { eqns }")
     assert a == b
     assert a != excache.jaxpr_fingerprint("something else")
+
+  def test_pallas_fingerprint_none_for_kernel_free_jaxpr(self):
+    """The overwhelmingly common key must stay byte-stable: kernel-free
+    computations get the literal 'none' component, and the traced
+    component dict carries it."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((4, 3))
+    traced = jax.jit(lambda x: x * 2).trace(x)
+    assert excache.pallas_fingerprint(traced.jaxpr) == "none"
+    comps = excache.key_components_from_traced(traced, (x,))
+    assert comps["pallas"] == "none"
+
+  def test_pallas_fingerprint_keys_kernel_lowerings(self):
+    """A pallas_call in the computation must key the cache entry on the
+    kernel body + pallas (jax) version — the kernel-revision
+    invalidation satellite (ISSUE 20). Two different kernel bodies over
+    identical avals must fingerprint differently; the same kernel
+    re-traced must fingerprint identically (process-stable)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tensor2robot_tpu.ops import decode_kernels
+
+    if not decode_kernels.pallas_available():
+      pytest.skip("pallas unavailable")
+    b, s, t, h, d = 2, 4, 8, 2, 4
+    q = jnp.ones((b, h, d))
+    arena = jnp.zeros((s, t, h, d))
+    slots = jnp.arange(1, b + 1, dtype=jnp.int32)
+    index = jnp.zeros((b,), jnp.int32)
+    mask = jnp.ones((b,), bool)
+    args = (q, q, q, arena, arena, slots, index, mask)
+
+    def kernel_step(*a):
+      return decode_kernels.fused_decode_attention(*a, interpret=True)
+
+    traced = jax.jit(kernel_step).trace(*args)
+    fp = excache.pallas_fingerprint(traced.jaxpr)
+    assert fp != "none"
+    assert fp.startswith(f"jax={jax.__version__};n=")
+    # Re-trace: process-stable (addresses normalized out).
+    again = excache.pallas_fingerprint(jax.jit(kernel_step).trace(*args).jaxpr)
+    assert fp == again
+    # The component rides key_components_from_traced into the key.
+    comps = excache.key_components_from_traced(traced, args)
+    assert comps["pallas"] == fp
+    # A different block size = different grid/kernel metadata: new key.
+    def kernel_step_b4(*a):
+      return decode_kernels.fused_decode_attention(*a, block_k=4,
+                                                   interpret=True)
+
+    fp_b4 = excache.pallas_fingerprint(jax.jit(kernel_step_b4).trace(*args).jaxpr)
+    assert fp_b4 != fp
+    assert (excache.cache_key("k", **comps)
+            != excache.cache_key("k", **{**comps, "pallas": fp_b4}))
 
 
 # ---------------------------------------------------------------------------
@@ -563,13 +621,14 @@ class TestCacheKeyLint:
     findings = cache_check.check_python_source("x.py", source)
     assert len(findings) == 1
     assert findings[0].rule == "cache-key-missing-component"
-    for component in ("mesh", "backend_version", "static_args"):
+    for component in ("mesh", "backend_version", "static_args", "pallas"):
       assert component in findings[0].message
 
   def test_full_call_and_splat_pass(self):
     source = (
         "key1 = cache_key('fn', jaxpr_fingerprint=a, avals=b, mesh=c,\n"
-        "                 backend_version=d, donation=e, static_args=f)\n"
+        "                 backend_version=d, donation=e, static_args=f,\n"
+        "                 pallas=g)\n"
         "key2 = cache_key('fn', **components)\n")
     assert cache_check.check_python_source("x.py", source) == []
 
@@ -842,7 +901,7 @@ from tensor2robot_tpu.obs import excache
 key = excache.cache_key("train_step",
                         jaxpr_fingerprint="fp", avals="f32[4]",
                         mesh="n8:cpu", backend_version="jax=x",
-                        donation="D-", static_args="")
+                        donation="D-", static_args="", pallas="none")
 assert key.startswith("train_step-"), key
 assert excache.jaxpr_fingerprint("a 0xdead b") == \\
     excache.jaxpr_fingerprint("a 0xbeef b")

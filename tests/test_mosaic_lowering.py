@@ -163,6 +163,33 @@ class TestFlashMosaicLowering:
         s, s, s)
 
 
+class TestDecodeKernelMosaicLowering:
+  """graftkern (ISSUE 20): the fused decode-tick kernel lowers via
+  Mosaic for TPU. `interpret=None` resolves from the PROCESS backend at
+  trace time (correct in the serving engine, which compiles for the
+  backend it runs on), so a TPU-target export from this CPU host must
+  pass interpret=False explicitly — exactly what a real TPU serving
+  process resolves to."""
+
+  @pytest.mark.parametrize("t,block_k", [(32, 8), (96, 32), (512, 128)])
+  def test_fused_decode_tick_lowers_mosaic(self, t, block_k):
+    from tensor2robot_tpu.ops import decode_kernels
+
+    s_sz, b, h, d = 9, 4, 4, 64
+    lane = jax.ShapeDtypeStruct((b, h, d), jnp.float32)
+    arena = jax.ShapeDtypeStruct((s_sz, t, h, d), jnp.float32)
+    i32 = jax.ShapeDtypeStruct((b,), jnp.int32)
+    lanes = jax.ShapeDtypeStruct((b,), jnp.bool_)
+    exported = _export_for_tpu(
+        lambda q, kn, vn, ka, va, sl, ix, mk:
+            decode_kernels.fused_decode_attention(
+                q, kn, vn, ka, va, sl, ix, mk, block_k=block_k,
+                interpret=False),
+        lane, lane, lane, arena, arena, i32, i32, lanes)
+    assert "tpu_custom_call" in exported.mlir_module(), (
+        "fused decode tick did not lower via Mosaic")
+
+
 def _uniform_shapes(tree, sharding):
   """ShapeDtypeStructs for a tree with one sharding everywhere."""
   return jax.tree_util.tree_map(

@@ -597,6 +597,80 @@ def test_grasp2vec_quadrant_centers_is_host_constant():
 
 
 # ---------------------------------------------------------------------------
+# Pallas rule family: pallas-missing-fallback.
+# ---------------------------------------------------------------------------
+
+
+class TestPallasFallbackLint:
+
+  _GUARDED = ("try:\n"
+              "  from jax.experimental import pallas as pl\n"
+              "except ImportError:\n"
+              "  pl = None\n")
+
+  def test_flags_unguarded_pallas_import(self):
+    from tensor2robot_tpu.analysis import pallas_check
+
+    source = ("from jax.experimental import pallas as pl\n"
+              "out = pl.pallas_call(kernel, interpret=True)(x)\n")
+    findings = pallas_check.check_python_source("x.py", source)
+    assert len(findings) == 1
+    assert findings[0].rule == "pallas-missing-fallback"
+    assert "try-guarded" in findings[0].message
+
+  def test_flags_missing_interpret_seam(self):
+    from tensor2robot_tpu.analysis import pallas_check
+
+    source = self._GUARDED + "out = pl.pallas_call(kernel, grid=(4,))(x)\n"
+    findings = pallas_check.check_python_source("x.py", source)
+    assert len(findings) == 1
+    assert "interpret" in findings[0].message
+
+  def test_guarded_import_with_interpret_passes(self):
+    from tensor2robot_tpu.analysis import pallas_check
+
+    source = (self._GUARDED
+              + "out = pl.pallas_call(kernel, interpret=flag)(x)\n"
+              + "out2 = pl.pallas_call(kernel, **kw)(x)\n")
+    assert pallas_check.check_python_source("x.py", source) == []
+
+  def test_kernel_free_and_unparseable_modules_pass(self):
+    from tensor2robot_tpu.analysis import pallas_check
+
+    assert pallas_check.check_python_source(
+        "x.py", "from jax.experimental import pallas as pl\n") == []
+    assert pallas_check.check_python_source("x.py", "def broken(:\n") == []
+
+  def test_suppression_honored(self):
+    from tensor2robot_tpu.analysis import pallas_check
+
+    source = ("out = pallas_call(kernel)"
+              "  # graftlint: disable=pallas-missing-fallback\n")
+    raw = pallas_check.check_python_source("p.py", source)
+    assert len(raw) == 1  # raw check still sees it
+    assert findings_lib.filter_findings(
+        raw, findings_lib.load_suppressions(source)) == []
+
+  def test_engine_runs_the_rule(self, tmp_path):
+    """Registered in the single-pass engine: a fixture violation
+    surfaces through run_engine (catalogued + CHECK_ORDER wired)."""
+    bad = tmp_path / "bad_kernel.py"
+    bad.write_text("from jax.experimental import pallas as pl\n"
+                   "out = pl.pallas_call(kernel)(x)\n")
+    result = engine_lib.run_engine([str(tmp_path)])
+    assert _rules(result.findings) == {"pallas-missing-fallback"}
+
+  def test_repo_kernel_modules_pin_clean(self):
+    """The two shipped kernel tiers ARE the discipline the rule
+    enforces — they must stay clean (soft import + interpret seam)."""
+    from tensor2robot_tpu.analysis import pallas_check
+
+    for rel in ("ops/attention.py", "ops/decode_kernels.py"):
+      path = os.path.join(REPO_ROOT, "tensor2robot_tpu", rel)
+      assert pallas_check.check_python_file(path) == [], rel
+
+
+# ---------------------------------------------------------------------------
 # The rule engine (analysis/engine.py): parity, catalog, JSON, baseline,
 # incremental cache.
 # ---------------------------------------------------------------------------
